@@ -1,0 +1,38 @@
+"""graphmine_tpu — a TPU-native massive-graph-mining framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capability surface of the
+reference PySpark project (community detection + outlier detection over
+massive graphs, ``/root/reference/CommunityDetection/Graphframes.py``):
+
+- L0  ingestion: parquet / edge-list readers, null filtering, dense int32
+      string factorization (replaces the sha1[:8] NodeHash scheme of
+      ``Graphframes.py:57-58`` — no birthday collisions, device-friendly).
+- L1  mesh runtime: ``jax.sharding.Mesh`` + ``shard_map`` over ICI; XLA
+      collectives (psum / all_gather / ppermute) are the comms backend
+      (replaces Spark shuffle + py4j). See :mod:`graphmine_tpu.parallel`.
+- L2  sharded graph container: vertex-range-sharded message CSR + vertex
+      property arrays (replaces Spark DataFrames / GraphFrames).
+- L3  graph ops: label propagation (``Graphframes.py:81``), connected
+      components, degrees, community census (replaces the O(C*V*E) driver
+      loops of ``Graphframes.py:100-118``), induced subgraphs, kNN + LOF
+      outlier scoring (the intended capability of ``Graphframes.py:121-137``).
+- L4  pipeline driver with a plugin boundary (backend=jax|graphframes).
+      See :mod:`graphmine_tpu.pipeline`.
+"""
+
+__version__ = "0.1.0"
+
+from graphmine_tpu.graph.container import Graph, build_graph
+from graphmine_tpu.io.edges import load_parquet_edges, load_edge_list
+from graphmine_tpu.ops.lpa import label_propagation
+from graphmine_tpu.ops.cc import connected_components
+
+__all__ = [
+    "Graph",
+    "build_graph",
+    "load_parquet_edges",
+    "load_edge_list",
+    "label_propagation",
+    "connected_components",
+    "__version__",
+]

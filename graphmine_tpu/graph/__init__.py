@@ -1,0 +1,3 @@
+from graphmine_tpu.graph.container import Graph, build_graph
+
+__all__ = ["Graph", "build_graph"]

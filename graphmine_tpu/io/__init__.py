@@ -1,0 +1,4 @@
+from graphmine_tpu.io.edges import EdgeTable, load_parquet_edges, load_edge_list
+from graphmine_tpu.io.factorize import factorize
+
+__all__ = ["EdgeTable", "load_parquet_edges", "load_edge_list", "factorize"]

@@ -1,0 +1,5 @@
+from graphmine_tpu.ops.segment import segment_mode
+from graphmine_tpu.ops.lpa import label_propagation, lpa_superstep
+from graphmine_tpu.ops.cc import connected_components
+
+__all__ = ["segment_mode", "label_propagation", "lpa_superstep", "connected_components"]

@@ -1,0 +1,59 @@
+"""Connected components via iterated min-label propagation.
+
+The reference never calls ``connectedComponents`` but BASELINE.json names it
+as a required capability (GraphFrames exposes it on the object built at
+``Graphframes.py:78``). Semantics: *weakly* connected components of the
+directed edge list — messages flow both directions, every vertex ends with
+the smallest vertex id reachable from it.
+
+Two device-side accelerations over naive propagation:
+- each step takes ``min(own, neighbor mins)`` (monotone, so safe);
+- **pointer jumping** (``labels = labels[labels]``) after each propagation
+  halves the remaining depth, giving O(log V) convergence on long chains —
+  the classic PRAM trick, a good fit for XLA's static-shape while_loop.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from graphmine_tpu.graph.container import Graph
+
+
+def cc_superstep(labels: jax.Array, graph: Graph) -> jax.Array:
+    msg = labels[graph.msg_send]
+    neigh_min = jax.ops.segment_min(
+        msg, graph.msg_recv, num_segments=graph.num_vertices, indices_are_sorted=True
+    )
+    new = jnp.minimum(labels, neigh_min)
+    # Pointer jumping: follow the current representative one hop.
+    return jnp.minimum(new, new[new]).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("max_iter",))
+def connected_components(graph: Graph, max_iter: int = 0) -> jax.Array:
+    """Weakly-connected component labels ``[V]`` (smallest member vertex id).
+
+    Runs to fixpoint inside a ``lax.while_loop`` (bounded by ``max_iter``
+    when nonzero). Returns int32 labels; distinct count on the bundled data
+    must equal the measured golden of 34 WCCs (BASELINE.md).
+    """
+    limit = max_iter if max_iter > 0 else graph.num_vertices + 2
+
+    def cond(state):
+        labels, prev_changed, it = state
+        return (prev_changed > 0) & (it < limit)
+
+    def body(state):
+        labels, _, it = state
+        new = cc_superstep(labels, graph)
+        changed = jnp.sum(new != labels, dtype=jnp.int32)
+        return new, changed, it + 1
+
+    labels0 = jnp.arange(graph.num_vertices, dtype=jnp.int32)
+    labels, _, _ = lax.while_loop(cond, body, (labels0, jnp.int32(1), jnp.int32(0)))
+    return labels

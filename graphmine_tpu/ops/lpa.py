@@ -1,0 +1,90 @@
+"""Label propagation — the TPU-native core of the pipeline.
+
+Reproduces the semantics of ``GraphFrame.labelPropagation(maxIter=5)`` as
+invoked at ``Graphframes.py:81`` (GraphX Pregel LPA):
+
+- initial label of every vertex = its own id;
+- synchronous supersteps: each vertex adopts the **mode of its neighbors'
+  labels**, messages flowing along both directions of every directed edge,
+  duplicate edges counted with multiplicity (``Graphframes.py:70-74``);
+- exactly ``max_iter`` supersteps, no convergence test;
+- isolated vertices keep their label;
+- tie-break: deterministic smallest-label (GraphX's is implementation-
+  defined, so cross-engine validation compares partitions, not ids).
+
+The superstep is one gather + one segment-mode over the precomputed message
+CSR — no shuffle, no driver round-trips. Under jit the whole ``max_iter``
+loop is a single ``lax.scan`` XLA program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from graphmine_tpu.graph.container import Graph
+from graphmine_tpu.ops.segment import segment_mode
+
+
+def lpa_superstep(labels: jax.Array, graph: Graph) -> jax.Array:
+    """One synchronous LPA superstep: gather → segment-mode → select."""
+    msg = labels[graph.msg_send]
+    mode, _ = segment_mode(
+        graph.msg_recv, msg, num_segments=graph.num_vertices, indices_are_sorted=True
+    )
+    deg = graph.degrees()
+    return jnp.where(deg > 0, mode, labels).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("max_iter", "return_history"))
+def label_propagation(
+    graph: Graph,
+    max_iter: int = 5,
+    init_labels: jax.Array | None = None,
+    return_history: bool = False,
+):
+    """Run ``max_iter`` LPA supersteps; returns int32 labels ``[V]``.
+
+    With ``return_history=True`` also returns the per-iteration count of
+    vertices whose label changed (the structured observability signal the
+    reference lacked — SURVEY §5 metrics).
+    """
+    labels = (
+        jnp.arange(graph.num_vertices, dtype=jnp.int32)
+        if init_labels is None
+        else init_labels.astype(jnp.int32)
+    )
+
+    def step(labels, _):
+        new = lpa_superstep(labels, graph)
+        changed = jnp.sum(new != labels, dtype=jnp.int32)
+        return new, changed
+
+    labels, changed = lax.scan(step, labels, None, length=max_iter)
+    if return_history:
+        return labels, changed
+    return labels
+
+
+def num_communities(labels: jax.Array) -> jax.Array:
+    """Distinct-label count (the reference's headline print, ``Graphframes.py:85``)."""
+    v = labels.shape[0]
+    present = jnp.zeros((v,), jnp.int32).at[labels].set(1, mode="drop")
+    return present.sum()
+
+
+def canonicalize(labels: jax.Array) -> jax.Array:
+    """Relabel communities to dense ids ordered by first member vertex.
+
+    Makes partitions comparable across engines/tie-breaks (SURVEY §6:
+    validate partitions, not raw label values).
+    """
+    v = labels.shape[0]
+    first_member = jnp.full((v,), v, jnp.int32).at[labels].min(jnp.arange(v, dtype=jnp.int32))
+    rep = first_member[labels]  # representative = smallest vertex id in community
+    order = jnp.unique(rep, size=v, fill_value=v)
+    dense = jnp.searchsorted(order, rep)
+    return dense.astype(jnp.int32)

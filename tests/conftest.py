@@ -1,0 +1,39 @@
+"""Test harness: force an 8-device virtual CPU mesh *before* jax imports.
+
+The TPU analog of the reference's ``SparkContext("local[*]")``
+(``Graphframes.py:12``): run the real pjit/shard_map code paths on fake
+devices on one host (SURVEY §4, "multi-chip-without-a-cluster").
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+REFERENCE_PARQUET = "/root/reference/CommunityDetection/data/outlinks_pq"
+
+
+@pytest.fixture(scope="session")
+def bundled_edges():
+    from graphmine_tpu.io.edges import load_parquet_edges
+
+    if not os.path.isdir(REFERENCE_PARQUET):
+        pytest.skip("bundled reference parquet not available")
+    return load_parquet_edges(REFERENCE_PARQUET)
+
+
+@pytest.fixture(scope="session")
+def bundled_graph(bundled_edges):
+    from graphmine_tpu.graph.container import graph_from_edge_table
+
+    return graph_from_edge_table(bundled_edges)
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

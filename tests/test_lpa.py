@@ -1,0 +1,94 @@
+"""LPA semantics tests: hand-built graphs with unambiguous modes, invariants,
+and the bundled-data distinct-label trajectory (anchor ~927→650, BASELINE.md).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from graphmine_tpu.graph.container import build_graph, graph_from_edge_table
+from graphmine_tpu.ops.lpa import label_propagation, lpa_superstep, num_communities, canonicalize
+from graphmine_tpu.ops.segment import segment_mode
+
+
+def test_segment_mode_basic():
+    seg = jnp.array([0, 0, 0, 1, 1, 2], jnp.int32)
+    val = jnp.array([5, 7, 5, 3, 3, 9], jnp.int32)
+    mode, count = segment_mode(seg, val, num_segments=4)
+    assert mode.tolist()[:3] == [5, 3, 9]
+    assert count.tolist() == [2, 2, 1, 0]  # empty segment -> count 0
+
+
+def test_segment_mode_tie_breaks_smallest():
+    seg = jnp.array([0, 0, 0, 0], jnp.int32)
+    val = jnp.array([4, 2, 4, 2], jnp.int32)
+    mode, count = segment_mode(seg, val, num_segments=1)
+    assert mode.tolist() == [2] and count.tolist() == [2]
+
+
+def test_segment_mode_drops_out_of_range():
+    seg = jnp.array([0, 1, 2, 2], jnp.int32)  # 2 == num_segments: padding sentinel
+    val = jnp.array([7, 8, 9, 9], jnp.int32)
+    mode, count = segment_mode(seg, val, num_segments=2)
+    assert mode.tolist() == [7, 8] and count.tolist() == [1, 1]
+
+
+def test_two_triangles_bridge():
+    # Two triangles joined by one bridge edge: LPA must find 2 communities.
+    src = np.array([0, 1, 2, 3, 4, 5, 0])
+    dst = np.array([1, 2, 0, 4, 5, 3, 3])
+    g = build_graph(src, dst)
+    labels = label_propagation(g, max_iter=10)
+    labels = np.asarray(canonicalize(labels))
+    assert labels[0] == labels[1] == labels[2]
+    assert labels[3] == labels[4] == labels[5]
+
+
+def test_isolated_vertex_keeps_label():
+    g = build_graph([0, 1], [1, 0], num_vertices=3)
+    labels = np.asarray(label_propagation(g, max_iter=3))
+    assert labels[2] == 2
+
+
+def test_duplicate_edge_multiplicity_matters():
+    # v2 has neighbors {0 (x2 via duplicate edge), 1}. With multiplicity the
+    # mode is 0; without it would tie and pick the smaller anyway, so also
+    # test the reverse: duplicates on the larger label flip the outcome.
+    g = build_graph([0, 0, 1], [2, 2, 2], num_vertices=3)
+    l1 = np.asarray(lpa_superstep(jnp.arange(3, dtype=jnp.int32), g))
+    assert l1[2] == 0
+    g2 = build_graph([1, 1, 0], [2, 2, 2], num_vertices=3)
+    l2 = np.asarray(lpa_superstep(jnp.arange(3, dtype=jnp.int32), g2))
+    assert l2[2] == 1  # multiplicity beats the smaller-label tie-break
+
+
+def test_labels_drawn_from_initial_set():
+    rng = np.random.default_rng(1)
+    src = rng.integers(0, 50, 200)
+    dst = rng.integers(0, 50, 200)
+    g = build_graph(src, dst)
+    labels = np.asarray(label_propagation(g, max_iter=4))
+    assert set(labels.tolist()) <= set(range(50))
+
+
+def test_bundled_trajectory(bundled_graph):
+    labels, changed = label_propagation(bundled_graph, max_iter=5, return_history=True)
+    n = int(num_communities(labels))
+    # BASELINE.md anchor: 927 -> 765 -> 716 -> 682 -> 650 (tie-break dependent).
+    assert 550 <= n <= 750, n
+    assert int(changed[0]) > int(changed[-1])  # propagation settles
+
+
+def test_permutation_invariance_of_partition(bundled_edges):
+    # Relabeling vertices must permute the partition, not change its shape.
+    et = bundled_edges
+    rng = np.random.default_rng(7)
+    perm = rng.permutation(et.num_vertices).astype(np.int32)
+    g1 = graph_from_edge_table(et)
+    g2 = build_graph(perm[et.src], perm[et.dst], num_vertices=et.num_vertices)
+    l1 = np.asarray(label_propagation(g1, max_iter=3))
+    l2 = np.asarray(label_propagation(g2, max_iter=3))
+    sizes1 = np.sort(np.unique(l1, return_counts=True)[1])
+    sizes2 = np.sort(np.unique(l2, return_counts=True)[1])
+    # Tie-breaks depend on ids, so exact partition equality isn't guaranteed;
+    # the community-size histogram must be statistically stable.
+    assert abs(len(sizes1) - len(sizes2)) <= len(sizes1) // 10
