@@ -1,0 +1,15 @@
+from graphmine_tpu.parallel.mesh import make_mesh
+from graphmine_tpu.parallel.sharded import (
+    ShardedGraph,
+    partition_graph,
+    sharded_label_propagation,
+    sharded_connected_components,
+)
+
+__all__ = [
+    "make_mesh",
+    "ShardedGraph",
+    "partition_graph",
+    "sharded_label_propagation",
+    "sharded_connected_components",
+]

@@ -1,0 +1,36 @@
+"""Device-mesh runtime — the framework's "cluster manager".
+
+Replaces the reference's Spark runtime layer (``SparkContext("local[*]")``,
+``Graphframes.py:12``, plus the implicit shuffle/scheduler): parallelism is
+SPMD over a ``jax.sharding.Mesh``, and all cross-device traffic is XLA
+collectives riding ICI (within a slice) / DCN (across slices). There is no
+dynamic task scheduler to build — BSP supersteps map 1:1 onto jit programs.
+
+Axis convention: a 1-D mesh over axis ``"v"`` (vertex-range sharding). On
+multi-slice topologies pass a 2-D devices array and the graph axes compose
+(outer axis rides DCN, inner rides ICI).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+VERTEX_AXIS = "v"
+
+
+def make_mesh(num_devices: int | None = None, devices=None) -> Mesh:
+    """A 1-D mesh over the vertex axis.
+
+    ``local[*]`` parity: with no arguments, uses every visible device.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, only {len(devices)} visible"
+            )
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (VERTEX_AXIS,))

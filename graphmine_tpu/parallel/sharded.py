@@ -1,0 +1,252 @@
+"""Sharded graph container + distributed LPA / CC supersteps.
+
+The distributed design (SURVEY §2.3, §5): **1-D vertex-range sharding**.
+Device ``d`` owns the contiguous vertex chunk ``[d*Vc, (d+1)*Vc)`` and every
+message *received* by those vertices. Because the message CSR is sorted by
+receiving vertex, each device's messages are a contiguous slice, padded to
+the max shard size so shapes are static. One superstep is then:
+
+    gather from the replicated label vector (local HBM, no comms)
+      → shard-local segment-mode / segment-min over owned vertices
+      → ``all_gather`` of the updated chunks over the mesh axis (ICI)
+
+This is the TPU equivalent of a Pregel superstep's shuffle
+(``Graphframes.py:81``): per-iteration cross-device traffic is exactly one
+tiled all-gather of the V-length label vector — dense, contiguous and
+ICI-friendly — instead of a JVM hash shuffle. Power-law skew (SURVEY §7
+hard part 3) only affects padding, not correctness: chunks are padded to
+the largest shard's message count.
+
+Scale note: labels are replicated (int32 V-vector per device — ~400 MB at
+100M vertices), which is the right trade on TPU where HBM is 16-32 GB and
+the edge arrays dominate. The edge/message arrays — the actual O(E) term —
+are fully sharded.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from graphmine_tpu.graph.container import Graph, build_graph
+from graphmine_tpu.ops.segment import segment_mode
+from graphmine_tpu.parallel.mesh import VERTEX_AXIS
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class ShardedGraph:
+    """Vertex-range-sharded message CSR with static shapes.
+
+    Fields (D = mesh size, Vc = padded vertices per shard, Mp = padded
+    messages per shard):
+
+    msg_recv_local : int32 [D, Mp]  receiver minus chunk start; padding = Vc
+                     (out-of-range ⇒ dropped by segment reductions)
+    msg_send       : int32 [D, Mp]  global sender vertex id; padding = 0
+    degrees        : int32 [D, Vc]  per-owned-vertex message count (0 ⇒ keep)
+    num_vertices   : int            true V (static)
+    chunk_size     : int            Vc (static)
+    num_shards     : int            D (static)
+    """
+
+    msg_recv_local: jax.Array
+    msg_send: jax.Array
+    degrees: jax.Array
+    num_vertices: int = dataclasses.field(metadata=dict(static=True))
+    chunk_size: int = dataclasses.field(metadata=dict(static=True))
+    num_shards: int = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def padded_vertices(self) -> int:
+        return self.chunk_size * self.num_shards
+
+
+def partition_graph(
+    graph_or_src,
+    dst=None,
+    num_vertices: int | None = None,
+    num_shards: int | None = None,
+    mesh=None,
+    pad_multiple: int = 8,
+) -> ShardedGraph:
+    """Partition a graph's message CSR into vertex-range shards (host-side).
+
+    Accepts either a :class:`Graph` or raw ``(src, dst)`` arrays. The shard
+    count comes from ``num_shards`` or ``mesh``.
+    """
+    if mesh is not None and num_shards is None:
+        num_shards = mesh.size
+    if num_shards is None:
+        raise ValueError("pass num_shards or mesh")
+    if not isinstance(graph_or_src, Graph):
+        # One source of truth for message-CSR construction semantics.
+        graph_or_src = build_graph(graph_or_src, dst, num_vertices=num_vertices)
+    g = graph_or_src
+    recv = np.asarray(g.msg_recv)
+    send = np.asarray(g.msg_send)
+    num_vertices = g.num_vertices
+
+    d = num_shards
+    vc = -(-num_vertices // d)  # ceil
+    vc = -(-vc // pad_multiple) * pad_multiple
+    shard_of = recv // vc
+    counts = np.bincount(shard_of, minlength=d)
+    mp = max(int(counts.max(initial=0)), 1)
+    mp = -(-mp // pad_multiple) * pad_multiple
+
+    recv_local = np.full((d, mp), vc, dtype=np.int32)  # Vc = drop sentinel
+    send_pad = np.zeros((d, mp), dtype=np.int32)
+    offsets = np.zeros(d + 1, dtype=np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    for s in range(d):
+        lo, hi = offsets[s], offsets[s + 1]
+        n = hi - lo
+        recv_local[s, :n] = recv[lo:hi] - s * vc
+        send_pad[s, :n] = send[lo:hi]
+
+    deg = np.zeros((d, vc), dtype=np.int32)
+    deg_flat = np.bincount(recv, minlength=d * vc)[: d * vc]
+    # recv ids beyond num_vertices never occur; reshape covers padded tail
+    deg[:, :] = deg_flat.reshape(d, vc)
+
+    return ShardedGraph(
+        msg_recv_local=jnp.asarray(recv_local),
+        msg_send=jnp.asarray(send_pad),
+        degrees=jnp.asarray(deg),
+        num_vertices=num_vertices,
+        chunk_size=vc,
+        num_shards=d,
+    )
+
+
+def shard_graph_arrays(sg: ShardedGraph, mesh) -> ShardedGraph:
+    """Place the per-shard arrays on the mesh (leading dim over the vertex axis)."""
+    spec = NamedSharding(mesh, P(VERTEX_AXIS, None))
+    return ShardedGraph(
+        msg_recv_local=jax.device_put(sg.msg_recv_local, spec),
+        msg_send=jax.device_put(sg.msg_send, spec),
+        degrees=jax.device_put(sg.degrees, spec),
+        num_vertices=sg.num_vertices,
+        chunk_size=sg.chunk_size,
+        num_shards=sg.num_shards,
+    )
+
+
+def _shard_specs(mesh):
+    data_spec = P(VERTEX_AXIS, None)
+    rep = P()
+    in_specs = (rep, data_spec, data_spec, data_spec)
+    return in_specs, rep
+
+
+def _check_mesh(sg: ShardedGraph, mesh) -> None:
+    mesh_size = mesh.size
+    if mesh_size != sg.num_shards:
+        raise ValueError(
+            f"graph was partitioned into {sg.num_shards} shards but the mesh "
+            f"has {mesh_size} devices; re-run partition_graph(mesh=mesh)"
+        )
+
+
+def _lpa_shard_body(labels_full, recv_local, send, deg, *, chunk_size):
+    """Per-device LPA superstep body (runs under shard_map)."""
+    recv_local = recv_local[0]
+    send = send[0]
+    deg = deg[0]
+    msg = labels_full[send]
+    mode, _ = segment_mode(recv_local, msg, num_segments=chunk_size)
+    start = lax.axis_index(VERTEX_AXIS).astype(jnp.int32) * chunk_size
+    own = lax.dynamic_slice(labels_full, (start,), (chunk_size,))
+    new_own = jnp.where(deg > 0, mode, own).astype(jnp.int32)
+    return lax.all_gather(new_own, VERTEX_AXIS, tiled=True)
+
+
+def _cc_shard_body(labels_full, recv_local, send, deg, *, chunk_size):
+    recv_local = recv_local[0]
+    send = send[0]
+    deg = deg[0]
+    msg = labels_full[send]
+    neigh_min = jax.ops.segment_min(msg, recv_local, num_segments=chunk_size)
+    start = lax.axis_index(VERTEX_AXIS).astype(jnp.int32) * chunk_size
+    own = lax.dynamic_slice(labels_full, (start,), (chunk_size,))
+    new_own = jnp.where(deg > 0, jnp.minimum(own, neigh_min), own).astype(jnp.int32)
+    full = lax.all_gather(new_own, VERTEX_AXIS, tiled=True)
+    # Pointer jumping on the (replicated) full vector — no extra comms.
+    return jnp.minimum(full, full[full])
+
+
+def _padded_init_labels(sg: ShardedGraph) -> jax.Array:
+    v_pad = sg.padded_vertices
+    return jnp.arange(v_pad, dtype=jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("max_iter", "mesh"))
+def sharded_label_propagation(
+    sg: ShardedGraph, mesh, max_iter: int = 5, init_labels: jax.Array | None = None
+) -> jax.Array:
+    """Distributed synchronous LPA; semantics identical to
+    :func:`graphmine_tpu.ops.lpa.label_propagation` (asserted by the
+    virtual-device parity tests). Returns int32 labels ``[V]``.
+    """
+    _check_mesh(sg, mesh)
+    in_specs, rep = _shard_specs(mesh)
+    body = jax.shard_map(
+        partial(_lpa_shard_body, chunk_size=sg.chunk_size),
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=rep,
+        # The output is a tiled all_gather — replicated by construction,
+        # which the vma checker cannot infer statically.
+        check_vma=False,
+    )
+    labels = _padded_init_labels(sg) if init_labels is None else _pad_labels(init_labels, sg)
+
+    def step(labels, _):
+        return body(labels, sg.msg_recv_local, sg.msg_send, sg.degrees), None
+
+    labels, _ = lax.scan(step, labels, None, length=max_iter)
+    return labels[: sg.num_vertices]
+
+
+@partial(jax.jit, static_argnames=("max_iter", "mesh"))
+def sharded_connected_components(sg: ShardedGraph, mesh, max_iter: int = 0) -> jax.Array:
+    """Distributed weakly-connected components (min-propagation + pointer
+    jumping); parity with :func:`graphmine_tpu.ops.cc.connected_components`."""
+    _check_mesh(sg, mesh)
+    in_specs, rep = _shard_specs(mesh)
+    body = jax.shard_map(
+        partial(_cc_shard_body, chunk_size=sg.chunk_size),
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=rep,
+        check_vma=False,
+    )
+    limit = max_iter if max_iter > 0 else sg.num_vertices + 2
+
+    def cond(state):
+        _, changed, it = state
+        return (changed > 0) & (it < limit)
+
+    def loop_body(state):
+        labels, _, it = state
+        new = body(labels, sg.msg_recv_local, sg.msg_send, sg.degrees)
+        changed = jnp.sum(new != labels, dtype=jnp.int32)
+        return new, changed, it + 1
+
+    labels0 = _padded_init_labels(sg)
+    labels, _, _ = lax.while_loop(cond, loop_body, (labels0, jnp.int32(1), jnp.int32(0)))
+    return labels[: sg.num_vertices]
+
+
+def _pad_labels(labels: jax.Array, sg: ShardedGraph) -> jax.Array:
+    v_pad = sg.padded_vertices
+    pad = jnp.arange(sg.num_vertices, v_pad, dtype=jnp.int32)
+    return jnp.concatenate([labels.astype(jnp.int32), pad])

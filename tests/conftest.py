@@ -6,11 +6,49 @@ devices on one host (SURVEY §4, "multi-chip-without-a-cluster").
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# The session environment routes every Python process to the real TPU via a
+# sitecustomize hook (PALLAS_AXON_POOL_IPS -> axon backend registration at
+# interpreter start), which wins over any in-process JAX_PLATFORMS setting.
+# Tests need 8 virtual CPU devices, so pytest re-execs itself once with the
+# hook disabled (from pytest_configure, after restoring captured fds, so the
+# replacement process inherits the real stdout). Set GRAPHMINE_TEST_TPU=1 to
+# run tests on the real device instead.
+
+
+def _needs_reexec() -> bool:
+    return bool(
+        os.environ.get("PALLAS_AXON_POOL_IPS")
+        and os.environ.get("GRAPHMINE_TEST_TPU") != "1"
+        and os.environ.get("_GRAPHMINE_TEST_REEXEC") != "1"
+    )
+
+
+def _invoked_as_pytest_cli() -> bool:
+    # Only rebuild the command line from sys.argv when pytest owns it;
+    # under programmatic pytest.main() the argv belongs to the caller.
+    argv0 = os.path.basename(sys.argv[0])
+    return argv0 in ("pytest", "py.test") or argv0 == "__main__.py"
+
+
+def pytest_configure(config):
+    if not (_needs_reexec() and _invoked_as_pytest_cli()):
+        return
+    cap = config.pluginmanager.getplugin("capturemanager")
+    if cap is not None:
+        cap.stop_global_capturing()
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["_GRAPHMINE_TEST_REEXEC"] = "1"
+    os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], env)
+
+
+if os.environ.get("GRAPHMINE_TEST_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
