@@ -1,0 +1,83 @@
+"""Headline benchmark: LPA edges/sec/chip (BASELINE.json "metric").
+
+Runs synchronous label propagation on a synthetic power-law graph sized for
+one chip, times the compiled superstep loop, and prints ONE JSON line.
+
+Baseline derivation (the reference publishes no numbers — BASELINE.md):
+the north-star target is "LPA on a 100M-edge graph converges < 60 s on a
+TPU v4-8" (8 chips). Reading that conservatively as 5 supersteps (the
+reference's maxIter, Graphframes.py:81) in 60 s: 100e6 edges x 5 iters /
+(60 s x 8 chips) ≈ 1.04e6 edges/sec/chip. vs_baseline > 1 beats it.
+"""
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_EDGES_PER_SEC_PER_CHIP = 100e6 * 5 / (60.0 * 8)
+
+# Sized for a single chip: ~8.4M directed edges -> 16.8M messages.
+NUM_VERTICES = 1 << 20
+NUM_EDGES = 1 << 23
+ITERS = 10
+
+
+def powerlaw_edges(v: int, e: int, seed: int = 0):
+    """Preferential-attachment-flavored endpoints: degree skew comparable to
+    web graphs (the bundled data's hub pattern, BASELINE.md)."""
+    rng = np.random.default_rng(seed)
+    # Zipf-ish endpoint draw via inverse-CDF on a pareto tail, clipped.
+    raw = rng.pareto(1.2, size=2 * e)
+    ids = np.minimum((raw * v / 50).astype(np.int64), v - 1).astype(np.int32)
+    perm = rng.permutation(v).astype(np.int32)  # decorrelate id order
+    ids = perm[ids]
+    return ids[:e], ids[e:]
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from graphmine_tpu.graph.container import build_graph
+    from graphmine_tpu.ops.lpa import lpa_superstep
+
+    src, dst = powerlaw_edges(NUM_VERTICES, NUM_EDGES)
+    graph = build_graph(src, dst, num_vertices=NUM_VERTICES)
+
+    # Compile a single superstep once; the timed loop feeds labels back so
+    # every iteration computes on fresh data (steady-state throughput).
+    step = jax.jit(lpa_superstep)
+    labels = jnp.arange(NUM_VERTICES, dtype=jnp.int32)
+    labels = step(labels, graph)
+    labels.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        labels = step(labels, graph)
+    labels.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    chips = max(len(jax.devices()), 1)
+    eps_chip = NUM_EDGES * ITERS / dt / chips
+    print(
+        json.dumps(
+            {
+                "metric": "lpa_edges_per_sec_per_chip",
+                "value": round(eps_chip),
+                "unit": "edges/s/chip",
+                "vs_baseline": round(eps_chip / BASELINE_EDGES_PER_SEC_PER_CHIP, 3),
+                "detail": {
+                    "num_vertices": NUM_VERTICES,
+                    "num_edges": NUM_EDGES,
+                    "iters": ITERS,
+                    "seconds": round(dt, 3),
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

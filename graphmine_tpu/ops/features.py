@@ -1,0 +1,51 @@
+"""Per-vertex structural features for the kNN/LOF outlier scorer.
+
+The north-star upgrade over the reference's size-threshold heuristic
+(BASELINE.json: "kNN-graph + LOF outlier scorer"): each vertex gets a small
+dense feature vector derived from graph structure, and outliers are scored
+geometrically. All features are O(E) segment ops.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from graphmine_tpu.graph.container import Graph
+from graphmine_tpu.ops.census import community_sizes
+
+
+@partial(jax.jit, static_argnames=())
+def vertex_features(graph: Graph, communities: jax.Array) -> jax.Array:
+    """Feature matrix ``[V, 5]`` (float32):
+
+    log1p(out-degree), log1p(in-degree), log1p(message degree),
+    log1p(community size), log1p(mean neighbor degree).
+
+    Log-scaled to tame the power-law degree distribution (max degree 1,223
+    at 4.6K vertices on the bundled data — SURVEY §7 hard part 3).
+    """
+    v = graph.num_vertices
+    ones_e = jnp.ones_like(graph.src)
+    out_deg = jax.ops.segment_sum(ones_e, graph.src, num_segments=v)
+    in_deg = jax.ops.segment_sum(ones_e, graph.dst, num_segments=v)
+    msg_deg = graph.degrees()
+    comm_size = community_sizes(communities)[communities]
+    neigh_deg_sum = jax.ops.segment_sum(
+        msg_deg[graph.msg_send], graph.msg_recv, num_segments=v,
+        indices_are_sorted=True,
+    )
+    mean_neigh_deg = neigh_deg_sum / jnp.maximum(msg_deg, 1)
+    feats = jnp.stack(
+        [out_deg, in_deg, msg_deg, comm_size, mean_neigh_deg], axis=1
+    ).astype(jnp.float32)
+    return jnp.log1p(feats)
+
+
+def standardize(feats: jax.Array) -> jax.Array:
+    """Zero-mean unit-variance columns (guarding constant features)."""
+    mu = feats.mean(axis=0, keepdims=True)
+    sd = feats.std(axis=0, keepdims=True)
+    return (feats - mu) / jnp.maximum(sd, 1e-6)

@@ -1,0 +1,55 @@
+"""Brute-force kNN: tiled all-pairs distances + top-k.
+
+The reference has no kNN; BASELINE.json specifies it as the basis of the
+LOF scorer ("batched all-pairs distance + top-k Pallas kernel"). This
+module is the XLA reference implementation — row-tiled so the [N, N]
+distance matrix never materializes, MXU-friendly (the inner op is a
+[T, F] x [F, N] matmul). The Pallas fused kernel lives in
+:mod:`graphmine_tpu.pallas_kernels.knn_pallas`; this is the fallback and
+the oracle it is tested against.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@partial(jax.jit, static_argnames=("k", "row_tile"))
+def knn(points: jax.Array, k: int, row_tile: int = 1024):
+    """k nearest neighbors under squared Euclidean distance, self excluded.
+
+    Returns ``(dists, idx)`` with shapes ``[N, k]``, ascending by distance.
+    """
+    n, _ = points.shape
+    if k >= n:
+        raise ValueError(f"k={k} must be < number of points {n}")
+    sq = jnp.sum(points * points, axis=1)
+    n_pad = -(-n // row_tile) * row_tile
+    pad = n_pad - n
+    points_p = jnp.pad(points, ((0, pad), (0, 0)))
+    sq_p = jnp.pad(sq, (0, pad))
+    rows = points_p.reshape(n_pad // row_tile, row_tile, -1)
+    row_sq = sq_p.reshape(n_pad // row_tile, row_tile)
+    row_idx = jnp.arange(n_pad, dtype=jnp.int32).reshape(n_pad // row_tile, row_tile)
+
+    def tile_knn(args):
+        tile, tile_sq, tile_ids = args
+        # d2[i, j] = |x_i|^2 - 2 x_i . x_j + |x_j|^2  (the matmul is the MXU op)
+        cross = tile @ points.T
+        d2 = tile_sq[:, None] - 2.0 * cross + sq[None, :]
+        d2 = jnp.maximum(d2, 0.0)
+        # exclude self-matches
+        self_mask = tile_ids[:, None] == jnp.arange(n, dtype=jnp.int32)[None, :]
+        d2 = jnp.where(self_mask, jnp.inf, d2)
+        neg_top, idx = lax.top_k(-d2, k)
+        return -neg_top, idx
+
+    dists, idx = lax.map(tile_knn, (rows, row_sq, row_idx))
+    return (
+        dists.reshape(n_pad, k)[:n],
+        idx.reshape(n_pad, k)[:n],
+    )

@@ -1,0 +1,105 @@
+"""Outlier detection, parity path: recursive LPA + bottom-decile threshold.
+
+This is the capability the reference *intended* but left as dead code
+(``Graphframes.py:121-137``): for every community, re-run label propagation
+on its induced subgraph, then flag sub-communities in the bottom decile by
+size as outliers.
+
+TPU-native design: instead of a host loop building a GraphFrame per
+community (the dead spec), one **masked global LPA** computes every
+community's recursive LPA simultaneously — cross-community messages are
+retargeted to a drop sentinel, so propagation happens strictly inside each
+community's induced subgraph. O(E) per superstep, zero host loops, no
+dynamic shapes.
+
+The decile rule follows the dead spec (``Graphframes.py:135-136``):
+sub-communities sorted by size descending, threshold element at index
+``-len//10``; communities with fewer than 10 sub-communities produce no
+outliers (the reference's ``-int(len/10)`` would index element 0 there —
+a bug we do not copy).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from graphmine_tpu.graph.container import Graph
+from graphmine_tpu.ops.segment import segment_mode
+
+
+@partial(jax.jit, static_argnames=("max_iter",))
+def masked_label_propagation(
+    graph: Graph, communities: jax.Array, max_iter: int = 5
+) -> jax.Array:
+    """LPA restricted to intra-community edges, for all communities at once.
+
+    Equivalent to running ``labelPropagation(maxIter)`` independently on
+    every community's induced subgraph (the dead spec at
+    ``Graphframes.py:122-126``), because labels can only flow along
+    messages whose endpoints share a community.
+    """
+    v = graph.num_vertices
+    keep = communities[graph.msg_send] == communities[graph.msg_recv]
+    recv = jnp.where(keep, graph.msg_recv, v)  # v = drop sentinel
+    deg = jax.ops.segment_sum(keep.astype(jnp.int32), graph.msg_recv, num_segments=v)
+    labels0 = jnp.arange(v, dtype=jnp.int32)
+
+    def step(labels, _):
+        msg = labels[graph.msg_send]
+        mode, _ = segment_mode(recv, msg, num_segments=v)
+        return jnp.where(deg > 0, mode, labels).astype(jnp.int32), None
+
+    labels, _ = lax.scan(step, labels0, None, length=max_iter)
+    return labels
+
+
+@dataclass(frozen=True)
+class OutlierReport:
+    """Result of the recursive-LPA outlier pass (host-side arrays)."""
+
+    sub_labels: np.ndarray        # int32 [V] sub-community of each vertex
+    outlier_vertices: np.ndarray  # bool [V] vertex is in an outlier sub-community
+    sub_sizes: np.ndarray         # int32 [S] size of each distinct sub-community
+    sub_parents: np.ndarray       # int32 [S] parent community of each sub-community
+    thresholds: dict              # parent community -> bottom-decile size threshold
+
+
+def recursive_lpa_outliers(
+    graph: Graph, communities: jax.Array, max_iter: int = 5, decile: float = 0.1
+) -> OutlierReport:
+    """Parity outlier detector (dead spec, ``Graphframes.py:121-137``).
+
+    Device side: one masked LPA over the whole graph. Host side: the
+    per-parent decile thresholds over the (tiny) sub-community size table.
+    """
+    sub = np.asarray(masked_label_propagation(graph, communities, max_iter=max_iter))
+    comm = np.asarray(communities)
+    sub_ids, inverse, sizes = np.unique(sub, return_inverse=True, return_counts=True)
+    parents = comm[sub_ids]  # sub-community label = a member vertex id
+
+    outlier_sub = np.zeros(len(sub_ids), dtype=bool)
+    thresholds: dict[int, int] = {}
+    for parent in np.unique(parents):
+        in_parent = parents == parent
+        n = int(in_parent.sum())
+        cut = int(n * decile)
+        if cut == 0:
+            continue  # fewer than 1/decile sub-communities: no decile defined
+        order = np.sort(sizes[in_parent])[::-1]  # most_common() order (:135)
+        threshold = int(order[-cut])
+        thresholds[int(parent)] = threshold
+        outlier_sub |= in_parent & (sizes <= threshold)
+
+    return OutlierReport(
+        sub_labels=sub.astype(np.int32),
+        outlier_vertices=outlier_sub[inverse],
+        sub_sizes=sizes.astype(np.int32),
+        sub_parents=parents.astype(np.int32),
+        thresholds=thresholds,
+    )

@@ -2,6 +2,7 @@ from graphmine_tpu.parallel.mesh import make_mesh
 from graphmine_tpu.parallel.sharded import (
     ShardedGraph,
     partition_graph,
+    shard_graph_arrays,
     sharded_label_propagation,
     sharded_connected_components,
 )
@@ -10,6 +11,7 @@ __all__ = [
     "make_mesh",
     "ShardedGraph",
     "partition_graph",
+    "shard_graph_arrays",
     "sharded_label_propagation",
     "sharded_connected_components",
 ]

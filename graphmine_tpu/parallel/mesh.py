@@ -6,9 +6,10 @@ SPMD over a ``jax.sharding.Mesh``, and all cross-device traffic is XLA
 collectives riding ICI (within a slice) / DCN (across slices). There is no
 dynamic task scheduler to build — BSP supersteps map 1:1 onto jit programs.
 
-Axis convention: a 1-D mesh over axis ``"v"`` (vertex-range sharding). On
-multi-slice topologies pass a 2-D devices array and the graph axes compose
-(outer axis rides DCN, inner rides ICI).
+Axis convention: a 1-D mesh over axis ``"v"`` (vertex-range sharding).
+Multi-slice (DCN-spanning) meshes are a planned extension: the vertex axis
+would factor into (slice, chip) so boundary exchange rides ICI within a
+slice and only the reduced label vector crosses DCN.
 """
 
 from __future__ import annotations

@@ -1,0 +1,4 @@
+from graphmine_tpu.pipeline.config import PipelineConfig
+from graphmine_tpu.pipeline.driver import run_pipeline
+
+__all__ = ["PipelineConfig", "run_pipeline"]

@@ -1,0 +1,3 @@
+from graphmine_tpu.pipeline.driver import main
+
+main()

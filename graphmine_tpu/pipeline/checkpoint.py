@@ -1,0 +1,33 @@
+"""Checkpoint / resume for long label-propagation runs.
+
+The reference's closest artifact is ``persist()`` (``Graphframes.py:82``) —
+in-memory caching only. Here the label state + iteration counter are saved
+so billion-edge LPA runs can resume (SURVEY §5 checkpoint/resume). The
+state is one int32 array + a counter; np.savez is the efficient, dependency-
+free representation (orbax would add sharded async saves for multi-host —
+noted as the upgrade path).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def save_labels(checkpoint_dir: str, labels, iteration: int, tag: str = "lpa") -> str:
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    path = os.path.join(checkpoint_dir, f"{tag}_labels.npz")
+    tmp = path + ".tmp.npz"  # .npz suffix keeps np.savez from renaming
+    np.savez(tmp, labels=np.asarray(labels), iteration=np.int64(iteration))
+    os.replace(tmp, path)
+    return path
+
+
+def load_labels(checkpoint_dir: str, tag: str = "lpa"):
+    """Returns (labels, iteration) or None when no checkpoint exists."""
+    path = os.path.join(checkpoint_dir, f"{tag}_labels.npz")
+    if not os.path.exists(path):
+        return None
+    with np.load(path) as z:
+        return z["labels"], int(z["iteration"])

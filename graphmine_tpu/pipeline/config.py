@@ -1,0 +1,72 @@
+"""Pipeline configuration — one dataclass + CLI.
+
+Replaces the reference's scattered hardcoded constants (SURVEY §5 config):
+the GraphFrames package pin env var (``Graphframes.py:3``), ``local[*]``
+(``:12``), the data glob (``:16``), ``maxIter=5`` (``:81``, ``:126``),
+``show(10)``, and the bottom-decile outlier threshold (``:136``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass
+
+
+@dataclass
+class PipelineConfig:
+    # data
+    data_path: str = "/root/reference/CommunityDetection/data/outlinks_pq"
+    data_format: str = "parquet"  # parquet | edgelist
+    # engine (the plugin boundary from BASELINE.json)
+    backend: str = "jax"  # jax | graphframes
+    num_devices: int | None = None  # None = all visible (local[*] parity, :12)
+    # community detection
+    max_iter: int = 5  # Graphframes.py:81
+    # outlier detection
+    outlier_method: str = "both"  # recursive_lpa | lof | both | none
+    sub_max_iter: int = 5  # Graphframes.py:126
+    decile: float = 0.1  # Graphframes.py:136
+    lof_k: int = 20
+    # observability
+    show: int = 10  # .show(10) parity
+    profile_dir: str | None = None  # jax.profiler trace output
+    # checkpoint / resume
+    checkpoint_dir: str | None = None
+    resume: bool = False
+
+    def validate(self) -> "PipelineConfig":
+        if self.data_format not in ("parquet", "edgelist"):
+            raise ValueError(f"unknown data_format {self.data_format!r}")
+        if self.backend not in ("jax", "graphframes"):
+            raise ValueError(f"unknown backend {self.backend!r}")
+        if self.outlier_method not in ("recursive_lpa", "lof", "both", "none"):
+            raise ValueError(f"unknown outlier_method {self.outlier_method!r}")
+        if self.max_iter < 0 or self.sub_max_iter < 0:
+            raise ValueError("max_iter must be >= 0")
+        if not 0 < self.decile < 1:
+            raise ValueError("decile must be in (0, 1)")
+        return self
+
+
+def parse_args(argv=None) -> PipelineConfig:
+    parser = argparse.ArgumentParser(
+        prog="graphmine_tpu.pipeline",
+        description="TPU-native community + outlier detection pipeline",
+    )
+    for f in dataclasses.fields(PipelineConfig):
+        name = "--" + f.name.replace("_", "-")
+        default = f.default
+        if f.type in ("bool", bool):
+            parser.add_argument(name, action="store_true", default=default)
+        else:
+            typ = str
+            if f.type in ("int", int):
+                typ = int
+            elif f.type in ("float", float):
+                typ = float
+            elif f.type in ("int | None",):
+                typ = int
+            parser.add_argument(name, type=typ, default=default)
+    ns = parser.parse_args(argv)
+    return PipelineConfig(**vars(ns)).validate()

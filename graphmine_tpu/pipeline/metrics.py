@@ -1,0 +1,65 @@
+"""Structured metrics + profiling hooks.
+
+The reference's only observability was ``print``/``show`` calls
+(``Graphframes.py:18,32,54,68,74,82,85,120``). Here every pipeline phase
+emits a structured JSON record, and LPA reports the driver's headline
+metric — **edges/sec/chip** per iteration (BASELINE.json ``"metric"``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import time
+from dataclasses import dataclass, field
+
+log = logging.getLogger("graphmine_tpu")
+
+
+@dataclass
+class MetricsSink:
+    """Collects phase timings and counters; emits JSON lines via logging."""
+
+    records: list = field(default_factory=list)
+
+    def emit(self, phase: str, **kv) -> dict:
+        rec = {"phase": phase, "t": time.time(), **kv}
+        self.records.append(rec)
+        log.info("%s", json.dumps(rec, default=str))
+        return rec
+
+    @contextlib.contextmanager
+    def timed(self, phase: str, **kv):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.emit(phase, seconds=round(time.perf_counter() - t0, 4), **kv)
+
+    def lpa_iteration(self, it: int, changed: int, num_edges: int, seconds: float, chips: int):
+        """Per-superstep record with the headline edges/sec/chip metric."""
+        eps = num_edges / seconds if seconds > 0 else float("inf")
+        return self.emit(
+            "lpa_iter",
+            iteration=it,
+            labels_changed=changed,
+            seconds=round(seconds, 5),
+            edges_per_sec=round(eps),
+            edges_per_sec_per_chip=round(eps / max(chips, 1)),
+        )
+
+
+@contextlib.contextmanager
+def maybe_profile(profile_dir: str | None):
+    """jax.profiler trace around a pipeline phase (SURVEY §5 tracing)."""
+    if not profile_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(profile_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

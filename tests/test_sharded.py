@@ -9,7 +9,7 @@ import jax
 import numpy as np
 import pytest
 
-from graphmine_tpu.graph.container import build_graph, graph_from_edge_table
+from graphmine_tpu.graph.container import build_graph
 from graphmine_tpu.ops.cc import connected_components
 from graphmine_tpu.ops.lpa import label_propagation
 from graphmine_tpu.parallel import make_mesh
@@ -52,7 +52,7 @@ def test_sharded_cc_matches_single_device(mesh8, rng):
         np.testing.assert_array_equal(got, want)
 
 
-def test_sharded_bundled_parity(mesh8, bundled_edges, bundled_graph):
+def test_sharded_bundled_parity(mesh8, bundled_graph):
     want = np.asarray(label_propagation(bundled_graph, max_iter=5))
     sg = shard_graph_arrays(partition_graph(bundled_graph, mesh=mesh8), mesh8)
     got = np.asarray(sharded_label_propagation(sg, mesh8, max_iter=5))
