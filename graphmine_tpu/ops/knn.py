@@ -18,12 +18,32 @@ import jax.numpy as jnp
 from jax import lax
 
 
-@partial(jax.jit, static_argnames=("k", "row_tile"))
-def knn(points: jax.Array, k: int, row_tile: int = 1024):
+def knn(points: jax.Array, k: int, row_tile: int = 1024, impl: str = "auto"):
     """k nearest neighbors under squared Euclidean distance, self excluded.
 
     Returns ``(dists, idx)`` with shapes ``[N, k]``, ascending by distance.
+
+    ``impl``: ``"auto"`` uses the fused Pallas kernel on TPU backends (and
+    this XLA path elsewhere); ``"xla"`` / ``"pallas"`` force a path.
     """
+    if impl == "auto":
+        impl = "pallas" if _on_tpu() and k <= 128 else "xla"
+    if impl == "pallas":
+        from graphmine_tpu.pallas_kernels.knn_pallas import knn_pallas
+
+        return knn_pallas(points, k)
+    return _knn_xla(points, k, row_tile)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False
+
+
+@partial(jax.jit, static_argnames=("k", "row_tile"))
+def _knn_xla(points: jax.Array, k: int, row_tile: int = 1024):
     n, _ = points.shape
     if k >= n:
         raise ValueError(f"k={k} must be < number of points {n}")

@@ -22,8 +22,10 @@ import jax.numpy as jnp
 from graphmine_tpu.ops.knn import knn
 
 
-@partial(jax.jit, static_argnames=("k", "row_tile"))
-def lof_scores(points: jax.Array, k: int = 20, row_tile: int = 1024) -> jax.Array:
+@partial(jax.jit, static_argnames=("k", "row_tile", "impl"))
+def lof_scores(
+    points: jax.Array, k: int = 20, row_tile: int = 1024, impl: str = "auto"
+) -> jax.Array:
     """LOF score per point, shape ``[N]`` (higher = more outlying).
 
     Discrete graph features produce many *identical* rows; classic LOF
@@ -33,7 +35,7 @@ def lof_scores(points: jax.Array, k: int = 20, row_tile: int = 1024) -> jax.Arra
     bounds scores at a meaningful scale and is a no-op on duplicate-free
     data (the sklearn parity test).
     """
-    d2, idx = knn(points, k=k, row_tile=row_tile)
+    d2, idx = knn(points, k=k, row_tile=row_tile, impl=impl)
     dists = jnp.sqrt(d2)
     pos = dists > 0
     eps = 1e-3 * dists.sum() / jnp.maximum(pos.sum(), 1)

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels for the hot ops (SURVEY §7.5: "batched all-pairs
+distance + top-k Pallas kernel").
+
+Each kernel has an XLA reference implementation elsewhere in the package
+(its oracle in tests) and is auto-dispatched on TPU backends.
+"""
+
+from graphmine_tpu.pallas_kernels.knn_pallas import knn_pallas  # noqa: F401

@@ -36,12 +36,15 @@ def lpa_graphframes(edge_table, max_iter: int) -> np.ndarray:
     from pyspark.sql import SparkSession
 
     spark = SparkSession.builder.appName("CommunityDetection").getOrCreate()
-    v_rows = [(int(i), str(n)) for i, n in enumerate(edge_table.names)]
-    e_rows = [(int(s), int(d)) for s, d in zip(edge_table.src, edge_table.dst)]
-    vertices = spark.createDataFrame(v_rows, ["id", "name"])
-    edges = spark.createDataFrame(e_rows, ["src", "dst"])
-    result = GraphFrame(vertices, edges).labelPropagation(maxIter=max_iter)
-    rows = result.select("id", "label").collect()
+    try:
+        v_rows = [(int(i), str(n)) for i, n in enumerate(edge_table.names)]
+        e_rows = [(int(s), int(d)) for s, d in zip(edge_table.src, edge_table.dst)]
+        vertices = spark.createDataFrame(v_rows, ["id", "name"])
+        edges = spark.createDataFrame(e_rows, ["src", "dst"])
+        result = GraphFrame(vertices, edges).labelPropagation(maxIter=max_iter)
+        rows = result.select("id", "label").collect()
+    finally:
+        spark.stop()
     labels = np.zeros(edge_table.num_vertices, dtype=np.int64)
     for r in rows:
         labels[r["id"]] = r["label"]

@@ -145,6 +145,12 @@ def _run_lpa(config: PipelineConfig, table: EdgeTable, graph: Graph, m: MetricsS
         loaded = ckpt.load_labels(config.checkpoint_dir)
         if loaded is not None:
             saved_labels, start_iter = loaded
+            if start_iter > config.max_iter:
+                raise ValueError(
+                    f"checkpoint at iteration {start_iter} exceeds "
+                    f"max_iter={config.max_iter}; delete the checkpoint or "
+                    f"raise max_iter"
+                )
             labels = jnp.asarray(saved_labels, dtype=jnp.int32)
             m.emit("resume", iteration=start_iter)
 
@@ -169,7 +175,7 @@ def _run_lpa(config: PipelineConfig, table: EdgeTable, graph: Graph, m: MetricsS
             new = one_iter(labels)
             new.block_until_ready()
             dt = time.perf_counter() - t0
-            changed = int((new != labels[: new.shape[0]]).sum())
+            changed = int((new != labels).sum())
             labels = new
             m.lpa_iteration(it + 1, changed, graph.num_edges, dt, chips)
             if config.checkpoint_dir:

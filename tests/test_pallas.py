@@ -1,0 +1,57 @@
+"""Pallas kernel tests — interpreter mode on the virtual-CPU harness.
+
+The XLA implementations are the oracles (SURVEY §4: algorithm-semantics
+tests against independent references). Inputs are constructed tie-free so
+index agreement is exact.
+"""
+
+import numpy as np
+import pytest
+
+from graphmine_tpu.ops.knn import _knn_xla
+from graphmine_tpu.pallas_kernels.knn_pallas import knn_pallas
+
+
+def _tie_free_points(n, f, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(n, f)).astype(np.float32)
+
+
+@pytest.mark.parametrize("n,f,k", [(200, 8, 5), (513, 3, 20), (1024, 40, 32)])
+def test_knn_pallas_matches_xla(n, f, k):
+    pts = _tie_free_points(n, f)
+    d_ref, i_ref = _knn_xla(pts, k=k, row_tile=256)
+    d_pal, i_pal = knn_pallas(pts, k=k, row_tile=128, col_tile=256, interpret=True)
+    np.testing.assert_array_equal(np.asarray(i_pal), np.asarray(i_ref))
+    np.testing.assert_allclose(np.asarray(d_pal), np.asarray(d_ref), rtol=1e-4, atol=1e-5)
+
+
+def test_knn_pallas_ascending_and_self_excluded():
+    pts = _tie_free_points(300, 6, seed=3)
+    d, i = knn_pallas(pts, k=10, row_tile=128, col_tile=128, interpret=True)
+    d = np.asarray(d)
+    i = np.asarray(i)
+    assert (np.diff(d, axis=1) >= 0).all()
+    assert (i != np.arange(300)[:, None]).all()
+    assert ((i >= 0) & (i < 300)).all()
+
+
+def test_knn_pallas_padding_rows_masked():
+    # n deliberately far from the tile grid: padded rows/cols must not leak.
+    pts = _tie_free_points(130, 4, seed=1)
+    d_ref, i_ref = _knn_xla(pts, k=3)
+    d_pal, i_pal = knn_pallas(pts, k=3, row_tile=128, col_tile=128, interpret=True)
+    np.testing.assert_array_equal(np.asarray(i_pal), np.asarray(i_ref))
+
+
+def test_lof_pallas_impl_matches_xla():
+    from graphmine_tpu.ops.lof import lof_scores
+
+    pts = _tie_free_points(400, 5, seed=2)
+    # interpret-mode pallas isn't reachable through the public impl flag on
+    # CPU, so compare the two knn paths feeding identical LOF math instead.
+    d_x, i_x = _knn_xla(pts, k=15)
+    d_p, i_p = knn_pallas(pts, k=15, interpret=True)
+    np.testing.assert_array_equal(np.asarray(i_p), np.asarray(i_x))
+    s = np.asarray(lof_scores(pts, k=15, impl="xla"))
+    assert s.shape == (400,) and np.isfinite(s).all()

@@ -8,6 +8,15 @@ from graphmine_tpu.pipeline.config import PipelineConfig, parse_args
 from graphmine_tpu.pipeline.driver import run_pipeline
 from graphmine_tpu.pipeline import checkpoint as ckpt
 
+import os
+
+from conftest import REFERENCE_PARQUET
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REFERENCE_PARQUET),
+    reason="bundled reference parquet not available",
+)
+
 
 def test_full_pipeline_bundled(tmp_path):
     cfg = PipelineConfig(
