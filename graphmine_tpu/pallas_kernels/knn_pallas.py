@@ -29,6 +29,7 @@ in interpreter mode (CPU) and the dispatcher picks this kernel on TPU.
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -113,8 +114,10 @@ def knn_pallas(points: jax.Array, k: int, row_tile: int = 128,
 
     # Pad rows to the tile grid and features to the 128-lane layout; padding
     # rows/columns are masked inside the kernel, zero-padded features are
-    # distance-neutral.
-    n_pad = -(-n // max(row_tile, col_tile)) * max(row_tile, col_tile)
+    # distance-neutral. n must pad to a common multiple of both tile sizes —
+    # the grid divides by each independently.
+    tile_lcm = math.lcm(row_tile, col_tile)
+    n_pad = -(-n // tile_lcm) * tile_lcm
     f_pad = max(-(-f // 128) * 128, 128)
     pts = jnp.pad(points.astype(jnp.float32), ((0, n_pad - n), (0, f_pad - f)))
 

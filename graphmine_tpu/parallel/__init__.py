@@ -1,4 +1,8 @@
 from graphmine_tpu.parallel.mesh import make_mesh
+from graphmine_tpu.parallel.ring import (
+    ring_connected_components,
+    ring_label_propagation,
+)
 from graphmine_tpu.parallel.sharded import (
     ShardedGraph,
     partition_graph,
@@ -14,4 +18,6 @@ __all__ = [
     "shard_graph_arrays",
     "sharded_label_propagation",
     "sharded_connected_components",
+    "ring_label_propagation",
+    "ring_connected_components",
 ]

@@ -188,6 +188,37 @@ def _padded_init_labels(sg: ShardedGraph) -> jax.Array:
     return jnp.arange(v_pad, dtype=jnp.int32)
 
 
+def _scan_supersteps(step_fn, labels: jax.Array, max_iter: int) -> jax.Array:
+    """Fixed-count superstep driver (LPA semantics: exactly max_iter)."""
+
+    def step(labels, _):
+        return step_fn(labels), None
+
+    labels, _ = lax.scan(step, labels, None, length=max_iter)
+    return labels
+
+
+def _fixpoint_supersteps(step_fn, sg: ShardedGraph, max_iter: int) -> jax.Array:
+    """Run supersteps until no label changes (CC semantics), bounded by
+    ``max_iter`` when nonzero. Shared by the replicated-label and ring
+    schedules so the convergence logic has one home."""
+    limit = max_iter if max_iter > 0 else sg.num_vertices + 2
+
+    def cond(state):
+        _, changed, it = state
+        return (changed > 0) & (it < limit)
+
+    def loop_body(state):
+        labels, _, it = state
+        new = step_fn(labels)
+        changed = jnp.sum(new != labels, dtype=jnp.int32)
+        return new, changed, it + 1
+
+    labels0 = _padded_init_labels(sg)
+    labels, _, _ = lax.while_loop(cond, loop_body, (labels0, jnp.int32(1), jnp.int32(0)))
+    return labels[: sg.num_vertices]
+
+
 @partial(jax.jit, static_argnames=("max_iter", "mesh"))
 def sharded_label_propagation(
     sg: ShardedGraph, mesh, max_iter: int = 5, init_labels: jax.Array | None = None
@@ -208,11 +239,9 @@ def sharded_label_propagation(
         check_vma=False,
     )
     labels = _padded_init_labels(sg) if init_labels is None else _pad_labels(init_labels, sg)
-
-    def step(labels, _):
-        return body(labels, sg.msg_recv_local, sg.msg_send, sg.degrees), None
-
-    labels, _ = lax.scan(step, labels, None, length=max_iter)
+    labels = _scan_supersteps(
+        lambda l: body(l, sg.msg_recv_local, sg.msg_send, sg.degrees), labels, max_iter
+    )
     return labels[: sg.num_vertices]
 
 
@@ -229,21 +258,9 @@ def sharded_connected_components(sg: ShardedGraph, mesh, max_iter: int = 0) -> j
         out_specs=rep,
         check_vma=False,
     )
-    limit = max_iter if max_iter > 0 else sg.num_vertices + 2
-
-    def cond(state):
-        _, changed, it = state
-        return (changed > 0) & (it < limit)
-
-    def loop_body(state):
-        labels, _, it = state
-        new = body(labels, sg.msg_recv_local, sg.msg_send, sg.degrees)
-        changed = jnp.sum(new != labels, dtype=jnp.int32)
-        return new, changed, it + 1
-
-    labels0 = _padded_init_labels(sg)
-    labels, _, _ = lax.while_loop(cond, loop_body, (labels0, jnp.int32(1), jnp.int32(0)))
-    return labels[: sg.num_vertices]
+    return _fixpoint_supersteps(
+        lambda l: body(l, sg.msg_recv_local, sg.msg_send, sg.degrees), sg, max_iter
+    )
 
 
 def _pad_labels(labels: jax.Array, sg: ShardedGraph) -> jax.Array:

@@ -12,12 +12,13 @@ import os
 
 from conftest import REFERENCE_PARQUET
 
-pytestmark = pytest.mark.skipif(
+needs_data = pytest.mark.skipif(
     not os.path.isdir(REFERENCE_PARQUET),
     reason="bundled reference parquet not available",
 )
 
 
+@needs_data
 def test_full_pipeline_bundled(tmp_path):
     cfg = PipelineConfig(
         outlier_method="both",
@@ -35,6 +36,7 @@ def test_full_pipeline_bundled(tmp_path):
     assert all(r["edges_per_sec_per_chip"] > 0 for r in iters)
 
 
+@needs_data
 def test_resume_from_checkpoint(tmp_path):
     ckdir = str(tmp_path / "ck")
     cfg = PipelineConfig(max_iter=3, outlier_method="none", checkpoint_dir=ckdir)
@@ -53,6 +55,7 @@ def test_resume_from_checkpoint(tmp_path):
     np.testing.assert_array_equal(res2.labels, res_full.labels)
 
 
+@needs_data
 def test_multi_device_pipeline():
     import jax
 
