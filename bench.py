@@ -11,6 +11,7 @@ reference's maxIter, Graphframes.py:81) in 60 s: 100e6 edges x 5 iters /
 """
 
 import json
+import os
 import time
 
 import numpy as np
@@ -39,6 +40,12 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    # Persistent compile cache: the superstep program at this size is
+    # expensive to compile on TPU; repeat bench runs should pay it once.
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     from graphmine_tpu.graph.container import build_graph
     from graphmine_tpu.ops.lpa import lpa_superstep
 
@@ -50,12 +57,18 @@ def main() -> None:
     step = jax.jit(lpa_superstep)
     labels = jnp.arange(NUM_VERTICES, dtype=jnp.int32)
     labels = step(labels, graph)
-    labels.block_until_ready()
+    np.asarray(labels[:8])
 
+    # Completion signal: a tiny device->host fetch of a slice that depends
+    # on the final labels. On the tunneled axon TPU backend,
+    # block_until_ready() was observed returning before the computation
+    # finished (33us/iter for a 16M-element sort loop — physically
+    # impossible); a data fetch cannot be early. The 32-byte transfer adds
+    # negligible time to the window.
     t0 = time.perf_counter()
     for _ in range(ITERS):
         labels = step(labels, graph)
-    labels.block_until_ready()
+    np.asarray(labels[:8])
     dt = time.perf_counter() - t0
 
     # The timed loop is a plain jit on one device; normalizing by the full

@@ -26,6 +26,8 @@ from graphmine_tpu.graph.container import Graph, build_graph
 from graphmine_tpu.io.edges import load_parquet_edges, load_edge_list
 from graphmine_tpu.ops.lpa import label_propagation
 from graphmine_tpu.ops.cc import connected_components
+from graphmine_tpu.ops.louvain import louvain
+from graphmine_tpu.ops.modularity import modularity
 
 __all__ = [
     "Graph",
@@ -34,5 +36,7 @@ __all__ = [
     "load_edge_list",
     "label_propagation",
     "connected_components",
+    "louvain",
+    "modularity",
     "__version__",
 ]

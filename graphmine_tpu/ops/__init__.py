@@ -1,5 +1,7 @@
 from graphmine_tpu.ops.segment import segment_mode
 from graphmine_tpu.ops.lpa import label_propagation, lpa_superstep
 from graphmine_tpu.ops.cc import connected_components
+from graphmine_tpu.ops.louvain import louvain
+from graphmine_tpu.ops.modularity import modularity
 
-__all__ = ["segment_mode", "label_propagation", "lpa_superstep", "connected_components"]
+__all__ = ["segment_mode", "label_propagation", "lpa_superstep", "connected_components", "louvain", "modularity"]

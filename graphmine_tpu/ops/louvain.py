@@ -1,0 +1,225 @@
+"""Louvain community detection, TPU-native.
+
+SURVEY §7.7 names Louvain-modularity comparison as the scale-up capability
+beyond the reference's LPA (``Graphframes.py:81``). Classic Louvain is
+sequential (one vertex moves at a time); the TPU design replaces the inner
+phase with **synchronous parallel local moves** — every vertex evaluates
+the modularity gain of joining each neighboring community and the best
+movers switch together — the standard parallel-Louvain formulation,
+expressed as sort/segment kernels:
+
+  inner sweep (device, jit):  sort (vertex, neighbor-community) message
+      pairs → per-run weight totals → per-vertex argmax of the gain score
+      → masked synchronous move (alternating vertex parity breaks the
+      two-vertex swap oscillation of synchronous moves)
+  level contraction (host):   communities become super-vertices; edge
+      weights aggregate; self-loops accumulate internal weight
+
+Levels repeat until modularity stops improving. All device arrays are
+padded to powers of two so compiled programs are reused across levels.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from graphmine_tpu.graph.container import Graph
+from graphmine_tpu.ops.modularity import modularity
+
+_NEG_BIG = -3.4e38
+_INT32_MAX = np.iinfo(np.int32).max
+
+
+@dataclass(frozen=True)
+class _Level:
+    """One Louvain level: symmetric weighted messages + self-loop weights
+    (host-side, padded; recv == padded V is the drop sentinel)."""
+
+    recv: np.ndarray         # int32 [M_pad]
+    send: np.ndarray         # int32 [M_pad]
+    weight: np.ndarray       # float32 [M_pad]
+    self_weight: np.ndarray  # float32 [V_pad]
+    num_vertices: int        # true vertex count (<= V_pad)
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(int(max(n, 1) - 1).bit_length(), 3)
+
+
+def _pad_level(recv, send, w, self_w, v) -> _Level:
+    m_pad, v_pad = _pow2(len(recv)), _pow2(v)
+    pad = m_pad - len(recv)
+    recv = np.concatenate([recv.astype(np.int32), np.full(pad, v_pad, np.int32)])
+    send = np.concatenate([send.astype(np.int32), np.zeros(pad, np.int32)])
+    w = np.concatenate([w.astype(np.float32), np.zeros(pad, np.float32)])
+    self_w = np.concatenate([self_w.astype(np.float32), np.zeros(v_pad - v, np.float32)])
+    return _Level(recv, send, w, self_w, v)
+
+
+def _level_from_graph(graph: Graph) -> _Level:
+    recv = np.asarray(graph.msg_recv)
+    send = np.asarray(graph.msg_send)
+    v = graph.num_vertices
+    is_self = recv == send
+    # A self-loop edge appears twice in the symmetric message list; carrying
+    # it as self_weight 0.5 per appearance preserves the degree convention
+    # (one self-loop of weight w adds 2w to its vertex's degree).
+    w = np.where(is_self, 0.0, 1.0).astype(np.float32)
+    self_w = np.zeros(v, np.float32)
+    np.add.at(self_w, recv[is_self], 0.5)
+    return _pad_level(recv, send, w, self_w, v)
+
+
+@partial(jax.jit, static_argnames=("num_vertices", "max_sweeps"))
+def _local_moves(
+    recv, send, weight, self_weight, num_vertices: int,
+    gamma: float, max_sweeps: int,
+):
+    """Synchronous gain-based local moves until no vertex moves (bounded by
+    ``max_sweeps``). Operates on padded arrays; ``num_vertices`` is the
+    padded size (padding vertices are isolated and never move). Returns
+    int32 community labels [num_vertices]."""
+    v = num_vertices
+    w = weight.astype(jnp.float32)
+    k = jax.ops.segment_sum(w, recv, num_segments=v) + 2.0 * self_weight
+    two_m = jnp.maximum(k.sum(), 1e-12)
+    vertex_ids = jnp.arange(v, dtype=jnp.int32)
+    m = recv.shape[0]
+
+    def sweep(comm, it):
+        sigma_tot = jax.ops.segment_sum(k, comm, num_segments=v)
+        comm_size = jax.ops.segment_sum(jnp.ones((v,), jnp.int32), comm, num_segments=v)
+        # Candidate messages: neighbor communities, plus a zero-weight
+        # "stay" candidate per vertex so the current community is always
+        # scored (w_{i->c_i} accumulates onto it via the run sum).
+        seg = jnp.concatenate([recv, vertex_ids])
+        val = jnp.concatenate([comm[send], comm])
+        wgt = jnp.concatenate([w, jnp.zeros((v,), jnp.float32)])
+        seg_s, val_s, w_s = lax.sort((seg, val, wgt), num_keys=2)
+        new_run = jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_),
+             (seg_s[1:] != seg_s[:-1]) | (val_s[1:] != val_s[:-1])]
+        )
+        run_id = jnp.cumsum(new_run.astype(jnp.int32)) - 1
+        run_w = jax.ops.segment_sum(w_s, run_id, num_segments=m + v)[run_id]
+        # Gain score for vertex i joining community d (terms constant in i
+        # dropped):  w_{i->d} - gamma * k_i * Sigma_tot'_d / (2m), with
+        # Sigma_tot' excluding i itself when d is i's current community.
+        seg_c = jnp.clip(seg_s, 0, v - 1)
+        k_i = k[seg_c]
+        own = val_s == comm[seg_c]
+        tot_adj = sigma_tot[jnp.clip(val_s, 0, v - 1)] - jnp.where(own, k_i, 0.0)
+        score = jnp.where(
+            seg_s < v, run_w - gamma * k_i * tot_adj / two_m, _NEG_BIG
+        )
+        best = jax.ops.segment_max(score, seg_s, num_segments=v)
+        is_best = (score >= best[seg_c]) & (seg_s < v)
+        cand = jnp.where(is_best, val_s, _INT32_MAX)
+        choice = jax.ops.segment_min(cand, seg_s, num_segments=v)
+        choice = jnp.where(choice == _INT32_MAX, comm, choice)
+        # Strict improvement over staying, with an epsilon against float
+        # noise.
+        stay = jnp.where((seg_s < v) & own, score, _NEG_BIG)
+        stay_best = jax.ops.segment_max(stay, seg_s, num_segments=v)
+        improves = best > stay_best + 1e-4
+        # Two synchronous-move safeguards (both needed — parity alone does
+        # not serialize same-parity neighbors): (a) alternating vertex
+        # parity serializes half of all conflicting moves; (b) the
+        # singleton-ordering rule of parallel Louvain — a singleton vertex
+        # may join another singleton's community only in the direction of
+        # the smaller community id — breaks the remaining two-singleton
+        # swap cycle, which would otherwise oscillate forever.
+        may_move = (vertex_ids % 2) == (it % 2)
+        i_single = comm_size[comm] == 1
+        tgt_single = comm_size[jnp.clip(choice, 0, v - 1)] == 1
+        swap_risk = i_single & tgt_single & (choice > comm)
+        new_comm = jnp.where(improves & may_move & ~swap_risk, choice, comm)
+        moved = jnp.sum(new_comm != comm, dtype=jnp.int32)
+        return new_comm, moved
+
+    def cond(state):
+        _, quiet, it = state
+        # Parity alternation means a single quiet sweep only proves half
+        # the vertices have no move; stop after a full quiet even+odd pair.
+        return (quiet < 2) & (it < max_sweeps)
+
+    def body(state):
+        comm, quiet, it = state
+        comm, moved = sweep(comm, it)
+        quiet = jnp.where(moved > 0, jnp.int32(0), quiet + 1)
+        return comm, quiet, it + 1
+
+    comm, _, _ = lax.while_loop(cond, body, (vertex_ids, jnp.int32(0), jnp.int32(0)))
+    return comm
+
+
+def _contract(level: _Level, comm: np.ndarray):
+    """Host-side level contraction: communities -> super-vertices.
+
+    Returns ``(new_level, dense)`` where ``dense[i]`` is the super-vertex of
+    old vertex ``i``. The O(V+M) host work per level mirrors the host-side
+    partitioning in :mod:`graphmine_tpu.parallel.sharded` — levels shrink
+    geometrically so level 0 dominates.
+    """
+    v = level.num_vertices
+    uniq, dense = np.unique(comm[:v], return_inverse=True)
+    c = len(uniq)
+    real = level.recv < len(level.self_weight)
+    cu = dense[level.recv[real]]
+    cv = dense[level.send[real]]
+    w = level.weight[real]
+    internal = cu == cv
+    new_self = np.zeros(c, np.float64)
+    np.add.at(new_self, dense, level.self_weight[:v].astype(np.float64))
+    np.add.at(new_self, cu[internal], 0.5 * w[internal].astype(np.float64))
+    key = cu[~internal].astype(np.int64) * c + cv[~internal]
+    pairs, pair_inv = np.unique(key, return_inverse=True)
+    new_w = np.zeros(len(pairs), np.float64)
+    np.add.at(new_w, pair_inv, w[~internal].astype(np.float64))
+    new_recv = (pairs // c).astype(np.int32)
+    new_send = (pairs % c).astype(np.int32)
+    new_level = _pad_level(new_recv, new_send, new_w, new_self, c)
+    return new_level, dense.astype(np.int32)
+
+
+def louvain(
+    graph: Graph,
+    gamma: float = 1.0,
+    max_levels: int = 12,
+    max_sweeps: int = 32,
+    tol: float = 1e-6,
+):
+    """Louvain community labels + modularity for a :class:`Graph`.
+
+    Returns ``(labels, q)``: int32 labels ``[V]`` (values are level-0
+    vertex-dense community ids) and the float modularity of that partition
+    on the input graph. Deterministic: synchronous sweeps with smallest-id
+    tie-breaks, no randomness.
+    """
+    level = _level_from_graph(graph)
+    mapping = np.arange(graph.num_vertices, dtype=np.int32)
+    best_labels, best_q = mapping, float(modularity(jnp.asarray(mapping), graph, gamma))
+    for _ in range(max_levels):
+        comm = np.asarray(
+            _local_moves(
+                level.recv, level.send, level.weight, level.self_weight,
+                num_vertices=len(level.self_weight), gamma=gamma,
+                max_sweeps=max_sweeps,
+            )
+        )
+        new_level, dense = _contract(level, comm)
+        mapping = dense[mapping]
+        q = float(modularity(jnp.asarray(mapping), graph, gamma))
+        if q > best_q + tol:
+            best_labels, best_q = mapping.copy(), q
+        shrunk = new_level.num_vertices < level.num_vertices
+        if not shrunk or q <= best_q - tol:
+            break
+        level = new_level
+    return jnp.asarray(best_labels, jnp.int32), best_q

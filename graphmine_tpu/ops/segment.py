@@ -50,7 +50,11 @@ def segment_mode(
         [jnp.ones((1,), jnp.bool_), (seg_s[1:] != seg_s[:-1]) | (val_s[1:] != val_s[:-1])]
     )
     # Index of each element's run start, via max-scan of start positions.
-    run_start = lax.associative_scan(jnp.maximum, jnp.where(new_run, pos, -1))
+    # lax.cummax, not associative_scan: the generic scan unrolls into log(M)
+    # irregular slice/concat stages that take minutes of TPU compile time at
+    # M ~ 10^7; cummax lowers to XLA's native cumulative op (~9x faster
+    # compile, same result).
+    run_start = lax.cummax(jnp.where(new_run, pos, -1))
     rank = pos - run_start  # 0-based multiplicity-1 within the run
     best_rank = jax.ops.segment_max(
         rank, seg_s, num_segments=num_segments, indices_are_sorted=True

@@ -22,7 +22,9 @@ class PipelineConfig:
     backend: str = "jax"  # jax | graphframes
     num_devices: int | None = None  # None = all visible (local[*] parity, :12)
     # community detection
+    community_method: str = "lpa"  # lpa (Graphframes.py:81 parity) | louvain
     max_iter: int = 5  # Graphframes.py:81
+    gamma: float = 1.0  # louvain resolution
     # outlier detection
     outlier_method: str = "both"  # recursive_lpa | lof | both | none
     sub_max_iter: int = 5  # Graphframes.py:126
@@ -42,6 +44,8 @@ class PipelineConfig:
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.outlier_method not in ("recursive_lpa", "lof", "both", "none"):
             raise ValueError(f"unknown outlier_method {self.outlier_method!r}")
+        if self.community_method not in ("lpa", "louvain"):
+            raise ValueError(f"unknown community_method {self.community_method!r}")
         if self.max_iter < 0 or self.sub_max_iter < 0:
             raise ValueError("max_iter must be >= 0")
         if not 0 < self.decile < 1:

@@ -61,17 +61,30 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
         graph = graph_from_edge_table(table)
 
     # ---- CS-3 community detection --------------------------------------
-    labels = _run_lpa(config, table, graph, m)
+    if config.community_method == "louvain":
+        from graphmine_tpu.ops.louvain import louvain
+
+        if config.checkpoint_dir:
+            m.emit("warning", message="checkpoint/resume applies to LPA only; "
+                   "louvain runs are not checkpointed")
+        with m.timed("louvain", gamma=config.gamma):
+            labels, q = louvain(graph, gamma=config.gamma)
+    else:
+        labels = _run_lpa(config, table, graph, m)
+        q = None
 
     # ---- CS-4 census ----------------------------------------------------
     from graphmine_tpu.ops.census import census_table
     from graphmine_tpu.ops.lpa import num_communities
+    from graphmine_tpu.ops.modularity import modularity
 
     with m.timed("census"):
         n_comm = int(num_communities(labels))
         present, sizes, edge_counts = census_table(labels, graph)
+        if q is None:
+            q = float(modularity(labels, graph, gamma=config.gamma))
     # parity with "There are N Communities in the Dataset." (:85)
-    m.emit("communities", count=n_comm, largest=int(sizes.max(initial=0)))
+    m.emit("communities", count=n_comm, largest=int(sizes.max(initial=0)), modularity=round(q, 6))
 
     result = PipelineResult(
         edge_table=table,

@@ -67,6 +67,16 @@ def test_multi_device_pipeline():
     np.testing.assert_array_equal(res8.labels, res1.labels)
 
 
+@needs_data
+def test_louvain_pipeline():
+    res = run_pipeline(
+        PipelineConfig(community_method="louvain", outlier_method="none")
+    )
+    comm_rec = [r for r in res.metrics.records if r["phase"] == "communities"][0]
+    assert comm_rec["modularity"] > 0.5  # Louvain >> LPA's ~0.05 on this data
+    assert 0 < res.num_communities < 1000
+
+
 def test_config_validation():
     with pytest.raises(ValueError):
         PipelineConfig(backend="spark").validate()
