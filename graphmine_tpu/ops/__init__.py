@@ -3,5 +3,10 @@ from graphmine_tpu.ops.lpa import label_propagation, lpa_superstep
 from graphmine_tpu.ops.cc import connected_components
 from graphmine_tpu.ops.louvain import louvain
 from graphmine_tpu.ops.modularity import modularity
+from graphmine_tpu.ops.pagerank import pagerank
+from graphmine_tpu.ops.degrees import degrees, in_degrees, out_degrees
+from graphmine_tpu.ops.paths import bfs_distances, shortest_paths
+from graphmine_tpu.ops.triangles import triangle_count, clustering_coefficient
+from graphmine_tpu.ops.kcore import core_numbers
 
-__all__ = ["segment_mode", "label_propagation", "lpa_superstep", "connected_components", "louvain", "modularity"]
+__all__ = ["segment_mode", "label_propagation", "lpa_superstep", "connected_components", "louvain", "modularity", "pagerank", "degrees", "in_degrees", "out_degrees", "bfs_distances", "shortest_paths", "triangle_count", "clustering_coefficient", "core_numbers"]

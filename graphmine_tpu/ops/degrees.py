@@ -1,0 +1,32 @@
+"""Degree views of a graph.
+
+The GraphFrames surface exposes ``degrees`` / ``inDegrees`` /
+``outDegrees`` DataFrames on the object built at ``Graphframes.py:78``;
+here they are dense int32 vectors (duplicate edges counted with
+multiplicity, matching the reference's kept duplicates,
+``Graphframes.py:70-74``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from graphmine_tpu.graph.container import Graph
+
+
+def out_degrees(graph: Graph) -> jax.Array:
+    return jax.ops.segment_sum(
+        jnp.ones_like(graph.src), graph.src, num_segments=graph.num_vertices
+    )
+
+
+def in_degrees(graph: Graph) -> jax.Array:
+    return jax.ops.segment_sum(
+        jnp.ones_like(graph.dst), graph.dst, num_segments=graph.num_vertices
+    )
+
+
+def degrees(graph: Graph) -> jax.Array:
+    """Undirected degree (in + out; self-loops therefore count twice)."""
+    return out_degrees(graph) + in_degrees(graph)

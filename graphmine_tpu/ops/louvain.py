@@ -63,6 +63,11 @@ def _pad_level(recv, send, w, self_w, v) -> _Level:
 
 
 def _level_from_graph(graph: Graph) -> _Level:
+    if not graph.symmetric:
+        raise ValueError(
+            "louvain needs the symmetric message list (both edge "
+            "directions); rebuild the graph with symmetric=True"
+        )
     recv = np.asarray(graph.msg_recv)
     send = np.asarray(graph.msg_send)
     v = graph.num_vertices

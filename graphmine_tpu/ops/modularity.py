@@ -53,6 +53,11 @@ def modularity_weighted(
 def modularity(labels: jax.Array, graph: Graph, gamma: float = 1.0) -> jax.Array:
     """Modularity of ``labels`` on a :class:`Graph` (unit edge weights,
     duplicate edges counted with multiplicity, self-loops handled)."""
+    if not graph.symmetric:
+        raise ValueError(
+            "modularity needs the symmetric message list (both edge "
+            "directions); rebuild the graph with symmetric=True"
+        )
     v = graph.num_vertices
     is_self = graph.msg_recv == graph.msg_send
     w = jnp.where(is_self, 0.0, 1.0)

@@ -46,6 +46,11 @@ class PipelineConfig:
             raise ValueError(f"unknown outlier_method {self.outlier_method!r}")
         if self.community_method not in ("lpa", "louvain"):
             raise ValueError(f"unknown community_method {self.community_method!r}")
+        if self.backend == "graphframes" and self.community_method != "lpa":
+            raise ValueError(
+                "backend='graphframes' only provides labelPropagation; "
+                "use community_method='lpa' or backend='jax'"
+            )
         if self.max_iter < 0 or self.sub_max_iter < 0:
             raise ValueError("max_iter must be >= 0")
         if not 0 < self.decile < 1:

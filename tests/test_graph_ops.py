@@ -1,0 +1,147 @@
+"""PageRank / BFS / shortest paths / triangles / k-core vs oracles
+(networkx where available, hand-computed otherwise) — SURVEY §4's
+algorithm-semantics test strategy applied to the extended engine surface."""
+
+import numpy as np
+import pytest
+
+from graphmine_tpu.graph.container import build_graph
+from graphmine_tpu.ops.degrees import degrees, in_degrees, out_degrees
+from graphmine_tpu.ops.kcore import core_numbers
+from graphmine_tpu.ops.pagerank import pagerank
+from graphmine_tpu.ops.paths import UNREACHABLE, bfs_distances, shortest_paths
+from graphmine_tpu.ops.triangles import clustering_coefficient, triangle_count
+
+nx = pytest.importorskip("networkx")
+
+
+def _random_digraph(rng, v=40, e=160):
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    return src, dst
+
+
+def test_degrees(rng):
+    src, dst = _random_digraph(rng)
+    g = build_graph(src, dst, num_vertices=40)
+    np.testing.assert_array_equal(np.asarray(out_degrees(g)), np.bincount(src, minlength=40))
+    np.testing.assert_array_equal(np.asarray(in_degrees(g)), np.bincount(dst, minlength=40))
+    np.testing.assert_array_equal(
+        np.asarray(degrees(g)),
+        np.bincount(src, minlength=40) + np.bincount(dst, minlength=40),
+    )
+
+
+def test_pagerank_matches_networkx(rng):
+    src, dst = _random_digraph(rng)
+    g = build_graph(src, dst, num_vertices=40)
+    got = np.asarray(pagerank(g, alpha=0.85, max_iter=200, tol=1e-10))
+    gnx = nx.MultiDiGraph()
+    gnx.add_nodes_from(range(40))
+    gnx.add_edges_from(zip(src.tolist(), dst.tolist()))
+    want = nx.pagerank(gnx, alpha=0.85, max_iter=200, tol=1e-12)
+    want = np.array([want[i] for i in range(40)])
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    assert abs(got.sum() - 1.0) < 1e-4
+
+
+def test_pagerank_personalized(rng):
+    src, dst = _random_digraph(rng)
+    g = build_graph(src, dst, num_vertices=40)
+    reset = np.zeros(40, np.float32)
+    reset[3] = 1.0
+    got = np.asarray(pagerank(g, reset=reset, max_iter=200, tol=1e-10))
+    gnx = nx.MultiDiGraph()
+    gnx.add_nodes_from(range(40))
+    gnx.add_edges_from(zip(src.tolist(), dst.tolist()))
+    want = nx.pagerank(gnx, alpha=0.85, personalization={i: float(reset[i]) for i in range(40)},
+                       max_iter=200, tol=1e-12)
+    want = np.array([want[i] for i in range(40)])
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_bfs_directed_chain():
+    g = build_graph([0, 1, 2], [1, 2, 3], num_vertices=5)
+    d = np.asarray(bfs_distances(g, np.array([0]), direction="out"))
+    np.testing.assert_array_equal(d, [0, 1, 2, 3, UNREACHABLE])
+    d_both = np.asarray(bfs_distances(g, np.array([3]), direction="both"))
+    np.testing.assert_array_equal(d_both, [3, 2, 1, 0, UNREACHABLE])
+
+
+def test_bfs_matches_networkx(rng):
+    src, dst = _random_digraph(rng, v=60, e=150)
+    g = build_graph(src, dst, num_vertices=60)
+    d = np.asarray(bfs_distances(g, np.array([7]), direction="out"))
+    gnx = nx.DiGraph()
+    gnx.add_nodes_from(range(60))
+    gnx.add_edges_from(zip(src.tolist(), dst.tolist()))
+    want = nx.single_source_shortest_path_length(gnx, 7)
+    for v in range(60):
+        if v in want:
+            assert d[v] == want[v], v
+        else:
+            assert d[v] == UNREACHABLE, v
+
+
+def test_shortest_paths_landmarks(rng):
+    src, dst = _random_digraph(rng, v=50, e=120)
+    g = build_graph(src, dst, num_vertices=50)
+    landmarks = [2, 11, 29]
+    got = np.asarray(shortest_paths(g, landmarks, direction="out"))
+    assert got.shape == (50, 3)
+    gnx = nx.DiGraph()
+    gnx.add_nodes_from(range(50))
+    gnx.add_edges_from(zip(src.tolist(), dst.tolist()))
+    for j, lm in enumerate(landmarks):
+        # GraphFrames semantics: distance from each vertex TO the landmark
+        want = nx.single_source_shortest_path_length(gnx.reverse(), lm)
+        for v in range(50):
+            if v in want:
+                assert got[v, j] == want[v]
+            else:
+                assert got[v, j] == UNREACHABLE
+
+
+def test_triangles_matches_networkx(rng):
+    src, dst = _random_digraph(rng, v=50, e=300)
+    g = build_graph(src, dst, num_vertices=50)
+    tri, total = triangle_count(g)
+    tri = np.asarray(tri)
+    gnx = nx.Graph()
+    gnx.add_nodes_from(range(50))
+    gnx.add_edges_from(zip(src.tolist(), dst.tolist()))
+    gnx.remove_edges_from(nx.selfloop_edges(gnx))
+    want = nx.triangles(gnx)
+    np.testing.assert_array_equal(tri, [want[i] for i in range(50)])
+    assert int(total) == sum(want.values()) // 3
+
+    cc = np.asarray(clustering_coefficient(g))
+    want_cc = nx.clustering(gnx)
+    np.testing.assert_allclose(cc, [want_cc[i] for i in range(50)], atol=1e-6)
+
+
+def test_triangle_free():
+    g = build_graph([0, 1, 2], [1, 2, 3], num_vertices=4)  # path: no triangles
+    tri, total = triangle_count(g)
+    assert int(total) == 0
+    np.testing.assert_array_equal(np.asarray(tri), 0)
+
+
+def test_kcore_matches_networkx(rng):
+    src, dst = _random_digraph(rng, v=60, e=400)
+    g = build_graph(src, dst, num_vertices=60)
+    got = np.asarray(core_numbers(g))
+    gnx = nx.Graph()
+    gnx.add_nodes_from(range(60))
+    gnx.add_edges_from(zip(src.tolist(), dst.tolist()))
+    gnx.remove_edges_from(nx.selfloop_edges(gnx))
+    want = nx.core_number(gnx)
+    np.testing.assert_array_equal(got, [want[i] for i in range(60)])
+
+
+def test_kcore_clique_plus_tail():
+    # K4 (core 3) with a tail vertex (core 1) and an isolated vertex (core 0)
+    edges = [(0, 1), (0, 2), (0, 3), (1, 2), (1, 3), (2, 3), (3, 4)]
+    src, dst = np.array(edges, np.int32).T
+    g = build_graph(src, dst, num_vertices=6)
+    np.testing.assert_array_equal(np.asarray(core_numbers(g)), [3, 3, 3, 3, 1, 0])
