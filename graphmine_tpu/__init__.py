@@ -30,7 +30,9 @@ from graphmine_tpu.ops.louvain import louvain
 from graphmine_tpu.ops.modularity import modularity
 from graphmine_tpu.ops.pagerank import pagerank
 from graphmine_tpu.ops.degrees import degrees, in_degrees, out_degrees
-from graphmine_tpu.ops.paths import bfs_distances, shortest_paths
+from graphmine_tpu.ops.paths import bfs, bfs_distances, bfs_parents, shortest_paths
+from graphmine_tpu.ops.scc import strongly_connected_components
+from graphmine_tpu.ops.aggregate import aggregate_messages, pregel
 from graphmine_tpu.ops.triangles import triangle_count, clustering_coefficient
 from graphmine_tpu.ops.kcore import core_numbers
 
@@ -47,8 +49,13 @@ __all__ = [
     "degrees",
     "in_degrees",
     "out_degrees",
+    "bfs",
     "bfs_distances",
+    "bfs_parents",
     "shortest_paths",
+    "strongly_connected_components",
+    "aggregate_messages",
+    "pregel",
     "triangle_count",
     "clustering_coefficient",
     "core_numbers",

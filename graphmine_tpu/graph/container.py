@@ -98,3 +98,21 @@ def build_graph(src, dst, num_vertices: int | None = None, symmetric: bool = Tru
 def graph_from_edge_table(table, symmetric: bool = True) -> Graph:
     """Build a graph from an :class:`graphmine_tpu.io.edges.EdgeTable`."""
     return build_graph(table.src, table.dst, num_vertices=table.num_vertices, symmetric=symmetric)
+
+
+def simple_undirected_edges(graph: Graph) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side simplification: distinct undirected edges, no self-loops.
+
+    Returns ``(a, b)`` int32 arrays with ``a < b``, one row per undirected
+    edge. The common preprocessing for ops defined on the simple graph
+    (triangle counting, k-core — GraphFrames' ``triangleCount`` ignores
+    direction and duplicates the same way).
+    """
+    src = np.asarray(graph.src)
+    dst = np.asarray(graph.dst)
+    v = graph.num_vertices
+    keep = src != dst
+    a = np.minimum(src[keep], dst[keep]).astype(np.int64)
+    b = np.maximum(src[keep], dst[keep]).astype(np.int64)
+    und = np.unique(a * v + b)
+    return (und // v).astype(np.int32), (und % v).astype(np.int32)

@@ -1,6 +1,9 @@
 from graphmine_tpu.ops.segment import segment_mode
+from graphmine_tpu.ops.aggregate import aggregate_messages, pregel
 from graphmine_tpu.ops.lpa import label_propagation, lpa_superstep
 from graphmine_tpu.ops.cc import connected_components
+from graphmine_tpu.ops.scc import strongly_connected_components
+from graphmine_tpu.ops.paths import bfs, bfs_parents
 from graphmine_tpu.ops.louvain import louvain
 from graphmine_tpu.ops.modularity import modularity
 from graphmine_tpu.ops.pagerank import pagerank
@@ -9,4 +12,4 @@ from graphmine_tpu.ops.paths import bfs_distances, shortest_paths
 from graphmine_tpu.ops.triangles import triangle_count, clustering_coefficient
 from graphmine_tpu.ops.kcore import core_numbers
 
-__all__ = ["segment_mode", "label_propagation", "lpa_superstep", "connected_components", "louvain", "modularity", "pagerank", "degrees", "in_degrees", "out_degrees", "bfs_distances", "shortest_paths", "triangle_count", "clustering_coefficient", "core_numbers"]
+__all__ = ["segment_mode", "aggregate_messages", "pregel", "label_propagation", "lpa_superstep", "connected_components", "strongly_connected_components", "louvain", "modularity", "pagerank", "degrees", "in_degrees", "out_degrees", "bfs", "bfs_parents", "bfs_distances", "shortest_paths", "triangle_count", "clustering_coefficient", "core_numbers"]

@@ -28,19 +28,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from graphmine_tpu.graph.container import Graph
+from graphmine_tpu.graph.container import Graph, simple_undirected_edges
 
 
 def _simple_messages(graph: Graph):
     """Host-side: symmetric message list of the simplified graph."""
-    src = np.asarray(graph.src)
-    dst = np.asarray(graph.dst)
-    v = graph.num_vertices
-    keep = src != dst
-    a = np.minimum(src[keep], dst[keep]).astype(np.int64)
-    b = np.maximum(src[keep], dst[keep]).astype(np.int64)
-    und = np.unique(a * v + b)
-    a, b = (und // v).astype(np.int32), (und % v).astype(np.int32)
+    a, b = simple_undirected_edges(graph)
     recv = np.concatenate([a, b])
     send = np.concatenate([b, a])
     order = np.argsort(recv, kind="stable")

@@ -31,6 +31,12 @@ def _edges(graph: Graph, direction: str):
     if direction == "out":
         return graph.src, graph.dst
     if direction == "both":
+        if not graph.symmetric:
+            raise ValueError(
+                "direction='both' needs a graph built with symmetric=True "
+                "(the message CSR of an asymmetric graph only carries the "
+                "forward direction)"
+            )
         return graph.msg_send, graph.msg_recv
     raise ValueError(f"direction must be 'out' or 'both', got {direction!r}")
 
@@ -89,3 +95,70 @@ def shortest_paths(graph: Graph, landmarks, direction: str = "out") -> jax.Array
     else:
         per = lambda lm: bfs_distances(graph, lm[None], direction="both")
     return lax.map(per, landmarks).T
+
+
+@partial(jax.jit, static_argnames=("direction", "max_depth"))
+def bfs_parents(
+    graph: Graph, sources: jax.Array, direction: str = "out", max_depth: int = 0
+) -> tuple[jax.Array, jax.Array]:
+    """BFS distances plus parent pointers for path reconstruction.
+
+    Returns ``(dist, parent)``, both int32 ``[V]``. ``parent[v]`` is the
+    smallest-id predecessor of ``v`` on some shortest path from ``sources``
+    (-1 for sources and unreachable vertices). Parents are recovered in one
+    extra relaxation pass after the distance fixpoint — keeps the hot loop
+    identical to :func:`bfs_distances`.
+    """
+    v = graph.num_vertices
+    send, recv = _edges(graph, direction)
+    dist = bfs_distances(graph, sources, direction=direction, max_depth=max_depth)
+    on_sp = (dist[send] != UNREACHABLE) & (dist[recv] == dist[send] + 1)
+    cand = jnp.where(on_sp, send, UNREACHABLE)
+    parent = jax.ops.segment_min(cand, recv, num_segments=v)
+    parent = jnp.where((parent == UNREACHABLE) | (dist == 0), -1, parent)
+    return dist, parent.astype(jnp.int32)
+
+
+def bfs(
+    graph: Graph,
+    from_vertices,
+    to_vertices,
+    direction: str = "out",
+    max_path_length: int = 10,
+):
+    """Shortest paths from a source set to a target set.
+
+    Semantics of ``GraphFrame.bfs(fromExpr, toExpr, maxPathLength)`` (the
+    object at ``Graphframes.py:78`` exposes it): breadth-first search stops
+    at the first depth where any target is reached; one shortest path per
+    target at that depth is returned. Instead of SQL expressions the
+    endpoint sets are vertex-id arrays — build them with any host-side
+    predicate over vertex properties.
+
+    Returns a list of int32 NumPy paths ``[source, ..., target]``, empty if
+    no target is within ``max_path_length`` hops. The distance/parent sweep
+    is one compiled kernel; only the final pointer walk (path-length steps)
+    runs on host.
+    """
+    import numpy as np
+
+    from_vertices = jnp.atleast_1d(jnp.asarray(from_vertices, jnp.int32))
+    to_np = np.atleast_1d(np.asarray(to_vertices, np.int64))
+    dist, parent = bfs_parents(
+        graph, from_vertices, direction=direction, max_depth=max_path_length
+    )
+    dist, parent = np.asarray(dist), np.asarray(parent)
+    if to_np.size == 0:
+        return []
+    tdist = dist[to_np]
+    reach = tdist != int(UNREACHABLE)
+    if not reach.any():
+        return []
+    best = int(tdist[reach].min())
+    paths = []
+    for t in to_np[reach & (tdist == best)]:
+        path = [int(t)]
+        while parent[path[-1]] >= 0:
+            path.append(int(parent[path[-1]]))
+        paths.append(np.asarray(path[::-1], dtype=np.int32))
+    return paths

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from graphmine_tpu.graph.container import Graph
+from graphmine_tpu.graph.container import Graph, simple_undirected_edges
 
 
 def _oriented_csr(graph: Graph):
@@ -39,14 +39,8 @@ def _oriented_csr(graph: Graph):
 
     Returns (ptr, col, wedge_u, wedge_v, wedge_w, simple_degree).
     """
-    src = np.asarray(graph.src)
-    dst = np.asarray(graph.dst)
     v = graph.num_vertices
-    keep = src != dst
-    a = np.minimum(src[keep], dst[keep]).astype(np.int64)
-    b = np.maximum(src[keep], dst[keep]).astype(np.int64)
-    und = np.unique(a * v + b)
-    a, b = (und // v).astype(np.int32), (und % v).astype(np.int32)
+    a, b = simple_undirected_edges(graph)
 
     deg = np.bincount(a, minlength=v) + np.bincount(b, minlength=v)
     # orient small rank -> large rank; rank = (degree, id)
@@ -106,15 +100,15 @@ def _count_device(ptr, col, wedge_v, wedge_w, wedge_u, num_vertices: int, search
     return tri, hit.sum()
 
 
-def triangle_count(graph: Graph):
-    """Per-vertex triangle counts ``[V]`` and the global triangle total.
+def _triangles(graph: Graph):
+    """Shared pipeline: host build + device count once.
 
-    GraphFrames ``triangleCount`` semantics (simple undirected graph).
+    Returns ``(tri [V], total, simple_degree [V])``.
     """
-    ptr, col, wu, wv, ww, _ = _oriented_csr(graph)
+    ptr, col, wu, wv, ww, deg = _oriented_csr(graph)
     if len(wu) == 0:
         z = jnp.zeros((graph.num_vertices,), jnp.int32)
-        return z, jnp.int32(0)
+        return z, jnp.int32(0), jnp.asarray(deg, jnp.int32)
     max_row = int(np.max(np.diff(ptr), initial=1))
     iters = max(int(np.ceil(np.log2(max(max_row, 2)))) + 1, 1)
     tri, total = _count_device(
@@ -122,22 +116,26 @@ def triangle_count(graph: Graph):
         jnp.asarray(wv), jnp.asarray(ww), jnp.asarray(wu),
         num_vertices=graph.num_vertices, search_iters=iters,
     )
+    return tri, total, jnp.asarray(deg, jnp.int32)
+
+
+def triangle_count(graph: Graph):
+    """Per-vertex triangle counts ``[V]`` and the global triangle total.
+
+    GraphFrames ``triangleCount`` semantics (simple undirected graph).
+    """
+    tri, total, _ = _triangles(graph)
     return tri, total
 
 
-def clustering_coefficient(graph: Graph) -> jax.Array:
+def clustering_coefficient(graph: Graph, _cached=None) -> jax.Array:
     """Local clustering coefficient ``[V]`` (float32): triangles through a
-    vertex over its wedge count on the simplified graph."""
-    ptr, col, wu, wv, ww, deg = _oriented_csr(graph)
-    if len(wu) == 0:
-        return jnp.zeros((graph.num_vertices,), jnp.float32)
-    max_row = int(np.max(np.diff(ptr), initial=1))
-    iters = max(int(np.ceil(np.log2(max(max_row, 2)))) + 1, 1)
-    tri, _ = _count_device(
-        jnp.asarray(ptr, jnp.int32), jnp.asarray(col),
-        jnp.asarray(wv), jnp.asarray(ww), jnp.asarray(wu),
-        num_vertices=graph.num_vertices, search_iters=iters,
-    )
-    deg = jnp.asarray(deg, jnp.float32)
+    vertex over its wedge count on the simplified graph.
+
+    ``_cached`` optionally takes a prior :func:`_triangles` result so a
+    caller needing both counts and coefficients pays the pipeline once.
+    """
+    tri, _, deg = _triangles(graph) if _cached is None else _cached
+    deg = deg.astype(jnp.float32)
     wedges = deg * (deg - 1.0) / 2.0
     return jnp.where(wedges > 0, tri / jnp.maximum(wedges, 1.0), 0.0).astype(jnp.float32)

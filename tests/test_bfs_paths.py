@@ -1,0 +1,62 @@
+"""BFS path-finding tests (GraphFrames .bfs semantics)."""
+
+import numpy as np
+
+from graphmine_tpu.graph.container import build_graph
+from graphmine_tpu.ops.paths import UNREACHABLE, bfs, bfs_parents
+
+
+def _chain_graph():
+    # 0->1->2->3->4 chain plus shortcut 0->3, and 5 isolated
+    src = np.array([0, 1, 2, 3, 0], np.int32)
+    dst = np.array([1, 2, 3, 4, 3], np.int32)
+    return build_graph(src, dst, num_vertices=6)
+
+
+def test_parents_give_shortest_tree():
+    g = _chain_graph()
+    dist, parent = bfs_parents(g, np.array([0]), direction="out")
+    assert np.asarray(dist)[:5].tolist() == [0, 1, 2, 1, 2]
+    p = np.asarray(parent)
+    assert p[0] == -1 and p[5] == -1
+    assert p[3] == 0  # via the shortcut, not the chain
+    assert p[4] == 3
+
+
+def test_bfs_path_reconstruction():
+    g = _chain_graph()
+    (path,) = bfs(g, [0], [4])
+    assert path.tolist() == [0, 3, 4]
+
+
+def test_bfs_stops_at_first_hit_level():
+    g = _chain_graph()
+    # targets at different depths: 3 (depth 1) and 4 (depth 2) -> only depth-1 path
+    paths = bfs(g, [0], [3, 4])
+    assert [p.tolist() for p in paths] == [[0, 3]]
+
+
+def test_bfs_unreachable_and_max_len():
+    g = _chain_graph()
+    assert bfs(g, [0], [5]) == []
+    assert bfs(g, [1], [4], max_path_length=2) == []
+    (p,) = bfs(g, [1], [4], max_path_length=3)
+    assert p.tolist() == [1, 2, 3, 4]
+
+
+def test_bfs_source_is_target():
+    g = _chain_graph()
+    (p,) = bfs(g, [2, 0], [2])
+    assert p.tolist() == [2]
+
+
+def test_bfs_both_direction():
+    g = _chain_graph()
+    (p,) = bfs(g, [4], [0], direction="both")
+    assert p.tolist() == [4, 3, 0]
+
+
+def test_unreachable_sentinel():
+    g = _chain_graph()
+    dist, _ = bfs_parents(g, np.array([4]), direction="out")
+    assert int(np.asarray(dist)[0]) == int(UNREACHABLE)
