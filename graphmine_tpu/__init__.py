@@ -23,6 +23,7 @@ massive graphs, ``/root/reference/CommunityDetection/Graphframes.py``):
 __version__ = "0.1.0"
 
 from graphmine_tpu.graph.container import Graph, build_graph
+from graphmine_tpu.frames import GraphFrame
 from graphmine_tpu.io.edges import load_parquet_edges, load_edge_list
 from graphmine_tpu.ops.lpa import label_propagation
 from graphmine_tpu.ops.cc import connected_components
@@ -33,11 +34,14 @@ from graphmine_tpu.ops.degrees import degrees, in_degrees, out_degrees
 from graphmine_tpu.ops.paths import bfs, bfs_distances, bfs_parents, shortest_paths
 from graphmine_tpu.ops.scc import strongly_connected_components
 from graphmine_tpu.ops.aggregate import aggregate_messages, pregel
+from graphmine_tpu.ops.motifs import find as find_motifs
+from graphmine_tpu.ops.streaming_lof import StreamingLOF, fit_lof, score_lof
 from graphmine_tpu.ops.triangles import triangle_count, clustering_coefficient
 from graphmine_tpu.ops.kcore import core_numbers
 
 __all__ = [
     "Graph",
+    "GraphFrame",
     "build_graph",
     "load_parquet_edges",
     "load_edge_list",
@@ -56,6 +60,10 @@ __all__ = [
     "strongly_connected_components",
     "aggregate_messages",
     "pregel",
+    "find_motifs",
+    "StreamingLOF",
+    "fit_lof",
+    "score_lof",
     "triangle_count",
     "clustering_coefficient",
     "core_numbers",

@@ -20,7 +20,6 @@ expressive than Catalyst expressions.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable
 
 import jax
@@ -102,7 +101,6 @@ def aggregate_messages(
     return _reduce(reduce, m, r, graph.num_vertices)
 
 
-@partial(jax.jit, static_argnames=("to_dst", "to_src", "reduce", "update", "max_iter"))
 def pregel(
     graph: Graph,
     init_state: Any,
@@ -126,6 +124,13 @@ def pregel(
     (``Graphframes.py:81`` runs exactly 5 supersteps, no convergence test);
     for convergence-tested loops use ``lax.while_loop`` directly, as
     :func:`graphmine_tpu.ops.cc.connected_components` does.
+
+    Not jitted here on purpose: the callables would have to be static jit
+    arguments, and inline lambdas (the idiomatic call style) would then
+    recompile the whole scan on every invocation. ``lax.scan`` already
+    executes the loop as compiled XLA; for repeated driver-loop use, wrap
+    *your* call site — ``jax.jit(lambda g, s: pregel(g, s, to_dst=f, ...))``
+    — so the cache is keyed by your stable closure.
     """
 
     def step(state, _):

@@ -73,3 +73,45 @@ def _knn_xla(points: jax.Array, k: int, row_tile: int = 1024):
         dists.reshape(n_pad, k)[:n],
         idx.reshape(n_pad, k)[:n],
     )
+
+
+@partial(jax.jit, static_argnames=("k", "row_tile"))
+def cross_knn(
+    queries: jax.Array,
+    refs: jax.Array,
+    k: int,
+    ref_mask: jax.Array | None = None,
+    row_tile: int = 1024,
+):
+    """k nearest *reference* points for each query (no self-exclusion).
+
+    The cross-set primitive of the streaming LOF scorer: queries arrive in
+    chunks, references are a fixed-capacity window. ``ref_mask`` (bool
+    ``[M]``) marks valid window slots — invalid slots never match, so a
+    partially filled window keeps a static shape (no recompiles as the
+    stream warms up). Returns ``(d2, idx)``, shapes ``[N, k]``, ascending.
+    """
+    n, _ = queries.shape
+    m = refs.shape[0]
+    if k > m:
+        raise ValueError(f"k={k} must be <= number of references {m}")
+    ref_sq = jnp.sum(refs * refs, axis=1)
+    q_sq = jnp.sum(queries * queries, axis=1)
+    n_pad = -(-n // row_tile) * row_tile
+    rows = jnp.pad(queries, ((0, n_pad - n), (0, 0))).reshape(
+        n_pad // row_tile, row_tile, -1
+    )
+    row_sq = jnp.pad(q_sq, (0, n_pad - n)).reshape(n_pad // row_tile, row_tile)
+    invalid = None if ref_mask is None else ~ref_mask
+
+    def tile_knn(args):
+        tile, tile_sq = args
+        d2 = tile_sq[:, None] - 2.0 * (tile @ refs.T) + ref_sq[None, :]
+        d2 = jnp.maximum(d2, 0.0)
+        if invalid is not None:
+            d2 = jnp.where(invalid[None, :], jnp.inf, d2)
+        neg_top, idx = lax.top_k(-d2, k)
+        return -neg_top, idx
+
+    dists, idx = lax.map(tile_knn, (rows, row_sq))
+    return dists.reshape(n_pad, k)[:n], idx.reshape(n_pad, k)[:n]

@@ -1,0 +1,144 @@
+"""GraphFrame high-level API tests — the reference user's migration surface."""
+
+import numpy as np
+import pytest
+
+from graphmine_tpu.frames import GraphFrame
+
+
+@pytest.fixture
+def gf():
+    # triangle 0-1-2 (directed cycle), pendant 3->4, isolated 5
+    src = np.array([0, 1, 2, 3], np.int32)
+    dst = np.array([1, 2, 0, 4], np.int32)
+    names = np.array([f"v{i}" for i in range(6)])
+    return GraphFrame((src, dst), vertices={"name": names}, num_vertices=6)
+
+
+def test_construction_and_repr(gf):
+    assert gf.num_vertices == 6 and gf.num_edges == 4
+    assert "V=6" in repr(gf)
+    assert list(gf.vertices["name"][:2]) == ["v0", "v1"]
+
+
+def test_from_edge_table_roundtrip():
+    from graphmine_tpu.io.edges import EdgeTable
+
+    et = EdgeTable(
+        src=np.array([0, 1], np.int32),
+        dst=np.array([1, 0], np.int32),
+        names=np.array(["a.com", "b.com"]),
+    )
+    gf = GraphFrame.from_edge_table(et)
+    assert gf.num_vertices == 2
+    assert list(gf.vertices["name"]) == ["a.com", "b.com"]
+
+
+def test_degrees(gf):
+    assert np.asarray(gf.out_degrees()).tolist() == [1, 1, 1, 1, 0, 0]
+    assert np.asarray(gf.in_degrees()).tolist() == [1, 1, 1, 0, 1, 0]
+    assert np.asarray(gf.degrees()).tolist() == [2, 2, 2, 1, 1, 0]
+    np.testing.assert_array_equal(np.asarray(gf.inDegrees()), np.asarray(gf.in_degrees()))
+
+
+def test_algorithms_run(gf):
+    labels = np.asarray(gf.label_propagation(max_iter=5))
+    assert labels.shape == (6,)
+    cc = np.asarray(gf.connected_components())
+    assert cc.tolist() == [0, 0, 0, 3, 3, 5]
+    scc = np.asarray(gf.strongly_connected_components())
+    assert scc[0] == scc[1] == scc[2]
+    assert len({scc[3], scc[4], scc[5], scc[0]}) == 4
+    pr = np.asarray(gf.pagerank(max_iter=50))
+    assert pr.shape == (6,) and abs(pr.sum() - 1.0) < 1e-4
+    tri, total = gf.triangle_count()
+    assert int(total) == 1 and np.asarray(tri)[:3].tolist() == [1, 1, 1]
+    sp = np.asarray(gf.shortest_paths([4]))
+    assert sp[3, 0] == 1 and sp[4, 0] == 0
+    camel = np.asarray(gf.connectedComponents())
+    np.testing.assert_array_equal(camel, cc)
+
+
+def test_bfs_with_predicates(gf):
+    paths = gf.bfs(
+        from_=lambda v: v["name"] == "v0",
+        to=lambda v: v["name"] == "v2",
+    )
+    assert [p.tolist() for p in paths] == [[0, 1, 2]]
+    # id-array form
+    paths = gf.bfs(from_=[3], to=[4])
+    assert [p.tolist() for p in paths] == [[3, 4]]
+
+
+def test_find_motif(gf):
+    r = gf.find("(a)-[]->(b); (b)-[]->(c); (c)-[]->(a)")
+    assert r.num_matches == 3  # rotations of the directed triangle
+
+
+def test_aggregate_and_pregel(gf):
+    import jax.numpy as jnp
+
+    ones = jnp.ones((6,), jnp.int32)
+    indeg = gf.aggregate_messages(ones, to_dst=lambda s, d, e: s, reduce="sum")
+    np.testing.assert_array_equal(np.asarray(indeg), np.asarray(gf.in_degrees()))
+    out = gf.pregel(
+        jnp.arange(6, dtype=jnp.int32),
+        to_dst=lambda s, d, e: s,
+        reduce="max",
+        update=lambda st, agg: jnp.maximum(st, agg),
+        max_iter=4,
+    )
+    assert np.asarray(out)[:3].tolist() == [2, 2, 2]
+
+
+def test_filter_vertices_reindexes_with_orig(gf):
+    sub = gf.filter_vertices(lambda v: np.arange(6) < 3)
+    assert sub.num_vertices == 3 and sub.num_edges == 3
+    assert sub.vertices["orig"].tolist() == [0, 1, 2]
+    # filter again: orig still maps to the root frame
+    sub2 = sub.filter_vertices([0, 2])
+    assert sub2.vertices["orig"].tolist() == [0, 2]
+    # 0->1 and 1->2 drop with vertex 1; 2->0 survives, re-indexed to 1->0
+    assert sub2.num_edges == 1
+    assert (int(sub2.edges["src"][0]), int(sub2.edges["dst"][0])) == (1, 0)
+
+
+def test_filter_edges_keeps_vertices(gf):
+    sub = gf.filter_edges(lambda e: e["src"] != 3)
+    assert sub.num_vertices == 6 and sub.num_edges == 3
+
+
+def test_drop_isolated(gf):
+    sub = gf.drop_isolated_vertices()
+    assert sub.num_vertices == 5
+    assert sub.vertices["orig"].tolist() == [0, 1, 2, 3, 4]
+    assert list(sub.vertices["name"]) == ["v0", "v1", "v2", "v3", "v4"]
+
+
+def test_extras_run(gf):
+    labels, q = gf.louvain()
+    assert labels.shape == (6,)
+    q2 = float(gf.modularity(np.asarray(gf.connected_components())))
+    assert -1.0 <= q2 <= 1.0
+    cores = np.asarray(gf.core_numbers())
+    assert cores.tolist() == [2, 2, 2, 1, 1, 0]
+    lof = np.asarray(gf.lof_scores(k=3))
+    assert lof.shape == (6,)
+
+
+def test_edge_attr_columns():
+    gf = GraphFrame(
+        {"src": [0, 1], "dst": [1, 2], "weight": np.array([0.5, 2.0])},
+        num_vertices=3,
+    )
+    sub = gf.filter_edges(lambda e: e["weight"] > 1.0)
+    assert sub.num_edges == 1 and sub.edges["weight"].tolist() == [2.0]
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError):
+        GraphFrame({"src": [0, 1]})  # missing dst
+    with pytest.raises(ValueError):
+        GraphFrame(([0], [1, 2]))  # length mismatch
+    with pytest.raises(ValueError):
+        GraphFrame(([0], [1]), vertices={"x": np.zeros(5)}, num_vertices=2)

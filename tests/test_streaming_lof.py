@@ -1,0 +1,126 @@
+"""Streaming LOF tests: sklearn novelty-mode oracle + sliding-window behavior."""
+
+import numpy as np
+import pytest
+
+from graphmine_tpu.ops.knn import cross_knn
+from graphmine_tpu.ops.streaming_lof import StreamingLOF, fit_lof, score_lof
+
+
+def test_cross_knn_matches_brute(rng):
+    q = rng.normal(size=(37, 4)).astype(np.float32)
+    r = rng.normal(size=(53, 4)).astype(np.float32)
+    d2, idx = cross_knn(q, r, k=5, row_tile=16)
+    full = ((q[:, None, :] - r[None, :, :]) ** 2).sum(-1)
+    want_idx = np.argsort(full, axis=1, kind="stable")[:, :5]
+    np.testing.assert_allclose(
+        np.sort(np.asarray(d2), axis=1),
+        np.sort(np.take_along_axis(full, want_idx, 1), axis=1),
+        rtol=2e-4, atol=2e-4,
+    )
+
+
+def test_cross_knn_mask_excludes_slots(rng):
+    q = rng.normal(size=(8, 3)).astype(np.float32)
+    r = np.concatenate([q, rng.normal(size=(20, 3)).astype(np.float32)])
+    mask = np.ones(28, bool)
+    mask[:8] = False  # the exact copies are masked out
+    _, idx = cross_knn(q, r, k=4, ref_mask=mask)
+    assert (np.asarray(idx) >= 8).all()
+
+
+def test_score_matches_sklearn_novelty(rng):
+    from sklearn.neighbors import LocalOutlierFactor
+
+    refs = rng.normal(size=(300, 5)).astype(np.float32)
+    queries = np.concatenate(
+        [rng.normal(size=(40, 5)), rng.normal(loc=6.0, size=(10, 5))]
+    ).astype(np.float32)
+    k = 15
+    model = fit_lof(refs, k=k)
+    got = np.asarray(score_lof(model, queries))
+    oracle = LocalOutlierFactor(n_neighbors=k, novelty=True).fit(refs)
+    want = -oracle.score_samples(queries)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_fit_with_padding_matches_unpadded(rng):
+    pts = rng.normal(size=(100, 4)).astype(np.float32)
+    padded = np.zeros((160, 4), np.float32)
+    padded[:100] = pts
+    mask = np.zeros(160, bool)
+    mask[:100] = True
+    m1 = fit_lof(pts, k=10)
+    m2 = fit_lof(padded, mask, k=10)
+    np.testing.assert_allclose(np.asarray(m2.kdist[:100]), np.asarray(m1.kdist), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(m2.lrd[:100]), np.asarray(m1.lrd), rtol=1e-4)
+    q = rng.normal(size=(20, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(score_lof(m2, q)), np.asarray(score_lof(m1, q)), rtol=1e-4
+    )
+
+
+def test_streaming_flags_outliers(rng):
+    # admit_threshold keeps flagged outliers out of the window, so a
+    # persistent outlier cluster cannot launder itself into "normal"
+    s = StreamingLOF(k=10, capacity=512, admit_threshold=2.0)
+    aurocs = []
+    for step in range(6):
+        inliers = rng.normal(size=(120, 3)).astype(np.float32)
+        outliers = rng.normal(loc=7.0, size=(8, 3)).astype(np.float32)
+        chunk = np.concatenate([inliers, outliers])
+        scores = s.update(chunk)
+        assert scores.shape == (128,)
+        if step == 0:
+            continue  # bootstrap chunk scored in-window
+        from graphmine_tpu.ops.lof import auroc
+
+        y = np.zeros(128, bool)
+        y[120:] = True
+        aurocs.append(auroc(scores, y))
+    assert min(aurocs) > 0.95
+
+
+def test_persistent_cluster_absorbed_without_threshold(rng):
+    # documents the flip side: with no admit threshold, a recurring outlier
+    # cluster eventually joins the window and scores as normal
+    s = StreamingLOF(k=10, capacity=512)
+    for _ in range(4):
+        chunk = np.concatenate(
+            [rng.normal(size=(120, 3)), rng.normal(loc=7.0, size=(8, 3))]
+        ).astype(np.float32)
+        scores = s.update(chunk)
+    assert scores[120:].mean() < 1.5  # absorbed
+
+
+def test_window_eviction_adapts(rng):
+    # distribution shift: after the window slides, the new regime is inlier
+    s = StreamingLOF(k=8, capacity=256)
+    a = rng.normal(loc=0.0, size=(256, 2)).astype(np.float32)
+    s.update(a)
+    b = rng.normal(loc=10.0, size=(256, 2)).astype(np.float32)
+    high = s.update(b).mean()  # shifted chunk looks outlying vs regime A
+    c = rng.normal(loc=10.0, size=(256, 2)).astype(np.float32)
+    low = s.update(c).mean()  # window is now full of regime B
+    assert high > 5 * low
+
+
+def test_first_chunk_too_small():
+    s = StreamingLOF(k=10, capacity=128)
+    with pytest.raises(ValueError):
+        s.update(np.zeros((5, 2), np.float32))
+    with pytest.raises(ValueError):
+        StreamingLOF(k=10, capacity=10)
+
+
+def test_failed_bootstrap_is_retryable(rng):
+    # a rejected bootstrap (threshold filters too much) must not corrupt
+    # state: the next update re-bootstraps cleanly
+    s = StreamingLOF(k=5, capacity=64, admit_threshold=1e-6)
+    bad = rng.normal(size=(10, 2)).astype(np.float32)
+    with pytest.raises(ValueError):
+        s.update(bad)
+    assert not s.fitted
+    s.admit_threshold = 10.0
+    scores = s.update(rng.normal(size=(20, 2)).astype(np.float32))
+    assert s.fitted and scores.shape == (20,)
