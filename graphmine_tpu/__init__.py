@@ -38,6 +38,7 @@ from graphmine_tpu.ops.motifs import find as find_motifs
 from graphmine_tpu.ops.streaming_lof import StreamingLOF, fit_lof, score_lof
 from graphmine_tpu.ops.triangles import triangle_count, clustering_coefficient
 from graphmine_tpu.ops.kcore import core_numbers
+from graphmine_tpu.table import Table, read_parquet
 
 __all__ = [
     "Graph",
@@ -67,5 +68,7 @@ __all__ = [
     "triangle_count",
     "clustering_coefficient",
     "core_numbers",
+    "Table",
+    "read_parquet",
     "__version__",
 ]

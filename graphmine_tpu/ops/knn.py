@@ -42,37 +42,50 @@ def _on_tpu() -> bool:
         return False
 
 
+def _tiled_knn(queries, refs, k, row_tile, *, exclude_self=False, ref_mask=None):
+    """Shared row-tiled distance + top-k core.
+
+    ``d2[i, j] = |q_i|^2 - 2 q_i . r_j + |r_j|^2`` — the matmul is the MXU
+    op; tiles keep the [N, M] distance matrix from materializing.
+    ``exclude_self`` masks the diagonal (queries are the refs);
+    ``ref_mask`` (bool [M]) hides invalid reference slots.
+    """
+    n, _ = queries.shape
+    m = refs.shape[0]
+    if n == 0:
+        dt = jnp.promote_types(queries.dtype, refs.dtype)
+        return jnp.zeros((0, k), dt), jnp.zeros((0, k), jnp.int32)
+    ref_sq = jnp.sum(refs * refs, axis=1)
+    q_sq = jnp.sum(queries * queries, axis=1)
+    n_pad = -(-n // row_tile) * row_tile
+    pad = n_pad - n
+    rows = jnp.pad(queries, ((0, pad), (0, 0))).reshape(n_pad // row_tile, row_tile, -1)
+    row_sq = jnp.pad(q_sq, (0, pad)).reshape(n_pad // row_tile, row_tile)
+    row_idx = jnp.arange(n_pad, dtype=jnp.int32).reshape(n_pad // row_tile, row_tile)
+    invalid = None if ref_mask is None else ~ref_mask
+
+    def tile_knn(args):
+        tile, tile_sq, tile_ids = args
+        d2 = tile_sq[:, None] - 2.0 * (tile @ refs.T) + ref_sq[None, :]
+        d2 = jnp.maximum(d2, 0.0)
+        if exclude_self:
+            self_mask = tile_ids[:, None] == jnp.arange(m, dtype=jnp.int32)[None, :]
+            d2 = jnp.where(self_mask, jnp.inf, d2)
+        if invalid is not None:
+            d2 = jnp.where(invalid[None, :], jnp.inf, d2)
+        neg_top, idx = lax.top_k(-d2, k)
+        return -neg_top, idx
+
+    dists, idx = lax.map(tile_knn, (rows, row_sq, row_idx))
+    return dists.reshape(n_pad, k)[:n], idx.reshape(n_pad, k)[:n]
+
+
 @partial(jax.jit, static_argnames=("k", "row_tile"))
 def _knn_xla(points: jax.Array, k: int, row_tile: int = 1024):
     n, _ = points.shape
     if k >= n:
         raise ValueError(f"k={k} must be < number of points {n}")
-    sq = jnp.sum(points * points, axis=1)
-    n_pad = -(-n // row_tile) * row_tile
-    pad = n_pad - n
-    points_p = jnp.pad(points, ((0, pad), (0, 0)))
-    sq_p = jnp.pad(sq, (0, pad))
-    rows = points_p.reshape(n_pad // row_tile, row_tile, -1)
-    row_sq = sq_p.reshape(n_pad // row_tile, row_tile)
-    row_idx = jnp.arange(n_pad, dtype=jnp.int32).reshape(n_pad // row_tile, row_tile)
-
-    def tile_knn(args):
-        tile, tile_sq, tile_ids = args
-        # d2[i, j] = |x_i|^2 - 2 x_i . x_j + |x_j|^2  (the matmul is the MXU op)
-        cross = tile @ points.T
-        d2 = tile_sq[:, None] - 2.0 * cross + sq[None, :]
-        d2 = jnp.maximum(d2, 0.0)
-        # exclude self-matches
-        self_mask = tile_ids[:, None] == jnp.arange(n, dtype=jnp.int32)[None, :]
-        d2 = jnp.where(self_mask, jnp.inf, d2)
-        neg_top, idx = lax.top_k(-d2, k)
-        return -neg_top, idx
-
-    dists, idx = lax.map(tile_knn, (rows, row_sq, row_idx))
-    return (
-        dists.reshape(n_pad, k)[:n],
-        idx.reshape(n_pad, k)[:n],
-    )
+    return _tiled_knn(points, points, k, row_tile, exclude_self=True)
 
 
 @partial(jax.jit, static_argnames=("k", "row_tile"))
@@ -91,27 +104,7 @@ def cross_knn(
     partially filled window keeps a static shape (no recompiles as the
     stream warms up). Returns ``(d2, idx)``, shapes ``[N, k]``, ascending.
     """
-    n, _ = queries.shape
     m = refs.shape[0]
     if k > m:
         raise ValueError(f"k={k} must be <= number of references {m}")
-    ref_sq = jnp.sum(refs * refs, axis=1)
-    q_sq = jnp.sum(queries * queries, axis=1)
-    n_pad = -(-n // row_tile) * row_tile
-    rows = jnp.pad(queries, ((0, n_pad - n), (0, 0))).reshape(
-        n_pad // row_tile, row_tile, -1
-    )
-    row_sq = jnp.pad(q_sq, (0, n_pad - n)).reshape(n_pad // row_tile, row_tile)
-    invalid = None if ref_mask is None else ~ref_mask
-
-    def tile_knn(args):
-        tile, tile_sq = args
-        d2 = tile_sq[:, None] - 2.0 * (tile @ refs.T) + ref_sq[None, :]
-        d2 = jnp.maximum(d2, 0.0)
-        if invalid is not None:
-            d2 = jnp.where(invalid[None, :], jnp.inf, d2)
-        neg_top, idx = lax.top_k(-d2, k)
-        return -neg_top, idx
-
-    dists, idx = lax.map(tile_knn, (rows, row_sq))
-    return dists.reshape(n_pad, k)[:n], idx.reshape(n_pad, k)[:n]
+    return _tiled_knn(queries, refs, k, row_tile, ref_mask=ref_mask)

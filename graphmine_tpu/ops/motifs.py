@@ -114,11 +114,42 @@ class _Joiner:
         self.dst = np.asarray(graph.dst, dtype=np.int64)
         self.v = graph.num_vertices
         self.e = len(self.src)
-        self.by_src = np.argsort(self.src, kind="stable")
-        self.src_sorted = self.src[self.by_src]
-        self.by_dst = np.argsort(self.dst, kind="stable")
-        self.dst_sorted = self.dst[self.by_dst]
-        self.edge_keys = np.unique(self.src * self.v + self.dst)
+        # Sort indexes and the unique-edge-key table cost O(E log E) each;
+        # built on first use — src-chained patterns never pay for the dst
+        # index, and only negated terms need edge_keys.
+        self._by_src = self._src_sorted = None
+        self._by_dst = self._dst_sorted = None
+        self._edge_keys = None
+
+    @property
+    def by_src(self):
+        if self._by_src is None:
+            self._by_src = np.argsort(self.src, kind="stable")
+            self._src_sorted = self.src[self._by_src]
+        return self._by_src
+
+    @property
+    def src_sorted(self):
+        self.by_src
+        return self._src_sorted
+
+    @property
+    def by_dst(self):
+        if self._by_dst is None:
+            self._by_dst = np.argsort(self.dst, kind="stable")
+            self._dst_sorted = self.dst[self._by_dst]
+        return self._by_dst
+
+    @property
+    def dst_sorted(self):
+        self.by_dst
+        return self._dst_sorted
+
+    @property
+    def edge_keys(self):
+        if self._edge_keys is None:
+            self._edge_keys = np.unique(self.src * self.v + self.dst)
+        return self._edge_keys
 
     def expand(self, bound: np.ndarray, by: str):
         """For each bound endpoint value, enumerate matching edge rows.

@@ -124,3 +124,14 @@ def test_failed_bootstrap_is_retryable(rng):
     s.admit_threshold = 10.0
     scores = s.update(rng.normal(size=(20, 2)).astype(np.float32))
     assert s.fitted and scores.shape == (20,)
+
+
+def test_update_with_empty_chunk():
+    import numpy as np
+    from graphmine_tpu.ops.streaming_lof import StreamingLOF
+
+    rng = np.random.default_rng(0)
+    s = StreamingLOF(k=3, capacity=32)
+    s.update(rng.normal(size=(16, 4)).astype(np.float32))
+    out = s.update(np.zeros((0, 4), np.float32))
+    assert out.shape == (0,)

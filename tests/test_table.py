@@ -1,0 +1,137 @@
+"""Relational-layer tests: the Spark DataFrame op contract (SURVEY §2.2).
+
+Covers the exact op sequence of the reference's preprocessing phase
+(``Graphframes.py:16-32, 53, 70-74, 85-110``) including its literal SQL
+filter string, plus the dead data-slicer ops (``:34-47``).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from graphmine_tpu.table import Table
+
+from conftest import REFERENCE_PARQUET
+
+
+def small():
+    return Table(
+        {
+            "Parent": np.array(["u1", "u2", "u3", "u4"], dtype=object),
+            "ParentDomain": np.array(["a.com", "a.com", None, "b.com"], dtype=object),
+            "ChildDomain": np.array(["b.com", "c.com", "b.com", None], dtype=object),
+            "n": np.array([1, 2, 3, 4]),
+        }
+    )
+
+
+def test_rename_and_null_filter():
+    t = small().with_column_renamed("Parent", "ParentURL")
+    assert t.columns == ["ParentURL", "ParentDomain", "ChildDomain", "n"]
+    # the reference's literal filter string, Graphframes.py:30
+    f = t.filter("ParentDomain is not null and ChildDomain is not null")
+    assert f.count() == 2
+    assert list(f["n"]) == [1, 2]
+    # rename of a missing column is a silent no-op (Spark semantics)
+    assert t.with_column_renamed("nope", "x").columns == t.columns
+
+
+def test_sql_predicates():
+    t = small()
+    assert t.filter("n > 2").count() == 2
+    assert t.filter("n >= 2 and n < 4").count() == 2
+    assert t.filter("ParentDomain = 'a.com'").count() == 2
+    assert t.filter("ParentDomain != 'a.com'").count() == 1  # null rows drop
+    assert t.filter("ParentDomain is null or ChildDomain is null").count() == 2
+    assert t.filter("not (n = 1)").count() == 3
+    assert t.filter("ParentDomain in ('a.com', 'z.com')").count() == 2
+    assert t.filter("ParentDomain like 'a%'").count() == 2
+    assert t.filter("ChildDomain like '_.com'").count() == 3
+    with pytest.raises((ValueError, KeyError)):
+        t.filter("Bogus = 1")
+
+
+def test_select_withcolumn_distinct_collect():
+    t = small()
+    s = t.select("ParentDomain", "ChildDomain")
+    assert s.columns == ["ParentDomain", "ChildDomain"]
+    w = t.with_column("n2", lambda tb: tb["n"] * 10)
+    assert list(w["n2"]) == [10, 20, 30, 40]
+    d = Table({"x": np.array([1, 1, 2, 2, 3])}).distinct()
+    assert list(d["x"]) == [1, 2, 3]
+    rows = t.select("n").collect()
+    assert [r.n for r in rows] == [1, 2, 3, 4]
+    # persist is the eager-engine identity (Graphframes.py:82)
+    assert t.persist() is t
+
+
+def test_distinct_with_nulls_and_multicol():
+    t = Table(
+        {
+            "a": np.array(["x", "x", None, None], dtype=object),
+            "b": np.array([1, 1, 2, 2]),
+        }
+    )
+    assert t.distinct().count() == 2
+    assert t.drop_duplicates(["b"]).count() == 2
+
+
+def test_slicer_ops_row_ids_sort_limit_subtract():
+    # the dead data-slicer pattern, Graphframes.py:34-47
+    t = Table({"v": np.array([30, 10, 20, 40])}).with_row_ids("id")
+    assert list(t["id"]) == [0, 1, 2, 3]
+    first2 = t.sort("v").limit(2)
+    assert list(first2["v"]) == [10, 20]
+    rest = t.subtract(first2)
+    assert sorted(rest["v"]) == [30, 40]
+    assert t.union(t).count() == 8
+
+
+def test_show_renders(capsys):
+    out = small().show(2, truncate=8)
+    assert "ParentDomain" in out and "only showing top 2 rows" in out
+    assert "null" not in out.split("\n")[3]  # first two rows have no nulls
+
+
+def test_flat_map_distinct_vertex_idiom():
+    # Graphframes.py:53 — union of the two domain columns, nulls dropped
+    t = small()
+    verts = t.flat_map_distinct("ParentDomain", "ChildDomain")
+    assert list(verts) == ["a.com", "b.com", "c.com"]
+
+
+def test_to_edge_table_bridge():
+    t = small().filter("ParentDomain is not null and ChildDomain is not null")
+    et = t.to_edge_table("ParentDomain", "ChildDomain")
+    assert et.num_edges == 2 and et.num_vertices == 3
+    assert et.names[et.src[0]] == "a.com" and et.names[et.dst[0]] == "b.com"
+
+
+@pytest.mark.skipif(
+    not os.path.exists(REFERENCE_PARQUET), reason="bundled parquet not available"
+)
+def test_reference_preprocessing_phase_end_to_end():
+    """The reference's whole phase 1 (Graphframes.py:16-30) through Table."""
+    df = Table.read_parquet(REFERENCE_PARQUET)
+    assert df.count() == 18399  # Graphframes.py:18
+    df = (
+        df.with_column_renamed("_c0", "Parent")
+        .with_column_renamed("_c1", "ParentDomain")
+        .with_column_renamed("_c2", "ChildDomain")
+        .with_column_renamed("_c3", "Child")
+        .filter("ParentDomain is not null and ChildDomain is not null")
+    )
+    assert df.count() == 18398  # one null row dropped
+    assert len(df.flat_map_distinct("ParentDomain", "ChildDomain")) == 4613
+    et = df.to_edge_table("ParentDomain", "ChildDomain")
+    assert et.num_edges == 18398 and et.num_vertices == 4613
+    assert len(et.distinct_edges()) == 7742
+
+
+def test_sort_with_nulls():
+    t = Table({"s": np.array(["b", None, "a"], dtype=object), "n": np.array([1, 2, 3])})
+    asc = t.sort("s")
+    assert list(asc["n"]) == [2, 3, 1]  # nulls first ascending
+    desc = t.sort("s", ascending=False)
+    assert list(desc["n"]) == [1, 3, 2]  # nulls last descending
