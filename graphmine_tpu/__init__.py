@@ -29,7 +29,7 @@ from graphmine_tpu.ops.lpa import label_propagation
 from graphmine_tpu.ops.cc import connected_components
 from graphmine_tpu.ops.louvain import louvain
 from graphmine_tpu.ops.modularity import modularity
-from graphmine_tpu.ops.pagerank import pagerank
+from graphmine_tpu.ops.pagerank import pagerank, parallel_personalized_pagerank
 from graphmine_tpu.ops.degrees import degrees, in_degrees, out_degrees
 from graphmine_tpu.ops.paths import bfs, bfs_distances, bfs_parents, shortest_paths
 from graphmine_tpu.ops.scc import strongly_connected_components
@@ -39,6 +39,7 @@ from graphmine_tpu.ops.streaming_lof import StreamingLOF, fit_lof, score_lof
 from graphmine_tpu.ops.triangles import triangle_count, clustering_coefficient
 from graphmine_tpu.ops.kcore import core_numbers
 from graphmine_tpu.table import Table, read_parquet
+from graphmine_tpu.ops.svdpp import svd_plus_plus, svdpp_predict
 
 __all__ = [
     "Graph",
@@ -51,6 +52,9 @@ __all__ = [
     "louvain",
     "modularity",
     "pagerank",
+    "parallel_personalized_pagerank",
+    "svd_plus_plus",
+    "svdpp_predict",
     "degrees",
     "in_degrees",
     "out_degrees",

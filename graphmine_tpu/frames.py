@@ -300,6 +300,32 @@ class GraphFrame:
         feats = standardize(vertex_features(self.graph(), labels))
         return lof_scores(feats, k=k, **kw)
 
+    def triplets(self):
+        """GraphFrames ``triplets``: one row per edge with src/dst vertex
+        attributes joined in (columns ``src``, ``dst``, then ``src_<attr>``
+        / ``dst_<attr>`` for every vertex column)."""
+        from graphmine_tpu.table import Table
+
+        src, dst = self.edges["src"], self.edges["dst"]
+        cols = dict(self.edges)
+        for name, vals in self.vertices.items():
+            vals = np.asarray(vals)
+            cols[f"src_{name}"] = vals[src]
+            cols[f"dst_{name}"] = vals[dst]
+        return Table(cols)
+
+    def parallel_personalized_pagerank(self, sources, **kw):
+        from graphmine_tpu.ops.pagerank import parallel_personalized_pagerank
+        return parallel_personalized_pagerank(self.graph(symmetric=False), sources, **kw)
+
+    def svd_plus_plus(self, ratings, **kw):
+        """Train SVD++ on this graph's edges with per-edge ``ratings``."""
+        from graphmine_tpu.ops.svdpp import svd_plus_plus
+        return svd_plus_plus(
+            self.edges["src"], self.edges["dst"], ratings,
+            num_vertices=self.num_vertices, **kw,
+        )
+
     # -- GraphFrames camelCase aliases -------------------------------------
 
     labelPropagation = label_propagation
@@ -314,3 +340,5 @@ class GraphFrame:
     dropIsolatedVertices = drop_isolated_vertices
     inDegrees = in_degrees
     outDegrees = out_degrees
+    parallelPersonalizedPageRank = parallel_personalized_pagerank
+    svdPlusPlus = svd_plus_plus

@@ -19,6 +19,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from graphmine_tpu.graph.container import Graph
@@ -66,3 +67,63 @@ def pagerank(
     pr0 = jnp.full((v,), 1.0 / v, jnp.float32)
     pr, _, _ = lax.while_loop(cond, step, (pr0, jnp.float32(1.0), jnp.int32(0)))
     return pr
+
+
+@partial(jax.jit, static_argnames=("v", "max_iter"))
+def _batched_ppr(src, dst, v, sources, alpha, max_iter, tol):
+    s = sources.shape[0]
+    out_deg = jax.ops.segment_sum(jnp.ones_like(src), src, num_segments=v)
+    inv_out = jnp.where(out_deg > 0, 1.0 / jnp.maximum(out_deg, 1), 0.0).astype(
+        jnp.float32
+    )
+    dangling = out_deg == 0
+    # One-hot teleport distributions, one column per source: [V, S].
+    reset = jnp.zeros((v, s), jnp.float32).at[sources, jnp.arange(s)].set(1.0)
+
+    def step(state):
+        pr, _, it = state
+        contrib = pr * inv_out[:, None]
+        inflow = jax.ops.segment_sum(contrib[src], dst, num_segments=v)
+        dangling_mass = jnp.sum(jnp.where(dangling[:, None], pr, 0.0), axis=0)
+        new = alpha * (inflow + dangling_mass[None, :] * reset) + (1.0 - alpha) * reset
+        delta = jnp.abs(new - pr).sum(axis=0).max()
+        return new, delta, it + 1
+
+    def cond(state):
+        _, delta, it = state
+        return (delta > tol) & (it < max_iter)
+
+    pr0 = jnp.full((v, s), 1.0 / v, jnp.float32)
+    pr, _, _ = lax.while_loop(cond, step, (pr0, jnp.float32(1.0), jnp.int32(0)))
+    return pr
+
+
+def parallel_personalized_pagerank(
+    graph: Graph,
+    sources,
+    alpha: float = 0.85,
+    max_iter: int = 100,
+    tol: float = 1e-6,
+) -> jax.Array:
+    """Personalized PageRank from many sources at once — GraphFrames'
+    ``parallelPersonalizedPageRank`` (part of the GraphFrame capability
+    surface, SURVEY §2.2).
+
+    Returns ``[V, S]``: column ``j`` is the PPR vector teleporting to
+    ``sources[j]``. One batched power iteration over the whole [V, S] rank
+    matrix — the per-edge gather/segment-sum is shared across sources, so S
+    sources cost barely more HBM traffic than one (vs GraphX, which runs a
+    vector program per source over the same Pregel machinery).
+    """
+    sources = np.asarray(sources, dtype=np.int32)
+    if sources.size and (
+        sources.min() < 0 or sources.max() >= graph.num_vertices
+    ):
+        bad = sources[(sources < 0) | (sources >= graph.num_vertices)]
+        raise ValueError(
+            f"source ids {bad.tolist()} out of range [0, {graph.num_vertices})"
+        )
+    return _batched_ppr(
+        graph.src, graph.dst, graph.num_vertices, jnp.asarray(sources), alpha,
+        max_iter, tol,
+    )

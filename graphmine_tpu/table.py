@@ -87,10 +87,36 @@ def _tokenize(expr: str) -> list[tuple[str, Any]]:
     return tokens
 
 
+class _Tri:
+    """SQL three-valued logic: a boolean vector plus an ``unknown`` (null)
+    vector. ``true``/``false``/``unknown`` are disjoint; a row passes a
+    filter only when the predicate is *true* (unknown rows drop, and
+    ``NOT unknown`` stays unknown — Spark semantics)."""
+
+    __slots__ = ("v", "u")
+
+    def __init__(self, v: np.ndarray, u: np.ndarray | None = None):
+        self.v = v
+        self.u = np.zeros(len(v), bool) if u is None else u
+
+    def __and__(self, o: "_Tri") -> "_Tri":
+        false = (~self.v & ~self.u) | (~o.v & ~o.u)
+        v = self.v & o.v
+        return _Tri(v, ~v & ~false)
+
+    def __or__(self, o: "_Tri") -> "_Tri":
+        v = self.v | o.v
+        return _Tri(v, ~v & (self.u | o.u))
+
+    def __invert__(self) -> "_Tri":
+        return _Tri(~self.v & ~self.u, self.u)
+
+
 class _PredicateParser:
     """Recursive-descent parser for the SQL predicate subset Spark-style
     ``filter`` strings use: comparisons, ``is [not] null``, ``like``,
-    ``in (...)``, ``and``/``or``/``not``, parentheses."""
+    ``in (...)``, ``and``/``or``/``not``, parentheses. Evaluates under SQL
+    three-valued logic (comparisons against null are *unknown*, not false)."""
 
     def __init__(self, tokens: list[tuple[str, Any]], columns: Mapping[str, np.ndarray], n: int):
         self.toks = tokens
@@ -111,26 +137,26 @@ class _PredicateParser:
         return tok
 
     def parse(self) -> np.ndarray:
-        mask = self.or_expr()
+        tri = self.or_expr()
         if self.peek()[0] is not None:
             raise ValueError(f"trailing tokens: {self.toks[self.i:]}")
-        return mask
+        return tri.v  # rows where the predicate is TRUE (unknown drops)
 
-    def or_expr(self) -> np.ndarray:
+    def or_expr(self) -> _Tri:
         left = self.and_expr()
         while self.peek() == ("kw", "or"):
             self.take()
             left = left | self.and_expr()
         return left
 
-    def and_expr(self) -> np.ndarray:
+    def and_expr(self) -> _Tri:
         left = self.not_expr()
         while self.peek() == ("kw", "and"):
             self.take()
             left = left & self.not_expr()
         return left
 
-    def not_expr(self) -> np.ndarray:
+    def not_expr(self) -> _Tri:
         if self.peek() == ("kw", "not"):
             self.take()
             return ~self.not_expr()
@@ -156,7 +182,7 @@ class _PredicateParser:
             return ("lit", val == "true")
         raise ValueError(f"unexpected token {self.peek()} in filter expression")
 
-    def comparison(self) -> np.ndarray:
+    def comparison(self) -> _Tri:
         left_kind, left = self._operand()
         if left_kind == "mask":
             return left
@@ -169,11 +195,12 @@ class _PredicateParser:
                 negate = True
             self.take("kw", "null")
             mask = _isnull(self._resolve(left_kind, left))
-            return ~mask if negate else mask
+            return _Tri(~mask if negate else mask)  # IS NULL is never unknown
         if kind == "kw" and val == "like":
             self.take()
             _, pat = self.take("lit")
-            return _like(self._resolve(left_kind, left), str(pat))
+            arr = self._resolve(left_kind, left)
+            return _Tri(_like(arr, str(pat)), _isnull(arr))
         if kind == "kw" and val == "in":
             self.take()
             self.take("lp")
@@ -187,17 +214,21 @@ class _PredicateParser:
                 self.take("rp")
                 break
             arr = self._resolve(left_kind, left)
-            return np.isin(arr, np.array(lits, dtype=arr.dtype if arr.dtype != object else object))
+            hit = np.isin(
+                arr, np.array(lits, dtype=arr.dtype if arr.dtype != object else object)
+            )
+            null = _isnull(arr)
+            return _Tri(hit & ~null, null)
         if kind == "op":
             self.take()
             right_kind, right = self._operand()
-            return _compare(
-                self._resolve(left_kind, left), val, self._resolve(right_kind, right)
-            )
+            a = self._resolve(left_kind, left)
+            b = self._resolve(right_kind, right)
+            return _Tri(_compare(a, val, b), _isnull(a) | _isnull(b))
         if left_kind == "col":
             col = self.cols[left]
             if col.dtype == np.bool_:
-                return col.copy()
+                return _Tri(col.copy())
         raise ValueError(f"column {left!r} used as a predicate but is not boolean")
 
     def _resolve(self, kind, val):
@@ -307,6 +338,10 @@ class Table:
         """``withColumnRenamed`` (``Graphframes.py:26-29``)."""
         if existing not in self._cols:
             return self  # Spark semantics: silently no-op on missing column
+        if new in self._cols and new != existing:
+            # Spark would produce duplicate column names; a dict cannot, and
+            # silently dropping a column loses data — fail loudly instead.
+            raise ValueError(f"cannot rename {existing!r}: column {new!r} already exists")
         return self._replace(
             {(new if k == existing else k): v for k, v in self._cols.items()}
         )
@@ -462,15 +497,21 @@ class Table:
         stacked = np.concatenate([c[~_isnull(c)] for c in cols])
         return np.unique(stacked)
 
-    def to_edge_table(self, src_col: str, dst_col: str):
+    def to_edge_table(self, src_col: str, dst_col: str, num_rows_raw: int | None = None):
         """Factorize two string/int columns into a dense-int32
         :class:`~graphmine_tpu.io.edges.EdgeTable` — the device boundary.
         Replaces the sha1 UDF scheme (``Graphframes.py:57-74``); duplicate
-        rows are kept, matching the reference."""
+        rows are kept, matching the reference.
+
+        ``num_rows_raw``: the pre-null-filter row count for the EdgeTable's
+        provenance field (this table cannot know how many rows an earlier
+        ``filter`` removed); defaults to this table's current row count."""
         from graphmine_tpu.io.edges import _from_string_columns
 
         return _from_string_columns(
-            self._cols[src_col], self._cols[dst_col], num_rows_raw=self._n
+            self._cols[src_col],
+            self._cols[dst_col],
+            num_rows_raw=self._n if num_rows_raw is None else num_rows_raw,
         )
 
     # -- io ------------------------------------------------------------------
@@ -509,10 +550,16 @@ def _as_column(values) -> np.ndarray:
 
 
 def _row_keys(cols: list[np.ndarray]) -> np.ndarray:
-    """Hashable per-row keys for distinct/subtract, vectorized."""
-    parts = [
-        np.where(_isnull(c), "\x00<null>", c.astype(str)).astype(object) for c in cols
-    ]
+    """Hashable per-row keys for distinct/subtract, vectorized.
+
+    Values are escaped before joining so the delimiter (and the null
+    sentinel) can never collide with data content."""
+    parts = []
+    for c in cols:
+        s = np.char.replace(c.astype(str).astype("U"), "\\", "\\\\")
+        s = np.char.replace(s, "\x1f", "\\u")
+        s = np.where(_isnull(c), "\\0", s).astype(object)
+        parts.append(s)
     out = parts[0]
     for p in parts[1:]:
         out = out + "\x1f" + p

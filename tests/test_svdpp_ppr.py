@@ -1,0 +1,99 @@
+"""SVD++ and parallel personalized PageRank (GraphFrames capability rows).
+
+GraphFrames 0.6.0 exposes ``svdPlusPlus`` and ``parallelPersonalizedPageRank``
+on the GraphFrame object the reference constructs (``Graphframes.py:78``);
+neither is called by the script, but both belong to the dependency
+capability surface (SURVEY §2.2).
+"""
+
+import numpy as np
+import pytest
+
+from graphmine_tpu.frames import GraphFrame
+from graphmine_tpu.graph.container import build_graph
+from graphmine_tpu.ops.pagerank import pagerank, parallel_personalized_pagerank
+from graphmine_tpu.ops.svdpp import svd_plus_plus, svdpp_predict
+
+
+def rating_data(n_users=40, n_items=30, rank=3, density=0.5, seed=1):
+    """Low-rank synthetic ratings; items indexed after users."""
+    rng = np.random.default_rng(seed)
+    u_f = rng.normal(size=(n_users, rank)) / np.sqrt(rank)
+    i_f = rng.normal(size=(n_items, rank)) / np.sqrt(rank)
+    full = 3.0 + u_f @ i_f.T  # centered at 3 stars
+    mask = rng.random((n_users, n_items)) < density
+    uu, ii = np.nonzero(mask)
+    ratings = np.clip(full[uu, ii] + rng.normal(0, 0.05, len(uu)), 0.0, 5.0)
+    return (
+        uu.astype(np.int32),
+        (n_users + ii).astype(np.int32),
+        ratings.astype(np.float32),
+        n_users + n_items,
+    )
+
+
+def test_svdpp_training_reduces_rmse():
+    src, dst, ratings, v = rating_data()
+    model, hist = svd_plus_plus(src, dst, ratings, num_vertices=v, rank=8, max_iter=100)
+    hist = np.asarray(hist)
+    # training error must drop well below the mean-only predictor's
+    baseline = float(np.sqrt(np.mean((ratings - ratings.mean()) ** 2)))
+    assert hist[-1] < 0.5 * baseline
+    assert hist[-1] < hist[0]
+    pred = np.asarray(svdpp_predict(model, src, dst, src, dst))
+    assert pred.shape == ratings.shape
+    assert float(np.sqrt(np.mean((pred - ratings) ** 2))) < baseline
+
+
+def test_svdpp_model_shapes_and_isolated_vertices():
+    src, dst, ratings, v = rating_data(n_users=10, n_items=8, density=0.4)
+    v_padded = v + 5  # vertices with no ratings at all
+    model, _ = svd_plus_plus(src, dst, ratings, num_vertices=v_padded, rank=4, max_iter=3)
+    assert model.p.shape == (v_padded, 4) and model.bu.shape == (v_padded,)
+    assert np.all(np.isfinite(np.asarray(model.p)))
+    assert np.all(np.isfinite(np.asarray(model.y)))
+
+
+def test_parallel_ppr_matches_single_source():
+    rng = np.random.default_rng(0)
+    v, e = 64, 256
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    g = build_graph(src, dst, num_vertices=v, symmetric=False)
+    sources = [3, 17, 42]
+    batched = np.asarray(parallel_personalized_pagerank(g, sources, max_iter=60))
+    assert batched.shape == (v, 3)
+    for j, s in enumerate(sources):
+        reset = np.zeros(v, np.float32)
+        reset[s] = 1.0
+        single = np.asarray(pagerank(g, reset=reset, max_iter=60))
+        np.testing.assert_allclose(batched[:, j], single, atol=1e-5)
+    # each column is a probability distribution
+    np.testing.assert_allclose(batched.sum(axis=0), np.ones(3), atol=1e-4)
+
+
+def test_graphframe_surface():
+    src = np.array([0, 1, 2], np.int32)
+    dst = np.array([1, 2, 0], np.int32)
+    gf = GraphFrame((src, dst), vertices={"name": np.array(["a", "b", "c"])})
+    pp = gf.parallelPersonalizedPageRank([0])
+    assert pp.shape == (3, 1)
+    model, hist = gf.svdPlusPlus(np.array([5.0, 1.0, 3.0], np.float32), max_iter=2)
+    assert model.p.shape[0] == 3 and hist.shape == (2,)
+    t = gf.triplets()
+    assert t.columns == ["src", "dst", "src_name", "dst_name"]
+    assert list(t["src_name"]) == ["a", "b", "c"]
+    assert list(t["dst_name"]) == ["b", "c", "a"]
+
+
+def test_review_fixes_predict_coercion_ppr_range():
+    src, dst, ratings, v = rating_data(n_users=8, n_items=6, density=0.6)
+    model, _ = svd_plus_plus(src, dst, ratings, num_vertices=v, rank=4, max_iter=2)
+    # list inputs coerce; output clipped to the training range
+    pred = np.asarray(svdpp_predict(model, list(src[:3]), list(dst[:3]),
+                                    list(src), list(dst)))
+    assert pred.shape == (3,) and pred.min() >= 0.0 and pred.max() <= 5.0
+    g = build_graph(np.array([0, 1], np.int32), np.array([1, 0], np.int32),
+                    num_vertices=2, symmetric=False)
+    with pytest.raises(ValueError):
+        parallel_personalized_pagerank(g, [7])

@@ -135,3 +135,21 @@ def test_sort_with_nulls():
     assert list(asc["n"]) == [2, 3, 1]  # nulls first ascending
     desc = t.sort("s", ascending=False)
     assert list(desc["n"]) == [1, 3, 2]  # nulls last descending
+
+
+def test_review_fixes_rename_collision_rowkeys_3vl():
+    # rename onto an existing name must not silently drop data
+    t = Table({"a": np.array([1, 2]), "b": np.array([3, 4])})
+    with pytest.raises(ValueError):
+        t.with_column_renamed("a", "b")
+    # delimiter bytes inside values must not collide row keys
+    t2 = Table({"x": np.array(["x\x1fy", "x"], dtype=object),
+                "y": np.array(["z", "y\x1fz"], dtype=object)})
+    assert t2.distinct().count() == 2
+    assert t2.subtract(Table({"x": np.array(["x"], dtype=object),
+                              "y": np.array(["y\x1fz"], dtype=object)})).count() == 1
+    # SQL three-valued logic: NOT(null = x) is unknown -> row drops
+    t3 = small()
+    assert t3.filter("not (ParentDomain = 'a.com')").count() == 1
+    assert t3.filter("not (ParentDomain like 'a%')").count() == 1
+    assert t3.filter("not (ParentDomain in ('a.com'))").count() == 1
