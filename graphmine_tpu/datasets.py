@@ -1,0 +1,158 @@
+"""Synthetic graph generators + the BASELINE scale ladder.
+
+``BASELINE.json`` defines a benchmark ladder over SNAP graphs (ego-Facebook
+→ com-Amazon → com-LiveJournal → Twitter-2010). This environment has no
+network egress, so the ladder is served two ways: a real SNAP edge-list
+file if one is present on disk (``load`` checks ``data_dir``), otherwise an
+**R-MAT** synthetic stand-in matched to the target's vertex/edge scale.
+
+R-MAT (Chakrabarti et al., SDM'04) is the standard web/social-graph
+generator (Graph500 uses it): each edge picks its (src, dst) bit-by-bit by
+recursively descending into one of four adjacency-matrix quadrants with
+probabilities (a, b, c, d). The default (0.57, 0.19, 0.19, 0.05) yields
+power-law degree skew comparable to the reference's CommonCrawl sample
+(max degree 1,223 at 4.6K vertices — BASELINE.md).
+
+Generation is fully vectorized host-side NumPy — ``scale`` rounds of
+``2E`` Bernoulli draws, no per-edge Python — then handed to the device as
+dense int32, matching the framework's ingestion contract.
+
+Also here: structural-anomaly injection for the LOF AUROC harness
+(BASELINE.json's second headline metric).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["rmat", "LadderRung", "LADDER", "load", "inject_structural_anomalies"]
+
+
+def rmat(
+    scale: int,
+    edge_factor: float = 16.0,
+    a: float = 0.57,
+    b: float = 0.19,
+    c: float = 0.19,
+    seed: int = 0,
+    dedup: bool = False,
+    permute: bool = True,
+):
+    """R-MAT edge list: ``2**scale`` vertices, ``edge_factor * 2**scale`` edges.
+
+    Returns ``(src, dst)`` int32 arrays. ``permute`` relabels vertices with
+    a random permutation (breaks the correlation between id and degree that
+    raw R-MAT has). ``dedup`` drops duplicate directed pairs (Graph500
+    keeps them; the reference also keeps duplicates — ``Graphframes.py:70-74``
+    — so the default matches both).
+    """
+    if not 0 < a + b + c <= 1.0:
+        raise ValueError("quadrant probabilities must satisfy 0 < a+b+c <= 1")
+    v = 1 << scale
+    e = int(edge_factor * v)
+    rng = np.random.default_rng(seed)
+    src = np.zeros(e, dtype=np.int64)
+    dst = np.zeros(e, dtype=np.int64)
+    for _ in range(scale):
+        r = rng.random(e)
+        # quadrant draw: [0,a) -> (0,0), [a,a+b) -> (0,1), [a+b,a+b+c) -> (1,0)
+        src_bit = r >= a + b
+        dst_bit = (r >= a) & (r < a + b) | (r >= a + b + c)
+        src = (src << 1) | src_bit
+        dst = (dst << 1) | dst_bit
+    if permute:
+        perm = rng.permutation(v)
+        src, dst = perm[src], perm[dst]
+    if dedup:
+        pairs = np.unique(src * v + dst)
+        src, dst = pairs // v, pairs % v
+    return src.astype(np.int32), dst.astype(np.int32)
+
+
+@dataclass(frozen=True)
+class LadderRung:
+    """One rung of the BASELINE.json benchmark ladder."""
+
+    name: str
+    snap_file: str  # expected on-disk SNAP edge list name (if downloaded)
+    scale: int  # rmat scale for the synthetic stand-in
+    edge_factor: float
+    description: str
+
+
+# Sizes match BASELINE.json "configs" (±, rounded to powers of two).
+LADDER: dict[str, LadderRung] = {
+    r.name: r
+    for r in [
+        LadderRung(
+            "ego-facebook", "facebook_combined.txt", 12, 21.5,
+            "SNAP ego-Facebook: 4K nodes / 88K edges — LPA + CC",
+        ),
+        LadderRung(
+            "com-amazon", "com-amazon.ungraph.txt", 18, 3.5,
+            "SNAP com-Amazon: 335K nodes / 926K edges — Louvain vs LPA",
+        ),
+        LadderRung(
+            "com-livejournal", "com-lj.ungraph.txt", 22, 8.3,
+            "SNAP com-LiveJournal: 4M nodes / 34M edges — sharded CSR over the mesh",
+        ),
+        LadderRung(
+            "twitter-2010", "twitter-2010.txt", 25, 42.0,
+            "Twitter-2010: 41M nodes / 1.4B edges — streaming LOF at slice scale",
+        ),
+    ]
+}
+
+
+def load(name: str, data_dir: str = "data", seed: int = 0, max_scale: int | None = None):
+    """Load a ladder rung: the real SNAP file when present, else R-MAT.
+
+    ``max_scale`` caps the synthetic size (e.g. for CI / single-chip runs);
+    the real file, when found, is always loaded in full. Returns an
+    :class:`~graphmine_tpu.io.edges.EdgeTable`.
+    """
+    rung = LADDER.get(name)
+    if rung is None:
+        raise KeyError(f"unknown ladder rung {name!r}; have {sorted(LADDER)}")
+    path = os.path.join(data_dir, rung.snap_file)
+    if os.path.exists(path):
+        from graphmine_tpu.io.edges import load_edge_list
+
+        return load_edge_list(path)
+    from graphmine_tpu.io.edges import from_arrays
+
+    scale = rung.scale if max_scale is None else min(rung.scale, max_scale)
+    ef = rung.edge_factor
+    src, dst = rmat(scale, ef, seed=seed)
+    return from_arrays(src, dst)
+
+
+def inject_structural_anomalies(
+    src: np.ndarray,
+    dst: np.ndarray,
+    num_vertices: int,
+    num_anomalies: int,
+    edges_per_anomaly: int = 20,
+    seed: int = 0,
+):
+    """Wire ``num_anomalies`` random existing vertices to uniform-random
+    endpoints, making them community-bridging hubs — the held-out outliers
+    of the LOF AUROC harness (BASELINE.json metric). Uniform cross-graph
+    edges put the anomaly in no community's neighborhood, which is exactly
+    the structural signature the feature/LOF pipeline scores.
+
+    Returns ``(src, dst, is_anomaly)`` with the new edges appended;
+    ``is_anomaly`` is a bool ``[num_vertices]`` ground-truth mask.
+    """
+    rng = np.random.default_rng(seed)
+    anomalies = rng.choice(num_vertices, size=num_anomalies, replace=False)
+    a_src = np.repeat(anomalies, edges_per_anomaly)
+    a_dst = rng.integers(0, num_vertices, num_anomalies * edges_per_anomaly)
+    out_src = np.concatenate([src, a_src]).astype(np.int32)
+    out_dst = np.concatenate([dst, a_dst]).astype(np.int32)
+    mask = np.zeros(num_vertices, dtype=bool)
+    mask[anomalies] = True
+    return out_src, out_dst, mask

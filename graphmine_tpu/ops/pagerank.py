@@ -116,6 +116,8 @@ def parallel_personalized_pagerank(
     vector program per source over the same Pregel machinery).
     """
     sources = np.asarray(sources, dtype=np.int32)
+    if sources.size == 0:
+        return jnp.zeros((graph.num_vertices, 0), jnp.float32)
     if sources.size and (
         sources.min() < 0 or sources.max() >= graph.num_vertices
     ):

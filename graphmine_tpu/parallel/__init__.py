@@ -1,4 +1,4 @@
-from graphmine_tpu.parallel.mesh import make_mesh
+from graphmine_tpu.parallel.mesh import make_mesh, make_multislice_mesh
 from graphmine_tpu.parallel.ring import (
     ring_connected_components,
     ring_label_propagation,
@@ -9,15 +9,18 @@ from graphmine_tpu.parallel.sharded import (
     shard_graph_arrays,
     sharded_label_propagation,
     sharded_connected_components,
+    sharded_pagerank,
 )
 
 __all__ = [
     "make_mesh",
+    "make_multislice_mesh",
     "ShardedGraph",
     "partition_graph",
     "shard_graph_arrays",
     "sharded_label_propagation",
     "sharded_connected_components",
+    "sharded_pagerank",
     "ring_label_propagation",
     "ring_connected_components",
 ]

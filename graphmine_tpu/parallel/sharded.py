@@ -37,7 +37,15 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from graphmine_tpu.graph.container import Graph, build_graph
 from graphmine_tpu.ops.segment import segment_mode
-from graphmine_tpu.parallel.mesh import VERTEX_AXIS
+def _vertex_axes(mesh):
+    """The mesh axes the vertex dimension is sharded over.
+
+    A 1-D mesh uses the plain vertex axis; a multi-slice 2-D
+    ``("dcn", "ici")`` mesh shards vertices over both axes (slice-major),
+    so collectives decompose hierarchically — ICI inside a slice, DCN
+    across slices."""
+    names = tuple(mesh.axis_names)
+    return names[0] if len(names) == 1 else names
 
 
 @jax.tree_util.register_dataclass
@@ -129,7 +137,7 @@ def partition_graph(
 
 def shard_graph_arrays(sg: ShardedGraph, mesh) -> ShardedGraph:
     """Place the per-shard arrays on the mesh (leading dim over the vertex axis)."""
-    spec = NamedSharding(mesh, P(VERTEX_AXIS, None))
+    spec = NamedSharding(mesh, P(_vertex_axes(mesh), None))
     return ShardedGraph(
         msg_recv_local=jax.device_put(sg.msg_recv_local, spec),
         msg_send=jax.device_put(sg.msg_send, spec),
@@ -141,7 +149,7 @@ def shard_graph_arrays(sg: ShardedGraph, mesh) -> ShardedGraph:
 
 
 def _shard_specs(mesh):
-    data_spec = P(VERTEX_AXIS, None)
+    data_spec = P(_vertex_axes(mesh), None)
     rep = P()
     in_specs = (rep, data_spec, data_spec, data_spec)
     return in_specs, rep
@@ -156,29 +164,29 @@ def _check_mesh(sg: ShardedGraph, mesh) -> None:
         )
 
 
-def _lpa_shard_body(labels_full, recv_local, send, deg, *, chunk_size):
+def _lpa_shard_body(labels_full, recv_local, send, deg, *, chunk_size, axes):
     """Per-device LPA superstep body (runs under shard_map)."""
     recv_local = recv_local[0]
     send = send[0]
     deg = deg[0]
     msg = labels_full[send]
     mode, _ = segment_mode(recv_local, msg, num_segments=chunk_size)
-    start = lax.axis_index(VERTEX_AXIS).astype(jnp.int32) * chunk_size
+    start = lax.axis_index(axes).astype(jnp.int32) * chunk_size
     own = lax.dynamic_slice(labels_full, (start,), (chunk_size,))
     new_own = jnp.where(deg > 0, mode, own).astype(jnp.int32)
-    return lax.all_gather(new_own, VERTEX_AXIS, tiled=True)
+    return lax.all_gather(new_own, axes, tiled=True)
 
 
-def _cc_shard_body(labels_full, recv_local, send, deg, *, chunk_size):
+def _cc_shard_body(labels_full, recv_local, send, deg, *, chunk_size, axes):
     recv_local = recv_local[0]
     send = send[0]
     deg = deg[0]
     msg = labels_full[send]
     neigh_min = jax.ops.segment_min(msg, recv_local, num_segments=chunk_size)
-    start = lax.axis_index(VERTEX_AXIS).astype(jnp.int32) * chunk_size
+    start = lax.axis_index(axes).astype(jnp.int32) * chunk_size
     own = lax.dynamic_slice(labels_full, (start,), (chunk_size,))
     new_own = jnp.where(deg > 0, jnp.minimum(own, neigh_min), own).astype(jnp.int32)
-    full = lax.all_gather(new_own, VERTEX_AXIS, tiled=True)
+    full = lax.all_gather(new_own, axes, tiled=True)
     # Pointer jumping on the (replicated) full vector — no extra comms.
     return jnp.minimum(full, full[full])
 
@@ -230,7 +238,7 @@ def sharded_label_propagation(
     _check_mesh(sg, mesh)
     in_specs, rep = _shard_specs(mesh)
     body = jax.shard_map(
-        partial(_lpa_shard_body, chunk_size=sg.chunk_size),
+        partial(_lpa_shard_body, chunk_size=sg.chunk_size, axes=_vertex_axes(mesh)),
         mesh=mesh,
         in_specs=in_specs,
         out_specs=rep,
@@ -252,7 +260,7 @@ def sharded_connected_components(sg: ShardedGraph, mesh, max_iter: int = 0) -> j
     _check_mesh(sg, mesh)
     in_specs, rep = _shard_specs(mesh)
     body = jax.shard_map(
-        partial(_cc_shard_body, chunk_size=sg.chunk_size),
+        partial(_cc_shard_body, chunk_size=sg.chunk_size, axes=_vertex_axes(mesh)),
         mesh=mesh,
         in_specs=in_specs,
         out_specs=rep,
@@ -267,3 +275,85 @@ def _pad_labels(labels: jax.Array, sg: ShardedGraph) -> jax.Array:
     v_pad = sg.padded_vertices
     pad = jnp.arange(sg.num_vertices, v_pad, dtype=jnp.int32)
     return jnp.concatenate([labels.astype(jnp.int32), pad])
+
+
+def _pagerank_shard_body(state, recv_local, send, deg, *, chunk_size, axes, alpha):
+    """Per-device PageRank power-iteration step.
+
+    ``state``: (pr_full, inv_out_full, dangling_mass_reset_full) — the
+    replicated rank vector and precomputed degree terms. Messages ride the
+    same vertex-range-sharded CSR as LPA; per-iteration comms is one tiled
+    all_gather of the rank chunk.
+    """
+    pr_full, inv_out_full, reset_full, dangling_full = state
+    recv_local = recv_local[0]
+    send = send[0]
+    contrib_full = pr_full * inv_out_full
+    inflow = jax.ops.segment_sum(
+        contrib_full[send] * (recv_local < chunk_size), recv_local,
+        num_segments=chunk_size,
+    )
+    dangling_mass = jnp.sum(jnp.where(dangling_full, pr_full, 0.0))
+    start = lax.axis_index(axes).astype(jnp.int32) * chunk_size
+    reset_own = lax.dynamic_slice(reset_full, (start,), (chunk_size,))
+    new_own = alpha * (inflow + dangling_mass * reset_own) + (1.0 - alpha) * reset_own
+    return lax.all_gather(new_own, axes, tiled=True)
+
+
+@partial(jax.jit, static_argnames=("max_iter", "mesh"))
+def sharded_pagerank(
+    sg: ShardedGraph,
+    mesh,
+    out_degrees: jax.Array,
+    alpha: float = 0.85,
+    max_iter: int = 100,
+    tol: float = 1e-6,
+) -> jax.Array:
+    """Distributed PageRank over the vertex-range-sharded message CSR.
+
+    ``sg`` must be partitioned from a **directed** graph
+    (``build_graph(..., symmetric=False)``); ``out_degrees`` is the
+    directed out-degree vector ``[V]`` (see
+    :func:`graphmine_tpu.ops.degrees.out_degrees`). Parity with
+    :func:`graphmine_tpu.ops.pagerank.pagerank` is asserted by the
+    virtual-device tests. Returns float32 ranks ``[V]`` summing to 1.
+    """
+    _check_mesh(sg, mesh)
+    v, v_pad = sg.num_vertices, sg.padded_vertices
+    out_deg = jnp.zeros((v_pad,), jnp.int32).at[:v].set(
+        out_degrees.astype(jnp.int32)
+    )
+    live = jnp.arange(v_pad) < v
+    inv_out = jnp.where(out_deg > 0, 1.0 / jnp.maximum(out_deg, 1), 0.0)
+    dangling = (out_deg == 0) & live
+    reset = jnp.where(live, 1.0 / v, 0.0).astype(jnp.float32)
+
+    in_specs, rep = _shard_specs(mesh)
+    body = jax.shard_map(
+        partial(
+            _pagerank_shard_body,
+            chunk_size=sg.chunk_size,
+            axes=_vertex_axes(mesh),
+            alpha=alpha,
+        ),
+        mesh=mesh,
+        in_specs=((rep, rep, rep, rep),) + in_specs[1:],
+        out_specs=rep,
+        check_vma=False,
+    )
+
+    def cond(state):
+        _, delta, it = state
+        return (delta > tol) & (it < max_iter)
+
+    def step(state):
+        pr, _, it = state
+        new = body(
+            (pr, inv_out, reset, dangling), sg.msg_recv_local, sg.msg_send, sg.degrees
+        )
+        delta = jnp.abs(new - pr).sum()
+        return new, delta, it + 1
+
+    pr0 = jnp.where(live, 1.0 / v, 0.0).astype(jnp.float32)
+    pr, _, _ = lax.while_loop(cond, step, (pr0, jnp.float32(1.0), jnp.int32(0)))
+    return pr[:v]

@@ -200,7 +200,8 @@ class _PredicateParser:
             self.take()
             _, pat = self.take("lit")
             arr = self._resolve(left_kind, left)
-            return _Tri(_like(arr, str(pat)), _isnull(arr))
+            null = _isnull(arr)
+            return _Tri(_like(arr, str(pat)) & ~null, null)
         if kind == "kw" and val == "in":
             self.take()
             self.take("lp")

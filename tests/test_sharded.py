@@ -81,3 +81,70 @@ def test_partition_shapes(rng):
     # every real message is preserved exactly once
     total_real = int((np.asarray(sg.msg_recv_local) < sg.chunk_size).sum())
     assert total_real == 2 * 400
+
+
+def test_sharded_pagerank_matches_single_device(mesh8):
+    import numpy as np
+    from graphmine_tpu.graph.container import build_graph
+    from graphmine_tpu.ops.degrees import out_degrees
+    from graphmine_tpu.ops.pagerank import pagerank
+    from graphmine_tpu.parallel.sharded import (
+        partition_graph,
+        shard_graph_arrays,
+        sharded_pagerank,
+    )
+
+    rng = np.random.default_rng(11)
+    v, e = 200, 800
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    g = build_graph(src, dst, num_vertices=v, symmetric=False)
+    sg = shard_graph_arrays(partition_graph(g, mesh=mesh8), mesh8)
+    od = out_degrees(g)
+    dist = np.asarray(sharded_pagerank(sg, mesh8, od, max_iter=80))
+    single = np.asarray(pagerank(g, max_iter=80))
+    np.testing.assert_allclose(dist, single, atol=1e-5)
+    assert abs(dist.sum() - 1.0) < 1e-4
+
+
+def test_multislice_mesh_lpa_cc_pagerank_parity():
+    """2-D (dcn, ici) mesh: same results as single-device on all three ops."""
+    import numpy as np
+    from graphmine_tpu.graph.container import build_graph
+    from graphmine_tpu.ops.cc import connected_components
+    from graphmine_tpu.ops.degrees import out_degrees
+    from graphmine_tpu.ops.lpa import label_propagation
+    from graphmine_tpu.ops.pagerank import pagerank
+    from graphmine_tpu.parallel.mesh import make_multislice_mesh
+    from graphmine_tpu.parallel.sharded import (
+        partition_graph,
+        shard_graph_arrays,
+        sharded_connected_components,
+        sharded_label_propagation,
+        sharded_pagerank,
+    )
+
+    mesh = make_multislice_mesh(2, 4)  # 2 "slices" x 4 "chips" of CPU devices
+    rng = np.random.default_rng(5)
+    v, e = 160, 640
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+
+    g_sym = build_graph(src, dst, num_vertices=v)
+    sg = shard_graph_arrays(partition_graph(g_sym, mesh=mesh), mesh)
+    np.testing.assert_array_equal(
+        np.asarray(sharded_label_propagation(sg, mesh, max_iter=5)),
+        np.asarray(label_propagation(g_sym, max_iter=5)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sharded_connected_components(sg, mesh)),
+        np.asarray(connected_components(g_sym)),
+    )
+
+    g_dir = build_graph(src, dst, num_vertices=v, symmetric=False)
+    sgd = shard_graph_arrays(partition_graph(g_dir, mesh=mesh), mesh)
+    np.testing.assert_allclose(
+        np.asarray(sharded_pagerank(sgd, mesh, out_degrees(g_dir), max_iter=60)),
+        np.asarray(pagerank(g_dir, max_iter=60)),
+        atol=1e-5,
+    )

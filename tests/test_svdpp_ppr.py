@@ -97,3 +97,10 @@ def test_review_fixes_predict_coercion_ppr_range():
                     num_vertices=2, symmetric=False)
     with pytest.raises(ValueError):
         parallel_personalized_pagerank(g, [7])
+
+
+def test_ppr_empty_sources():
+    g = build_graph(np.array([0], np.int32), np.array([1], np.int32),
+                    num_vertices=2, symmetric=False)
+    out = parallel_personalized_pagerank(g, [])
+    assert out.shape == (2, 0)

@@ -153,3 +153,11 @@ def test_review_fixes_rename_collision_rowkeys_3vl():
     assert t3.filter("not (ParentDomain = 'a.com')").count() == 1
     assert t3.filter("not (ParentDomain like 'a%')").count() == 1
     assert t3.filter("not (ParentDomain in ('a.com'))").count() == 1
+
+
+def test_like_on_null_is_unknown():
+    t = Table({"x": np.array([1.0, np.nan])})
+    assert t.filter("x like 'nan'").count() == 0
+    t2 = Table({"s": np.array(["abc", None], dtype=object)})
+    assert t2.filter("s like 'a%'").count() == 1
+    assert t2.filter("not (s like 'a%')").count() == 0
