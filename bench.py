@@ -47,14 +47,19 @@ def main() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     from graphmine_tpu.graph.container import build_graph
-    from graphmine_tpu.ops.lpa import lpa_superstep
+    from graphmine_tpu.ops.bucketed_mode import BucketedModePlan, lpa_superstep_bucketed
 
     src, dst = powerlaw_edges(NUM_VERTICES, NUM_EDGES)
     graph = build_graph(src, dst, num_vertices=NUM_VERTICES)
+    # Degree-bucketed dense-mode kernel (ops/bucketed_mode.py): ~1.4x the
+    # sort-based superstep at this scale, bit-identical labels (tested).
+    # Host-pure plan build — no device round-trip for msg_ptr.
+    plan = BucketedModePlan.from_edges(src, dst, NUM_VERTICES)
 
     # Compile a single superstep once; the timed loop feeds labels back so
     # every iteration computes on fresh data (steady-state throughput).
-    step = jax.jit(lpa_superstep)
+    raw_step = jax.jit(lpa_superstep_bucketed)
+    step = lambda lbl, g: raw_step(lbl, g, plan)
     labels = jnp.arange(NUM_VERTICES, dtype=jnp.int32)
     labels = step(labels, graph)
     np.asarray(labels[:8])

@@ -326,6 +326,21 @@ class GraphFrame:
             num_vertices=self.num_vertices, **kw,
         )
 
+    def persist(self) -> "GraphFrame":
+        """GraphFrames ``persist``/``cache`` parity: results here are eager
+        and the engine caches the device CSR per direction mode, so this is
+        the identity (the reference needed it at ``Graphframes.py:82``)."""
+        return self
+
+    cache = persist
+
+    def unpersist(self) -> "GraphFrame":
+        """Drop cached device graphs (frees HBM for a frame going cold)."""
+        self._graph = None
+        self._graph_directed = None
+        self._tri = None
+        return self
+
     # -- GraphFrames camelCase aliases -------------------------------------
 
     labelPropagation = label_propagation

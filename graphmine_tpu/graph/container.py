@@ -60,6 +60,25 @@ class Graph:
         return self.msg_ptr[1:] - self.msg_ptr[:-1]
 
 
+def message_ptr(src, dst, num_vertices: int, symmetric: bool = True) -> np.ndarray:
+    """CSR row pointers of the message layout (host-side int64 ``[V+1]``).
+
+    The single source of truth for the message-CSR layout contract:
+    receivers are ``concat(dst, src)`` when symmetric (both directions,
+    duplicates kept), grouped by receiver. Shared by :func:`build_graph`
+    and :meth:`~graphmine_tpu.ops.bucketed_mode.BucketedModePlan.from_edges`.
+    """
+    src = np.asarray(src)
+    dst = np.asarray(dst)
+    recv = np.concatenate([dst, src]) if symmetric else dst
+    counts = np.bincount(recv, minlength=num_vertices)
+    ptr = np.zeros(num_vertices + 1, dtype=np.int64)
+    np.cumsum(counts, out=ptr[1:])
+    if ptr[-1] >= np.iinfo(np.int32).max:
+        raise ValueError("message count exceeds int32; shard the build")
+    return ptr
+
+
 def build_graph(src, dst, num_vertices: int | None = None, symmetric: bool = True) -> Graph:
     """Build a :class:`Graph` from endpoint arrays (host-side, NumPy).
 
@@ -78,12 +97,8 @@ def build_graph(src, dst, num_vertices: int | None = None, symmetric: bool = Tru
     else:
         recv, send = dst, src
     order = np.argsort(recv, kind="stable")
+    ptr = message_ptr(src, dst, num_vertices, symmetric)
     recv, send = recv[order], send[order]
-    counts = np.bincount(recv, minlength=num_vertices).astype(np.int64)
-    ptr = np.zeros(num_vertices + 1, dtype=np.int64)
-    np.cumsum(counts, out=ptr[1:])
-    if ptr[-1] >= np.iinfo(np.int32).max:
-        raise ValueError("message count exceeds int32; shard the build")
     return Graph(
         src=jnp.asarray(src),
         dst=jnp.asarray(dst),

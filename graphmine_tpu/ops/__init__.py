@@ -8,6 +8,7 @@ from graphmine_tpu.ops.motifs import find, parse_pattern
 from graphmine_tpu.ops.streaming_lof import StreamingLOF, fit_lof, score_lof
 from graphmine_tpu.ops.louvain import louvain
 from graphmine_tpu.ops.modularity import modularity
+from graphmine_tpu.ops.bucketed_mode import BucketedModePlan, bucketed_mode, lpa_superstep_bucketed
 from graphmine_tpu.ops.pagerank import pagerank, parallel_personalized_pagerank
 from graphmine_tpu.ops.svdpp import SVDPlusPlusModel, svd_plus_plus, svdpp_predict
 from graphmine_tpu.ops.degrees import degrees, in_degrees, out_degrees
@@ -15,4 +16,4 @@ from graphmine_tpu.ops.paths import bfs_distances, shortest_paths
 from graphmine_tpu.ops.triangles import triangle_count, clustering_coefficient
 from graphmine_tpu.ops.kcore import core_numbers
 
-__all__ = ["segment_mode", "aggregate_messages", "pregel", "find", "parse_pattern", "StreamingLOF", "fit_lof", "score_lof", "label_propagation", "lpa_superstep", "connected_components", "strongly_connected_components", "louvain", "modularity", "pagerank", "parallel_personalized_pagerank", "svd_plus_plus", "svdpp_predict", "SVDPlusPlusModel", "degrees", "in_degrees", "out_degrees", "bfs", "bfs_parents", "bfs_distances", "shortest_paths", "triangle_count", "clustering_coefficient", "core_numbers"]
+__all__ = ["segment_mode", "BucketedModePlan", "bucketed_mode", "lpa_superstep_bucketed", "aggregate_messages", "pregel", "find", "parse_pattern", "StreamingLOF", "fit_lof", "score_lof", "label_propagation", "lpa_superstep", "connected_components", "strongly_connected_components", "louvain", "modularity", "pagerank", "parallel_personalized_pagerank", "svd_plus_plus", "svdpp_predict", "SVDPlusPlusModel", "degrees", "in_degrees", "out_degrees", "bfs", "bfs_parents", "bfs_distances", "shortest_paths", "triangle_count", "clustering_coefficient", "core_numbers"]

@@ -45,12 +45,19 @@ def label_propagation(
     max_iter: int = 5,
     init_labels: jax.Array | None = None,
     return_history: bool = False,
+    plan=None,
 ):
     """Run ``max_iter`` LPA supersteps; returns int32 labels ``[V]``.
 
     With ``return_history=True`` also returns the per-iteration count of
     vertices whose label changed (the structured observability signal the
     reference lacked — SURVEY §5 metrics).
+
+    ``plan``: an optional
+    :class:`~graphmine_tpu.ops.bucketed_mode.BucketedModePlan` for the
+    graph — switches every superstep to the degree-bucketed dense mode
+    kernel (~1.4× faster at 10^7 messages; identical results). Worth its
+    one-time host build cost when the same graph runs many supersteps.
     """
     labels = (
         jnp.arange(graph.num_vertices, dtype=jnp.int32)
@@ -58,8 +65,15 @@ def label_propagation(
         else init_labels.astype(jnp.int32)
     )
 
+    if plan is None:
+        superstep = lambda lbl: lpa_superstep(lbl, graph)
+    else:
+        from graphmine_tpu.ops.bucketed_mode import lpa_superstep_bucketed
+
+        superstep = lambda lbl: lpa_superstep_bucketed(lbl, graph, plan)
+
     def step(labels, _):
-        new = lpa_superstep(labels, graph)
+        new = superstep(labels)
         changed = jnp.sum(new != labels, dtype=jnp.int32)
         return new, changed
 

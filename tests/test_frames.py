@@ -142,3 +142,14 @@ def test_validation_errors():
         GraphFrame(([0], [1, 2]))  # length mismatch
     with pytest.raises(ValueError):
         GraphFrame(([0], [1]), vertices={"x": np.zeros(5)}, num_vertices=2)
+
+
+def test_persist_cache_unpersist():
+    import numpy as np
+
+    gf = GraphFrame((np.array([0, 1], np.int32), np.array([1, 0], np.int32)))
+    assert gf.persist() is gf and gf.cache() is gf
+    _ = gf.graph()
+    assert gf._graph is not None
+    gf.unpersist()
+    assert gf._graph is None

@@ -92,3 +92,48 @@ def test_permutation_invariance_of_partition(bundled_edges):
     # Tie-breaks depend on ids, so exact partition equality isn't guaranteed;
     # the community-size histogram must be statistically stable.
     assert abs(len(sizes1) - len(sizes2)) <= len(sizes1) // 10
+
+
+def test_bucketed_superstep_matches_sort_based(rng):
+    import jax
+    import jax.numpy as jnp
+
+    from graphmine_tpu.ops.bucketed_mode import (
+        BucketedModePlan,
+        lpa_superstep_bucketed,
+    )
+
+    for v, e in ((40, 160), (500, 3000)):
+        src = rng.integers(0, v, e).astype(np.int32)
+        dst = rng.integers(0, v, e).astype(np.int32)
+        g = build_graph(src, dst, num_vertices=v)
+        plan = BucketedModePlan.from_graph(g)
+        plan_h = BucketedModePlan.from_edges(src, dst, v)
+        labels = jnp.asarray(rng.integers(0, v, v).astype(np.int32))
+        want = np.asarray(jax.jit(lpa_superstep)(labels, g))
+        got = np.asarray(jax.jit(lpa_superstep_bucketed)(labels, g, plan))
+        got_h = np.asarray(jax.jit(lpa_superstep_bucketed)(labels, g, plan_h))
+        np.testing.assert_array_equal(want, got)
+        np.testing.assert_array_equal(want, got_h)
+    # full run through label_propagation(plan=...)
+    full = np.asarray(label_propagation(g, max_iter=5))
+    fast = np.asarray(label_propagation(g, max_iter=5, plan=plan))
+    np.testing.assert_array_equal(full, fast)
+
+
+def test_bucketed_plan_graph_mismatch_raises(rng):
+    import jax.numpy as jnp
+    import pytest
+
+    from graphmine_tpu.ops.bucketed_mode import (
+        BucketedModePlan,
+        lpa_superstep_bucketed,
+    )
+
+    g1 = build_graph(np.array([0, 1], np.int32), np.array([1, 2], np.int32),
+                     num_vertices=3)
+    g2 = build_graph(np.array([0, 1, 2], np.int32), np.array([1, 2, 0], np.int32),
+                     num_vertices=3)
+    plan = BucketedModePlan.from_graph(g1)
+    with pytest.raises(ValueError, match="mismatch"):
+        lpa_superstep_bucketed(jnp.arange(3, dtype=jnp.int32), g2, plan)
