@@ -60,17 +60,22 @@ class Graph:
         return self.msg_ptr[1:] - self.msg_ptr[:-1]
 
 
-def message_ptr(src, dst, num_vertices: int, symmetric: bool = True) -> np.ndarray:
+def message_ptr(
+    src, dst, num_vertices: int, symmetric: bool = True, recv=None
+) -> np.ndarray:
     """CSR row pointers of the message layout (host-side int64 ``[V+1]``).
 
     The single source of truth for the message-CSR layout contract:
     receivers are ``concat(dst, src)`` when symmetric (both directions,
     duplicates kept), grouped by receiver. Shared by :func:`build_graph`
     and :meth:`~graphmine_tpu.ops.bucketed_mode.BucketedModePlan.from_edges`.
+    ``recv``: the receiver concatenation, when the caller already built it
+    (skips an O(M) re-concatenation).
     """
-    src = np.asarray(src)
-    dst = np.asarray(dst)
-    recv = np.concatenate([dst, src]) if symmetric else dst
+    if recv is None:
+        src = np.asarray(src)
+        dst = np.asarray(dst)
+        recv = np.concatenate([dst, src]) if symmetric else dst
     counts = np.bincount(recv, minlength=num_vertices)
     ptr = np.zeros(num_vertices + 1, dtype=np.int64)
     np.cumsum(counts, out=ptr[1:])
@@ -97,7 +102,7 @@ def build_graph(src, dst, num_vertices: int | None = None, symmetric: bool = Tru
     else:
         recv, send = dst, src
     order = np.argsort(recv, kind="stable")
-    ptr = message_ptr(src, dst, num_vertices, symmetric)
+    ptr = message_ptr(src, dst, num_vertices, symmetric, recv=recv)
     recv, send = recv[order], send[order]
     return Graph(
         src=jnp.asarray(src),

@@ -45,20 +45,28 @@ class BucketedModePlan:
 
     ``vertex_ids[b]``: int32 ``[n_b]`` — vertices in bucket ``b``.
     ``msg_idx[b]``: int32 ``[n_b, w_b]`` — indices into the message array,
-    padded with ``num_messages`` (gathers a sentinel label slot).
+    padded with ``num_messages`` (gathers a sentinel label slot). ``None``
+    on fused plans (``send_idx`` replaces it; halves plan HBM).
+    ``send_idx[b]``: optional int32 ``[n_b, w_b]`` — the *sender vertex id*
+    behind each slot (padding = ``num_vertices``). When present, the LPA
+    superstep gathers straight from the label vector — one fused gather
+    instead of materializing the [M] message array and re-gathering it.
     """
 
     vertex_ids: tuple
-    msg_idx: tuple
+    msg_idx: tuple | None
     num_vertices: int = dataclasses.field(metadata=dict(static=True))
     num_messages: int = dataclasses.field(metadata=dict(static=True))
+    send_idx: tuple | None = None
 
     @classmethod
-    def from_graph(cls, graph: Graph) -> "BucketedModePlan":
-        """Build from a device-resident graph. Note: fetches ``msg_ptr`` to
-        host; when the original edge arrays are still on host, prefer
-        :meth:`from_edges` (no device round-trip)."""
-        return cls.from_ptr(np.asarray(graph.msg_ptr), graph.num_vertices)
+    def from_graph(cls, graph: Graph, with_send: bool = False) -> "BucketedModePlan":
+        """Build from a device-resident graph. Note: fetches ``msg_ptr``
+        (and ``msg_send`` when ``with_send``) to host; when the original
+        edge arrays are still on host, prefer :meth:`from_edges` (no device
+        round-trip, fused-gather plan included)."""
+        send = np.asarray(graph.msg_send) if with_send else None
+        return cls.from_ptr(np.asarray(graph.msg_ptr), graph.num_vertices, send)
 
     @classmethod
     def from_edges(
@@ -66,13 +74,27 @@ class BucketedModePlan:
     ) -> "BucketedModePlan":
         """Host-pure construction from endpoint arrays — same CSR layout as
         :func:`graphmine_tpu.graph.container.build_graph` (messages grouped
-        by receiver, stable order)."""
+        by receiver, stable order). Includes the fused-gather ``send_idx``
+        plan."""
         from graphmine_tpu.graph.container import message_ptr
 
-        return cls.from_ptr(message_ptr(src, dst, num_vertices, symmetric), num_vertices)
+        src = np.asarray(src, dtype=np.int32)
+        dst = np.asarray(dst, dtype=np.int32)
+        if src.shape != dst.shape or src.ndim != 1:
+            raise ValueError("src/dst must be equal-length 1-D arrays")
+        if symmetric:
+            recv = np.concatenate([dst, src])
+            send = np.concatenate([src, dst])
+        else:
+            recv, send = dst, src
+        ptr = message_ptr(src, dst, num_vertices, symmetric, recv=recv)
+        send_sorted = send[np.argsort(recv, kind="stable")]
+        return cls.from_ptr(ptr, num_vertices, send_sorted)
 
     @classmethod
-    def from_ptr(cls, ptr: np.ndarray, num_vertices: int) -> "BucketedModePlan":
+    def from_ptr(
+        cls, ptr: np.ndarray, num_vertices: int, send_sorted: np.ndarray | None = None
+    ) -> "BucketedModePlan":
         ptr = np.asarray(ptr).astype(np.int64)
         deg = ptr[1:] - ptr[:-1]
         m = int(ptr[-1])
@@ -82,21 +104,27 @@ class BucketedModePlan:
             np.ceil(np.log2(np.maximum(deg, 1))).astype(np.int64),
             int(np.log2(_MIN_WIDTH)),
         )
-        vertex_ids, msg_idx = [], []
+        vertex_ids, msg_idx, send_idx = [], [], []
         for c in np.unique(classes[deg > 0]):
             ids = np.nonzero((classes == c) & (deg > 0))[0].astype(np.int32)
             w = 1 << int(c)
             offs = np.arange(w, dtype=np.int64)[None, :]
             idx = ptr[ids][:, None] + offs
             valid = offs < deg[ids][:, None]
-            idx = np.where(valid, idx, m).astype(np.int32)
             vertex_ids.append(jnp.asarray(ids))
-            msg_idx.append(jnp.asarray(idx))
+            if send_sorted is not None:
+                # Fused plan: only sender-id matrices go to device — the
+                # msg_idx matrices would double plan HBM and never be read.
+                s = send_sorted[np.minimum(idx, m - 1)]
+                send_idx.append(jnp.asarray(np.where(valid, s, num_vertices).astype(np.int32)))
+            else:
+                msg_idx.append(jnp.asarray(np.where(valid, idx, m).astype(np.int32)))
         return cls(
             vertex_ids=tuple(vertex_ids),
-            msg_idx=tuple(msg_idx),
+            msg_idx=tuple(msg_idx) if send_sorted is None else None,
             num_vertices=num_vertices,
             num_messages=m,
+            send_idx=tuple(send_idx) if send_sorted is not None else None,
         )
 
 
@@ -125,6 +153,11 @@ def bucketed_mode(plan: BucketedModePlan, messages: jax.Array, fallback: jax.Arr
     ``fallback``: int32 ``[V]`` — value for vertices with no messages
     (LPA: keep the old label). Returns int32 ``[V]``.
     """
+    if plan.msg_idx is None:
+        raise ValueError(
+            "this plan is fused (send_idx only) — use lpa_superstep_bucketed, "
+            "or build with from_graph/from_ptr for generic message reduction"
+        )
     if messages.shape[0] != plan.num_messages or fallback.shape[0] != plan.num_vertices:
         raise ValueError(
             f"plan built for M={plan.num_messages}, V={plan.num_vertices} but got "
@@ -143,6 +176,28 @@ def lpa_superstep_bucketed(
     labels: jax.Array, graph: Graph, plan: BucketedModePlan
 ) -> jax.Array:
     """One LPA superstep via the bucketed plan — semantics identical to
-    :func:`graphmine_tpu.ops.lpa.lpa_superstep` (asserted by tests)."""
+    :func:`graphmine_tpu.ops.lpa.lpa_superstep` (asserted by tests).
+
+    With a fused plan (``send_idx`` present, e.g. from
+    :meth:`BucketedModePlan.from_edges`) the [M] message array is never
+    materialized: each bucket gathers sender labels directly — one gather
+    instead of two, saving an [M]-sized HBM round trip per superstep."""
+    if plan.send_idx is not None:
+        if (
+            labels.shape[0] != plan.num_vertices
+            or graph.num_messages != plan.num_messages
+        ):
+            raise ValueError(
+                f"plan built for V={plan.num_vertices}, M={plan.num_messages} "
+                f"but got V={labels.shape[0]}, M={graph.num_messages} — "
+                "plan/graph mismatch"
+            )
+        lbl_pad = jnp.concatenate(
+            [labels.astype(jnp.int32), jnp.full((1,), _SENTINEL, jnp.int32)]
+        )
+        out = labels.astype(jnp.int32)
+        for ids, sidx in zip(plan.vertex_ids, plan.send_idx):
+            out = out.at[ids].set(_rowwise_mode(lbl_pad[sidx]))
+        return out
     msg = labels[graph.msg_send]
     return bucketed_mode(plan, msg, labels)

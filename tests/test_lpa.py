@@ -137,3 +137,26 @@ def test_bucketed_plan_graph_mismatch_raises(rng):
     plan = BucketedModePlan.from_graph(g1)
     with pytest.raises(ValueError, match="mismatch"):
         lpa_superstep_bucketed(jnp.arange(3, dtype=jnp.int32), g2, plan)
+
+
+def test_fused_plan_mismatch_and_bad_edges_raise():
+    import jax.numpy as jnp
+    import pytest
+
+    from graphmine_tpu.ops.bucketed_mode import (
+        BucketedModePlan,
+        bucketed_mode,
+        lpa_superstep_bucketed,
+    )
+
+    g1e = (np.array([0, 1], np.int32), np.array([1, 2], np.int32))
+    g2 = build_graph(np.array([0, 1, 2], np.int32), np.array([1, 2, 0], np.int32),
+                     num_vertices=3)
+    fused = BucketedModePlan.from_edges(*g1e, num_vertices=3)
+    assert fused.send_idx is not None and fused.msg_idx is None
+    with pytest.raises(ValueError, match="mismatch"):
+        lpa_superstep_bucketed(jnp.arange(3, dtype=jnp.int32), g2, fused)
+    with pytest.raises(ValueError, match="fused"):
+        bucketed_mode(fused, jnp.zeros(4, jnp.int32), jnp.zeros(3, jnp.int32))
+    with pytest.raises(ValueError, match="equal-length"):
+        BucketedModePlan.from_edges(np.array([0]), np.array([1, 2]), num_vertices=3)
