@@ -84,18 +84,18 @@ def message_ptr(
     return ptr
 
 
-def build_graph(src, dst, num_vertices: int | None = None, symmetric: bool = True) -> Graph:
-    """Build a :class:`Graph` from endpoint arrays (host-side, NumPy).
+def _message_csr(src, dst, num_vertices, symmetric, use_native=True):
+    """(ptr int64 [V+1], recv_sorted, send_sorted int32 [M]) — messages
+    grouped by receiver, stable order. Native counting sort when available."""
+    if use_native:
+        from graphmine_tpu.io import native
 
-    ``symmetric=True`` reproduces the undirected message flow of GraphX LPA
-    (both directions of every edge, duplicates kept — ``Graphframes.py:81``).
-    """
-    src = np.asarray(src, dtype=np.int32)
-    dst = np.asarray(dst, dtype=np.int32)
-    if src.shape != dst.shape or src.ndim != 1:
-        raise ValueError("src/dst must be equal-length 1-D arrays")
-    if num_vertices is None:
-        num_vertices = int(max(src.max(initial=-1), dst.max(initial=-1))) + 1
+        out = native.build_message_csr(src, dst, num_vertices, symmetric)
+        if out is not None:
+            ptr, recv, send = out
+            if ptr[-1] >= np.iinfo(np.int32).max:
+                raise ValueError("message count exceeds int32; shard the build")
+            return ptr, recv, send
     if symmetric:
         recv = np.concatenate([dst, src])
         send = np.concatenate([src, dst])
@@ -103,7 +103,28 @@ def build_graph(src, dst, num_vertices: int | None = None, symmetric: bool = Tru
         recv, send = dst, src
     order = np.argsort(recv, kind="stable")
     ptr = message_ptr(src, dst, num_vertices, symmetric, recv=recv)
-    recv, send = recv[order], send[order]
+    return ptr, recv[order], send[order]
+
+
+def build_graph(
+    src, dst, num_vertices: int | None = None, symmetric: bool = True,
+    use_native: bool = True,
+) -> Graph:
+    """Build a :class:`Graph` from endpoint arrays (host-side).
+
+    ``symmetric=True`` reproduces the undirected message flow of GraphX LPA
+    (both directions of every edge, duplicates kept — ``Graphframes.py:81``).
+    The message grouping uses the native C++ counting-sort builder
+    (``native/graph_builder.cpp``, O(M+V)) when built, else a NumPy stable
+    argsort (O(M log M)); both produce byte-identical layouts (tested).
+    """
+    src = np.asarray(src, dtype=np.int32)
+    dst = np.asarray(dst, dtype=np.int32)
+    if src.shape != dst.shape or src.ndim != 1:
+        raise ValueError("src/dst must be equal-length 1-D arrays")
+    if num_vertices is None:
+        num_vertices = int(max(src.max(initial=-1), dst.max(initial=-1))) + 1
+    ptr, recv, send = _message_csr(src, dst, num_vertices, symmetric, use_native)
     return Graph(
         src=jnp.asarray(src),
         dst=jnp.asarray(dst),

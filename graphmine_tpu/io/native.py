@@ -56,6 +56,24 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.gb_free.argtypes = [ctypes.c_void_p]
     lib.gb_free_names.restype = None
     lib.gb_free_names.argtypes = [ctypes.POINTER(ctypes.c_char_p), ctypes.c_int64]
+    # int gb_build_message_csr(const int32* src, const int32* dst, int64 e,
+    #                          int64 v, int symmetric, int64* ptr,
+    #                          int32* recv_sorted, int32* send_sorted)
+    # Absent from pre-counting-sort builds of the library; bind when
+    # present so a stale .so still serves the edge-list loader.
+    if not hasattr(lib, "gb_build_message_csr"):
+        return
+    lib.gb_build_message_csr.restype = ctypes.c_int
+    lib.gb_build_message_csr.argtypes = [
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+    ]
 
 
 def available() -> bool:
@@ -92,3 +110,32 @@ def load_edge_list_native(path: str, comments: str = "#"):
         lib.gb_free(dst_p)
         lib.gb_free_names(names_p, nv)
     return EdgeTable(src=src, dst=dst, names=names, num_rows_raw=int(ne))
+
+
+def build_message_csr(src, dst, num_vertices: int, symmetric: bool = True):
+    """Native stable counting-sort message-CSR build.
+
+    Returns ``(ptr int64 [V+1], recv_sorted int32 [M], send_sorted int32
+    [M])`` matching the NumPy layout in ``container.build_graph`` exactly
+    (asserted by tests), or ``None`` when the library is unavailable.
+    Raises ``ValueError`` on out-of-range endpoints (parity with the
+    bounds implied by ``num_vertices``).
+    """
+    lib = _lib()
+    if lib is None or not hasattr(lib, "gb_build_message_csr"):
+        return None
+    src = np.ascontiguousarray(src, dtype=np.int32)
+    dst = np.ascontiguousarray(dst, dtype=np.int32)
+    if src.shape != dst.shape or src.ndim != 1:
+        raise ValueError("src/dst must be equal-length 1-D arrays")
+    e = len(src)
+    m = 2 * e if symmetric else e
+    ptr = np.empty(num_vertices + 1, dtype=np.int64)
+    recv_sorted = np.empty(max(m, 1), dtype=np.int32)
+    send_sorted = np.empty(max(m, 1), dtype=np.int32)
+    rc = lib.gb_build_message_csr(
+        src, dst, e, num_vertices, int(symmetric), ptr, recv_sorted, send_sorted
+    )
+    if rc != 0:
+        raise ValueError("edge endpoint out of range [0, num_vertices)")
+    return ptr, recv_sorted[:m], send_sorted[:m]

@@ -76,19 +76,13 @@ class BucketedModePlan:
         :func:`graphmine_tpu.graph.container.build_graph` (messages grouped
         by receiver, stable order). Includes the fused-gather ``send_idx``
         plan."""
-        from graphmine_tpu.graph.container import message_ptr
+        from graphmine_tpu.graph.container import _message_csr
 
         src = np.asarray(src, dtype=np.int32)
         dst = np.asarray(dst, dtype=np.int32)
         if src.shape != dst.shape or src.ndim != 1:
             raise ValueError("src/dst must be equal-length 1-D arrays")
-        if symmetric:
-            recv = np.concatenate([dst, src])
-            send = np.concatenate([src, dst])
-        else:
-            recv, send = dst, src
-        ptr = message_ptr(src, dst, num_vertices, symmetric, recv=recv)
-        send_sorted = send[np.argsort(recv, kind="stable")]
+        ptr, _, send_sorted = _message_csr(src, dst, num_vertices, symmetric)
         return cls.from_ptr(ptr, num_vertices, send_sorted)
 
     @classmethod

@@ -63,3 +63,22 @@ def test_native_large_roundtrip(tmp_path, rng):
     # decode back through names and compare to the original ids
     back_src = np.array([et.names[i] for i in et.src])
     assert (back_src == np.array([f"v{s}" for s in src])).all()
+
+
+def test_native_message_csr_matches_numpy():
+    from graphmine_tpu.graph.container import _message_csr
+    from graphmine_tpu.io import native
+
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(3)
+    src = rng.integers(0, 50, 400).astype(np.int32)
+    dst = rng.integers(0, 50, 400).astype(np.int32)
+    for sym in (True, False):
+        pn, rn, sn = _message_csr(src, dst, 50, sym, use_native=True)
+        pp, rp, sp = _message_csr(src, dst, 50, sym, use_native=False)
+        np.testing.assert_array_equal(pn, pp)
+        np.testing.assert_array_equal(rn, rp)
+        np.testing.assert_array_equal(sn, sp)
+    with pytest.raises(ValueError):
+        native.build_message_csr(np.array([99], np.int32), np.array([0], np.int32), 50)
