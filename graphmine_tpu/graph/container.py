@@ -87,6 +87,11 @@ def message_ptr(
 def _message_csr(src, dst, num_vertices, symmetric, use_native=True):
     """(ptr int64 [V+1], recv_sorted, send_sorted int32 [M]) — messages
     grouped by receiver, stable order. Native counting sort when available."""
+    if len(src) and (
+        min(src.min(), dst.min()) < 0
+        or max(src.max(), dst.max()) >= num_vertices
+    ):
+        raise ValueError(f"edge endpoint out of range [0, {num_vertices})")
     if use_native:
         from graphmine_tpu.io import native
 

@@ -145,3 +145,36 @@ def test_kcore_clique_plus_tail():
     src, dst = np.array(edges, np.int32).T
     g = build_graph(src, dst, num_vertices=6)
     np.testing.assert_array_equal(np.asarray(core_numbers(g)), [3, 3, 3, 3, 1, 0])
+
+
+def test_build_graph_rejects_out_of_range_endpoints():
+    import pytest
+
+    from graphmine_tpu.graph.container import build_graph
+
+    for use_native in (True, False):
+        with pytest.raises(ValueError, match="range"):
+            build_graph(np.array([5], np.int32), np.array([0], np.int32),
+                        num_vertices=3, symmetric=False, use_native=use_native)
+
+
+def test_build_graph_and_plan_shares_csr():
+    import jax
+    import jax.numpy as jnp
+
+    from graphmine_tpu.ops.bucketed_mode import (
+        build_graph_and_plan,
+        lpa_superstep_bucketed,
+    )
+    from graphmine_tpu.ops.lpa import lpa_superstep
+
+    rng = np.random.default_rng(2)
+    src = rng.integers(0, 64, 300).astype(np.int32)
+    dst = rng.integers(0, 64, 300).astype(np.int32)
+    g, plan = build_graph_and_plan(src, dst, num_vertices=64)
+    assert plan.send_idx is not None
+    labels = jnp.asarray(rng.integers(0, 64, 64).astype(np.int32))
+    np.testing.assert_array_equal(
+        np.asarray(jax.jit(lpa_superstep)(labels, g)),
+        np.asarray(jax.jit(lpa_superstep_bucketed)(labels, g, plan)),
+    )
