@@ -123,13 +123,24 @@ def build_graph(
     (``native/graph_builder.cpp``, O(M+V)) when built, else a NumPy stable
     argsort (O(M log M)); both produce byte-identical layouts (tested).
     """
+    src, dst, num_vertices = _prepare_edges(src, dst, num_vertices)
+    ptr, recv, send = _message_csr(src, dst, num_vertices, symmetric, use_native)
+    return _graph_from_csr(src, dst, ptr, recv, send, num_vertices, symmetric)
+
+
+def _prepare_edges(src, dst, num_vertices):
+    """Shared endpoint coercion/validation/V-inference for graph builders."""
     src = np.asarray(src, dtype=np.int32)
     dst = np.asarray(dst, dtype=np.int32)
     if src.shape != dst.shape or src.ndim != 1:
         raise ValueError("src/dst must be equal-length 1-D arrays")
     if num_vertices is None:
         num_vertices = int(max(src.max(initial=-1), dst.max(initial=-1))) + 1
-    ptr, recv, send = _message_csr(src, dst, num_vertices, symmetric, use_native)
+    return src, dst, num_vertices
+
+
+def _graph_from_csr(src, dst, ptr, recv, send, num_vertices, symmetric) -> Graph:
+    """Assemble the device-resident Graph from a host-built message CSR."""
     return Graph(
         src=jnp.asarray(src),
         dst=jnp.asarray(dst),

@@ -123,33 +123,23 @@ class BucketedModePlan:
 
 
 def build_graph_and_plan(
-    src, dst, num_vertices: int | None = None, symmetric: bool = True
+    src, dst, num_vertices: int | None = None, symmetric: bool = True,
+    use_native: bool = True,
 ):
     """Build the :class:`Graph` and its fused plan from ONE message-CSR
     pass — the pipeline's single-device fast path. Calling
     :func:`~graphmine_tpu.graph.container.build_graph` and
     :meth:`BucketedModePlan.from_edges` separately runs the counting sort
     twice over the same edges; this shares it."""
-    import jax.numpy as jnp
-
-    from graphmine_tpu.graph.container import _message_csr
-
-    src = np.asarray(src, dtype=np.int32)
-    dst = np.asarray(dst, dtype=np.int32)
-    if src.shape != dst.shape or src.ndim != 1:
-        raise ValueError("src/dst must be equal-length 1-D arrays")
-    if num_vertices is None:
-        num_vertices = int(max(src.max(initial=-1), dst.max(initial=-1))) + 1
-    ptr, recv, send = _message_csr(src, dst, num_vertices, symmetric)
-    graph = Graph(
-        src=jnp.asarray(src),
-        dst=jnp.asarray(dst),
-        msg_recv=jnp.asarray(recv),
-        msg_send=jnp.asarray(send),
-        msg_ptr=jnp.asarray(ptr.astype(np.int32)),
-        num_vertices=num_vertices,
-        symmetric=symmetric,
+    from graphmine_tpu.graph.container import (
+        _graph_from_csr,
+        _message_csr,
+        _prepare_edges,
     )
+
+    src, dst, num_vertices = _prepare_edges(src, dst, num_vertices)
+    ptr, recv, send = _message_csr(src, dst, num_vertices, symmetric, use_native)
+    graph = _graph_from_csr(src, dst, ptr, recv, send, num_vertices, symmetric)
     return graph, BucketedModePlan.from_ptr(ptr, num_vertices, send)
 
 

@@ -1,4 +1,4 @@
-from graphmine_tpu.parallel.mesh import make_mesh, make_multislice_mesh
+from graphmine_tpu.parallel.mesh import initialize_distributed, make_mesh, make_multislice_mesh
 from graphmine_tpu.parallel.ring import (
     ring_connected_components,
     ring_label_propagation,
@@ -13,6 +13,7 @@ from graphmine_tpu.parallel.sharded import (
 )
 
 __all__ = [
+    "initialize_distributed",
     "make_mesh",
     "make_multislice_mesh",
     "ShardedGraph",

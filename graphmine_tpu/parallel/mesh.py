@@ -67,3 +67,35 @@ def make_multislice_mesh(
         raise ValueError(f"requested {need} devices, only {len(devices)} visible")
     grid = np.asarray(devices[:need]).reshape(num_slices, chips_per_slice)
     return Mesh(grid, (DCN_AXIS, ICI_AXIS))
+
+
+def initialize_distributed(**kw) -> bool:
+    """Multi-host bootstrap: ``jax.distributed.initialize`` with idempotence.
+
+    Call once per host before building meshes on a multi-host fleet (the
+    coordinator address etc. come from the environment on TPU pods / SLURM
+    via jax's own cluster auto-detection, or pass ``coordinator_address=``/
+    ``num_processes=``/``process_id=`` explicitly). Returns True when the
+    distributed runtime is (now) initialized, False when running
+    single-process (no coordinator detectable) — callers can use the same
+    code path either way, as jax.devices() reflects the fleet exactly when
+    initialization happened. Explicit kwargs that fail to initialize raise.
+    """
+    import jax
+
+    if jax.distributed.is_initialized():
+        return True
+    try:
+        jax.distributed.initialize(**kw)
+    except ValueError as e:
+        # Swallow exactly the benign no-cluster case ("coordinator_address
+        # should be defined": nothing auto-detectable, nothing requested).
+        # Every other failure — explicit kwargs, a partially-configured
+        # cluster via JAX_* env vars ("Number of processes must be
+        # defined."), RuntimeError from a detected-but-unreachable
+        # coordinator — propagates, so a degraded pod run can never
+        # silently continue as N independent single-process runs.
+        if kw or "coordinator_address should be defined" not in str(e):
+            raise
+        return False
+    return True

@@ -31,3 +31,56 @@ def load_labels(checkpoint_dir: str, tag: str = "lpa"):
         return None
     with np.load(path) as z:
         return z["labels"], int(z["iteration"])
+
+
+def save_sharded(checkpoint_dir: str, labels, iteration: int, tag: str = "lpa") -> str:
+    """Orbax save of (labels, iteration) — the multi-host path.
+
+    Unlike :func:`save_labels` (single-host npz), orbax writes each shard
+    from its owning host (async-capable, atomic via its own finalization
+    protocol), so a DCN-spanning run checkpoints without gathering the
+    label vector to one host. Same state contents as the npz path; the two
+    are interchangeable for single-host runs.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(os.path.join(checkpoint_dir, f"{tag}_orbax"))
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(
+            path,
+            {"labels": labels, "iteration": np.int64(iteration)},
+            force=True,
+        )
+    return path
+
+
+def load_sharded(checkpoint_dir: str, tag: str = "lpa", sharding=None):
+    """Restore an orbax checkpoint; returns (labels, iteration) or None.
+
+    ``sharding``: optional ``jax.sharding.Sharding`` to restore the label
+    array directly into (device-resident, correctly placed on the mesh —
+    no host bounce). Defaults to host numpy.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(os.path.join(checkpoint_dir, f"{tag}_orbax"))
+    if not os.path.exists(path):
+        return None
+    with ocp.StandardCheckpointer() as ckptr:
+        if sharding is None:
+            state = ckptr.restore(path)
+        else:
+            import jax
+
+            meta = ckptr.metadata(path)
+            # StandardCheckpointer.metadata returns StepMetadata in newer
+            # orbax (tree under .item_metadata) and the raw tree in older.
+            meta = getattr(meta, "item_metadata", meta)["labels"]
+            tpl = {
+                "labels": jax.ShapeDtypeStruct(
+                    meta.shape, meta.dtype, sharding=sharding
+                ),
+                "iteration": 0,
+            }
+            state = ckptr.restore(path, tpl)
+    return state["labels"], int(state["iteration"])

@@ -20,11 +20,17 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from graphmine_tpu.graph.container import Graph
+from graphmine_tpu.graph.container import Graph, graph_from_edge_table
 from graphmine_tpu.io.edges import EdgeTable, load_edge_list, load_parquet_edges
 from graphmine_tpu.pipeline import checkpoint as ckpt
 from graphmine_tpu.pipeline.config import PipelineConfig
 from graphmine_tpu.pipeline.metrics import MetricsSink, maybe_profile
+
+
+def _visible_devices() -> int:
+    import jax
+
+    return len(jax.devices())
 
 
 @dataclass
@@ -57,14 +63,27 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
     )
 
     # ---- CS-2 graph construction ---------------------------------------
-    # One message-CSR pass feeds both the Graph and the fused LPA plan
-    # (ops/bucketed_mode.py — the single-device fast path).
-    from graphmine_tpu.ops.bucketed_mode import build_graph_and_plan
-
+    # The fused LPA plan is only consumed by the single-device jax LPA
+    # path; build it (from the same message-CSR pass as the Graph) only
+    # when that path will run — it is pure HBM/host waste for louvain,
+    # graphframes, and sharded runs. n_dev is resolved once here and passed
+    # to _run_lpa so the build-plan and use-plan predicates cannot diverge.
+    n_dev = config.num_devices or _visible_devices()
+    wants_plan = (
+        config.community_method == "lpa"
+        and config.backend != "graphframes"
+        and n_dev <= 1
+    )
     with m.timed("build_graph"):
-        graph, mode_plan = build_graph_and_plan(
-            table.src, table.dst, num_vertices=table.num_vertices
-        )
+        if wants_plan:
+            from graphmine_tpu.ops.bucketed_mode import build_graph_and_plan
+
+            graph, mode_plan = build_graph_and_plan(
+                table.src, table.dst, num_vertices=table.num_vertices
+            )
+        else:
+            graph = graph_from_edge_table(table)
+            mode_plan = None
 
     # ---- CS-3 community detection --------------------------------------
     if config.community_method == "louvain":
@@ -76,7 +95,7 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
         with m.timed("louvain", gamma=config.gamma):
             labels, q = louvain(graph, gamma=config.gamma)
     else:
-        labels = _run_lpa(config, table, graph, m, mode_plan)
+        labels = _run_lpa(config, table, graph, m, mode_plan, n_dev)
         q = None
 
     # ---- CS-4 census ----------------------------------------------------
@@ -135,7 +154,7 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
 
 def _run_lpa(
     config: PipelineConfig, table: EdgeTable, graph: Graph, m: MetricsSink,
-    mode_plan=None,
+    mode_plan, n_dev: int,
 ):
     """Community detection with backend dispatch, checkpointing and
     per-iteration metrics. Runs iterations one jit call at a time so the
@@ -157,7 +176,6 @@ def _run_lpa(
         sharded_label_propagation,
     )
 
-    n_dev = config.num_devices or len(jax.devices())
     chips = max(n_dev, 1)
     start_iter = 0
     labels = jnp.arange(graph.num_vertices, dtype=jnp.int32)
@@ -187,18 +205,14 @@ def _run_lpa(
     else:
         # Fused degree-bucketed kernel (ops/bucketed_mode.py): ~3x the
         # sort-based superstep, identical labels. The plan was built
-        # alongside the Graph from one shared message-CSR pass.
-        from graphmine_tpu.ops.bucketed_mode import (
-            BucketedModePlan,
-            lpa_superstep_bucketed,
-        )
+        # alongside the Graph from one shared message-CSR pass
+        # (wants_plan in run_pipeline is true exactly for this branch).
+        from graphmine_tpu.ops.bucketed_mode import lpa_superstep_bucketed
 
+        if mode_plan is None:
+            raise ValueError("single-device LPA requires the fused plan "
+                             "built by run_pipeline (wants_plan)")
         plan = mode_plan
-        if plan is None:
-            with m.timed("mode_plan"):
-                plan = BucketedModePlan.from_edges(
-                    np.asarray(table.src), np.asarray(table.dst), graph.num_vertices
-                )
         step = jax.jit(lpa_superstep_bucketed)
 
         def one_iter(lbl):

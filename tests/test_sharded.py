@@ -148,3 +148,28 @@ def test_multislice_mesh_lpa_cc_pagerank_parity():
         np.asarray(pagerank(g_dir, max_iter=60)),
         atol=1e-5,
     )
+
+
+def test_determinism_across_runs_and_shardings(mesh8):
+    """SURVEY §5 race-detection story: same input => bit-identical labels
+    across repeated runs and across sharding layouts."""
+    import numpy as np
+    from graphmine_tpu.graph.container import build_graph
+    from graphmine_tpu.ops.lpa import label_propagation
+    from graphmine_tpu.parallel.sharded import (
+        partition_graph,
+        shard_graph_arrays,
+        sharded_label_propagation,
+    )
+
+    rng = np.random.default_rng(42)
+    v, e = 120, 480
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    g = build_graph(src, dst, num_vertices=v)
+    a = np.asarray(label_propagation(g, max_iter=4))
+    b = np.asarray(label_propagation(g, max_iter=4))
+    np.testing.assert_array_equal(a, b)
+    sg = shard_graph_arrays(partition_graph(g, mesh=mesh8), mesh8)
+    c = np.asarray(sharded_label_propagation(sg, mesh8, max_iter=4))
+    np.testing.assert_array_equal(a, c)
