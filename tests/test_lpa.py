@@ -160,3 +160,34 @@ def test_fused_plan_mismatch_and_bad_edges_raise():
         bucketed_mode(fused, jnp.zeros(4, jnp.int32), jnp.zeros(3, jnp.int32))
     with pytest.raises(ValueError, match="equal-length"):
         BucketedModePlan.from_edges(np.array([0]), np.array([1, 2]), num_vertices=3)
+
+
+def test_bucketed_hist_path_matches_sort_based(rng, monkeypatch):
+    """Mega-hub histogram mode (fused plans, degree > _HIST_MIN_DEG) agrees
+    with the reference superstep — threshold lowered so small graphs hit it,
+    including the budget cap that spills overflow hubs back to sort rows."""
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+
+    bm = importlib.import_module("graphmine_tpu.ops.bucketed_mode")
+
+    monkeypatch.setattr(bm, "_HIST_MIN_DEG", 8)
+    v, e = 200, 4000  # several vertices with degree > 8
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    g = build_graph(src, dst, num_vertices=v)
+    plan = bm.BucketedModePlan.from_edges(src, dst, v)
+    assert plan.hist_vertex_ids is not None and plan.hist_vertex_ids.size > 0
+    labels = jnp.asarray(rng.integers(0, v, v).astype(np.int32))
+    want = np.asarray(jax.jit(lpa_superstep)(labels, g))
+    got = np.asarray(jax.jit(bm.lpa_superstep_bucketed)(labels, g, plan))
+    np.testing.assert_array_equal(want, got)
+
+    # budget cap: allow only 2 hub histograms; rest must spill to buckets
+    monkeypatch.setattr(bm, "_HIST_BUDGET", 2 * v)
+    plan2 = bm.BucketedModePlan.from_edges(src, dst, v)
+    assert plan2.hist_vertex_ids is not None and plan2.hist_vertex_ids.size == 2
+    got2 = np.asarray(jax.jit(bm.lpa_superstep_bucketed)(labels, g, plan2))
+    np.testing.assert_array_equal(want, got2)
