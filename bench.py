@@ -8,8 +8,15 @@ the north-star target is "LPA on a 100M-edge graph converges < 60 s on a
 TPU v4-8" (8 chips). Reading that conservatively as 5 supersteps (the
 reference's maxIter, Graphframes.py:81) in 60 s: 100e6 edges x 5 iters /
 (60 s x 8 chips) ≈ 1.04e6 edges/sec/chip. vs_baseline > 1 beats it.
+
+``--tier northstar`` runs the north-star config itself — 100M directed
+edges, LPA(maxIter=5) — as a single-device jit and reports seconds for
+the five compiled supersteps (host build and first-compile broken out in
+``detail``); under 60 is the target BASELINE.json budgets EIGHT v4 chips
+for.
 """
 
+import argparse
 import json
 import os
 import time
@@ -18,7 +25,8 @@ import numpy as np
 
 BASELINE_EDGES_PER_SEC_PER_CHIP = 100e6 * 5 / (60.0 * 8)
 
-# Sized for a single chip: ~8.4M directed edges -> 16.8M messages.
+# Default tier, sized for a single chip: ~8.4M directed edges -> 16.8M
+# messages. The northstar tier overrides these.
 NUM_VERTICES = 1 << 20
 NUM_EDGES = 1 << 23
 ITERS = 10
@@ -36,12 +44,12 @@ def powerlaw_edges(v: int, e: int, seed: int = 0):
     return ids[:e], ids[e:]
 
 
-def main() -> None:
+def _setup_jax_cache():
+    """Persistent compile cache: the superstep program at bench sizes is
+    expensive to compile on TPU; repeat bench runs should pay it once.
+    Returns the fused-kernel entry points both tiers use."""
     import jax
-    import jax.numpy as jnp
 
-    # Persistent compile cache: the superstep program at this size is
-    # expensive to compile on TPU; repeat bench runs should pay it once.
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
@@ -50,6 +58,77 @@ def main() -> None:
         build_graph_and_plan,
         lpa_superstep_bucketed,
     )
+
+    return build_graph_and_plan, lpa_superstep_bucketed
+
+
+def main_northstar() -> None:
+    """North-star config (BASELINE.json): LPA(maxIter=5) over 100M edges.
+
+    Single-device jit on jax.devices()[0] (chips=1 in the output records
+    that; the budgeted target hardware is a v4-8). The headline value is
+    the five compiled supersteps only — host graph generation/build and
+    the one-off first compile are reported separately in ``detail``."""
+    import jax
+    import jax.numpy as jnp
+
+    build_graph_and_plan, lpa_superstep_bucketed = _setup_jax_cache()
+
+    v, e, iters = 1 << 24, 100_000_000, 5
+    t0 = time.perf_counter()
+    src, dst = powerlaw_edges(v, e)
+    t_gen = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    graph, plan = build_graph_and_plan(src, dst, num_vertices=v)
+    t_build = time.perf_counter() - t0
+
+    raw_step = jax.jit(lpa_superstep_bucketed)
+    labels = jnp.arange(v, dtype=jnp.int32)
+    t0 = time.perf_counter()
+    labels = raw_step(labels, graph, plan)   # includes compile
+    np.asarray(labels[:8])
+    t_compile = time.perf_counter() - t0
+
+    labels = jnp.arange(v, dtype=jnp.int32)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        labels = raw_step(labels, graph, plan)
+    np.asarray(labels[:8])
+    dt = time.perf_counter() - t0
+
+    chips = 1
+    print(
+        json.dumps(
+            {
+                "metric": "lpa_100m_maxiter5_seconds",
+                "value": round(dt, 3),
+                "unit": "s",
+                # target: < 60 s on a v4-8 (8 chips). vs_baseline is the
+                # plain 60s-target ratio; "chips" below records that this
+                # run used a fraction of the budgeted hardware.
+                "vs_baseline": round(60.0 / dt, 3),
+                "detail": {
+                    "num_vertices": v,
+                    "num_edges": e,
+                    "iters": iters,
+                    "chips": chips,
+                    "edges_per_sec_per_chip": round(e * iters / dt / chips),
+                    "gen_seconds": round(t_gen, 1),
+                    "build_seconds": round(t_build, 1),
+                    "first_iter_with_compile_seconds": round(t_compile, 1),
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    build_graph_and_plan, lpa_superstep_bucketed = _setup_jax_cache()
 
     src, dst = powerlaw_edges(NUM_VERTICES, NUM_EDGES)
     # Fused degree-bucketed kernel (ops/bucketed_mode.py): ~3x the sort-
@@ -101,4 +180,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tier", choices=["chip", "northstar"], default="chip")
+    args = ap.parse_args()
+    main_northstar() if args.tier == "northstar" else main()
