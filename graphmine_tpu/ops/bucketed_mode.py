@@ -65,6 +65,27 @@ def _extend_widths(max_deg: int) -> np.ndarray:
     return np.asarray(ws, dtype=np.int64)
 
 
+def _class_rows(ptr, deg, eligible, classes, c, w, values, fill, num_values):
+    """Rows and padded [n, w] gather matrix for one width class (host).
+
+    The single source of truth for bucket-row construction, shared by
+    :meth:`BucketedModePlan.from_ptr` and the sharded plan builder
+    (``parallel/sharded.py``) so the two stay semantically identical.
+    ``values=None`` emits message *indices* (non-fused plans); otherwise
+    ``values`` is gathered (fused plans: sender ids). Padding slots get
+    ``fill``.
+    """
+    rows = np.nonzero((classes == c) & eligible)[0]
+    offs = np.arange(w, dtype=np.int64)[None, :]
+    idx = ptr[rows][:, None] + offs
+    valid = offs < deg[rows][:, None]
+    if values is None:
+        mat = np.where(valid, idx, fill)
+    else:
+        mat = np.where(valid, values[np.minimum(idx, max(num_values - 1, 0))], fill)
+    return rows, mat.astype(np.int32)
+
+
 @jax.tree_util.register_dataclass
 @dataclass(frozen=True)
 class BucketedModePlan:
@@ -144,19 +165,14 @@ class BucketedModePlan:
         vertex_ids, msg_idx, send_idx = [], [], []
         bucketed = (deg > 0) & ~hist_mask
         for c in np.unique(classes[bucketed]):
-            ids = np.nonzero((classes == c) & bucketed)[0].astype(np.int32)
-            w = int(widths[c])
-            offs = np.arange(w, dtype=np.int64)[None, :]
-            idx = ptr[ids][:, None] + offs
-            valid = offs < deg[ids][:, None]
-            vertex_ids.append(jnp.asarray(ids))
-            if send_sorted is not None:
-                # Fused plan: only sender-id matrices go to device — the
-                # msg_idx matrices would double plan HBM and never be read.
-                s = send_sorted[np.minimum(idx, m - 1)]
-                send_idx.append(jnp.asarray(np.where(valid, s, num_vertices).astype(np.int32)))
-            else:
-                msg_idx.append(jnp.asarray(np.where(valid, idx, m).astype(np.int32)))
+            # Fused plans carry only sender-id matrices — msg_idx would
+            # double plan HBM and never be read.
+            ids, mat = _class_rows(
+                ptr, deg, bucketed, classes, c, int(widths[c]),
+                send_sorted, num_vertices if send_sorted is not None else m, m,
+            )
+            vertex_ids.append(jnp.asarray(ids.astype(np.int32)))
+            (msg_idx if send_sorted is None else send_idx).append(jnp.asarray(mat))
 
         hist_vertex_ids = hist_send = hist_row_offset = None
         if hist_mask.any():

@@ -71,6 +71,16 @@ class ShardedGraph:
     num_vertices: int = dataclasses.field(metadata=dict(static=True))
     chunk_size: int = dataclasses.field(metadata=dict(static=True))
     num_shards: int = dataclasses.field(metadata=dict(static=True))
+    # Stacked degree-bucket plan for the fast LPA shard body (see
+    # ops/bucketed_mode.py for the single-device analysis): per width
+    # class c, bucket_send[c] is int32 [D, n_c, w_c] of global sender ids
+    # (padding rows/slots = padded_vertices, the label sentinel slot) and
+    # bucket_target[c] is int32 [D, n_c] of LOCAL owned-vertex indices
+    # (padding rows = chunk_size, dropped by the scatter). Shapes are
+    # uniform across shards — SPMD requires one program. Empty tuples =
+    # no plan; the sort-based segment_mode body is used instead.
+    bucket_send: tuple = ()
+    bucket_target: tuple = ()
 
     @property
     def padded_vertices(self) -> int:
@@ -84,11 +94,16 @@ def partition_graph(
     num_shards: int | None = None,
     mesh=None,
     pad_multiple: int = 8,
+    build_bucket_plan: bool = False,
 ) -> ShardedGraph:
     """Partition a graph's message CSR into vertex-range shards (host-side).
 
     Accepts either a :class:`Graph` or raw ``(src, dst)`` arrays. The shard
-    count comes from ``num_shards`` or ``mesh``.
+    count comes from ``num_shards`` or ``mesh``. ``build_bucket_plan``
+    precomputes the stacked degree-bucket plan the fast LPA shard body
+    uses (host work + its own HBM, amortized once per graph like the CSR
+    itself) — opt in when the partition feeds LPA; CC/PageRank/ring
+    consumers never read it.
     """
     if mesh is not None and num_shards is None:
         num_shards = mesh.size
@@ -125,6 +140,12 @@ def partition_graph(
     # recv ids beyond num_vertices never occur; reshape covers padded tail
     deg[:, :] = deg_flat.reshape(d, vc)
 
+    bucket_send, bucket_target = (), ()
+    if build_bucket_plan:
+        bucket_send, bucket_target = _build_shard_bucket_plan(
+            deg, send_pad, counts, vc, d
+        )
+
     return ShardedGraph(
         msg_recv_local=jnp.asarray(recv_local),
         msg_send=jnp.asarray(send_pad),
@@ -132,12 +153,57 @@ def partition_graph(
         num_vertices=num_vertices,
         chunk_size=vc,
         num_shards=d,
+        bucket_send=bucket_send,
+        bucket_target=bucket_target,
     )
+
+
+def _build_shard_bucket_plan(deg, send_pad, counts, chunk_size, d):
+    """Stacked per-shard degree-bucket plan with uniform shapes.
+
+    Every shard's owned vertices are bucketed on the shared 1.5x width
+    ladder (``ops/bucketed_mode._extend_widths``); per class the row count
+    is padded to the max across shards so one SPMD program serves all
+    devices. No histogram path here — a per-shard [n, V] count matrix
+    would replicate per device; mega-hubs ride wide sort rows instead.
+    """
+    from graphmine_tpu.ops.bucketed_mode import _class_rows, _extend_widths
+
+    sentinel_send = chunk_size * d          # the label sentinel slot
+    widths = _extend_widths(int(deg.max(initial=1)))
+    classes = np.searchsorted(widths, np.maximum(deg, 1))  # [d, vc]
+    # local CSR start of each owned vertex inside its shard's message run
+    ptr = np.zeros((d, chunk_size), dtype=np.int64)
+    np.cumsum(deg[:, :-1], axis=1, out=ptr[:, 1:])
+
+    bucket_send, bucket_target = [], []
+    for c in np.unique(classes[deg > 0]):
+        w = int(widths[c])
+        per_shard = [
+            _class_rows(
+                ptr[s], deg[s], deg[s] > 0, classes[s], c, w,
+                send_pad[s], sentinel_send, int(counts[s]),
+            )
+            for s in range(d)
+        ]
+        n_c = max(len(rows) for rows, _ in per_shard)
+        send_c = np.full((d, n_c, w), sentinel_send, dtype=np.int32)
+        # Padding rows get DISTINCT out-of-range targets (chunk_size + i):
+        # mode="drop" discards them, and unique_indices=True stays honest.
+        tgt_c = chunk_size + np.tile(np.arange(n_c, dtype=np.int32), (d, 1))
+        for s, (rows, mat) in enumerate(per_shard):
+            send_c[s, : len(rows)] = mat
+            tgt_c[s, : len(rows)] = rows
+        bucket_send.append(jnp.asarray(send_c))
+        bucket_target.append(jnp.asarray(tgt_c))
+    return tuple(bucket_send), tuple(bucket_target)
 
 
 def shard_graph_arrays(sg: ShardedGraph, mesh) -> ShardedGraph:
     """Place the per-shard arrays on the mesh (leading dim over the vertex axis)."""
-    spec = NamedSharding(mesh, P(_vertex_axes(mesh), None))
+    axes = _vertex_axes(mesh)
+    spec = NamedSharding(mesh, P(axes, None))
+    spec3 = NamedSharding(mesh, P(axes, None, None))
     return ShardedGraph(
         msg_recv_local=jax.device_put(sg.msg_recv_local, spec),
         msg_send=jax.device_put(sg.msg_send, spec),
@@ -145,6 +211,8 @@ def shard_graph_arrays(sg: ShardedGraph, mesh) -> ShardedGraph:
         num_vertices=sg.num_vertices,
         chunk_size=sg.chunk_size,
         num_shards=sg.num_shards,
+        bucket_send=tuple(jax.device_put(b, spec3) for b in sg.bucket_send),
+        bucket_target=tuple(jax.device_put(t, spec) for t in sg.bucket_target),
     )
 
 
@@ -175,6 +243,33 @@ def _lpa_shard_body(labels_full, recv_local, send, deg, *, chunk_size, axes):
     own = lax.dynamic_slice(labels_full, (start,), (chunk_size,))
     new_own = jnp.where(deg > 0, mode, own).astype(jnp.int32)
     return lax.all_gather(new_own, axes, tiled=True)
+
+
+def _lpa_shard_body_bucketed(
+    labels_full, bucket_send, bucket_target, *, chunk_size, axes
+):
+    """Fast LPA shard body: degree-bucketed dense mode per shard.
+
+    Same comms as :func:`_lpa_shard_body` (one tiled all_gather); the
+    shard-local reduction swaps the global segment-mode sort for the
+    bucketed plan (see ops/bucketed_mode.py — gather-bound analysis).
+    Padding rows gather the sentinel label and scatter to index
+    ``chunk_size``, which ``mode="drop"`` discards; vertices with no
+    messages are in no bucket and keep their label.
+    """
+    from graphmine_tpu.ops.bucketed_mode import _SENTINEL, _bucket_mode
+
+    lbl_pad = jnp.concatenate(
+        [labels_full, jnp.full((1,), _SENTINEL, jnp.int32)]
+    )
+    start = lax.axis_index(axes).astype(jnp.int32) * chunk_size
+    own = lax.dynamic_slice(labels_full, (start,), (chunk_size,))
+    for sidx, tgt in zip(bucket_send, bucket_target):
+        mat = lbl_pad[sidx[0]]
+        own = own.at[tgt[0]].set(
+            _bucket_mode(mat), unique_indices=True, mode="drop"
+        )
+    return lax.all_gather(own.astype(jnp.int32), axes, tiled=True)
 
 
 def _cc_shard_body(labels_full, recv_local, send, deg, *, chunk_size, axes):
@@ -236,20 +331,33 @@ def sharded_label_propagation(
     virtual-device parity tests). Returns int32 labels ``[V]``.
     """
     _check_mesh(sg, mesh)
-    in_specs, rep = _shard_specs(mesh)
-    body = jax.shard_map(
-        partial(_lpa_shard_body, chunk_size=sg.chunk_size, axes=_vertex_axes(mesh)),
-        mesh=mesh,
-        in_specs=in_specs,
-        out_specs=rep,
-        # The output is a tiled all_gather — replicated by construction,
-        # which the vma checker cannot infer statically.
-        check_vma=False,
-    )
+    axes = _vertex_axes(mesh)
+    rep = P()
+    if sg.bucket_send:
+        # Fast path: stacked degree-bucket plan (built by partition_graph).
+        n = len(sg.bucket_send)
+        body = jax.shard_map(
+            partial(_lpa_shard_body_bucketed, chunk_size=sg.chunk_size, axes=axes),
+            mesh=mesh,
+            in_specs=(rep, (P(axes, None, None),) * n, (P(axes, None),) * n),
+            out_specs=rep,
+            # The output is a tiled all_gather — replicated by construction,
+            # which the vma checker cannot infer statically.
+            check_vma=False,
+        )
+        step = lambda l: body(l, sg.bucket_send, sg.bucket_target)
+    else:
+        in_specs, _ = _shard_specs(mesh)
+        body = jax.shard_map(
+            partial(_lpa_shard_body, chunk_size=sg.chunk_size, axes=axes),
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=rep,
+            check_vma=False,
+        )
+        step = lambda l: body(l, sg.msg_recv_local, sg.msg_send, sg.degrees)
     labels = _padded_init_labels(sg) if init_labels is None else _pad_labels(init_labels, sg)
-    labels = _scan_supersteps(
-        lambda l: body(l, sg.msg_recv_local, sg.msg_send, sg.degrees), labels, max_iter
-    )
+    labels = _scan_supersteps(step, labels, max_iter)
     return labels[: sg.num_vertices]
 
 

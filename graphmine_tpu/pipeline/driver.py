@@ -197,7 +197,9 @@ def _run_lpa(
     if use_sharded:
         mesh = make_mesh(n_dev)
         with m.timed("partition", shards=n_dev):
-            sg = shard_graph_arrays(partition_graph(graph, mesh=mesh), mesh)
+            sg = shard_graph_arrays(
+                partition_graph(graph, mesh=mesh, build_bucket_plan=True), mesh
+            )
 
         def one_iter(lbl):
             return sharded_label_propagation(sg, mesh, max_iter=1, init_labels=lbl)

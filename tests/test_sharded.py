@@ -37,7 +37,9 @@ def test_sharded_lpa_matches_single_device(mesh8, rng):
         src, dst = _random_graph(rng, v, e)
         g = build_graph(src, dst, num_vertices=v)
         want = np.asarray(label_propagation(g, max_iter=4))
-        sg = shard_graph_arrays(partition_graph(g, mesh=mesh8), mesh8)
+        sg = shard_graph_arrays(
+            partition_graph(g, mesh=mesh8, build_bucket_plan=True), mesh8
+        )
         got = np.asarray(sharded_label_propagation(sg, mesh8, max_iter=4))
         np.testing.assert_array_equal(got, want)
 
@@ -173,3 +175,34 @@ def test_determinism_across_runs_and_shardings(mesh8):
     sg = shard_graph_arrays(partition_graph(g, mesh=mesh8), mesh8)
     c = np.asarray(sharded_label_propagation(sg, mesh8, max_iter=4))
     np.testing.assert_array_equal(a, c)
+
+
+def test_sort_fallback_body_matches_bucketed(mesh8):
+    """The sort-based shard body (default partition) and the bucketed one
+    (build_bucket_plan=True) must both agree with the single-device kernel."""
+    import numpy as np
+    from graphmine_tpu.graph.container import build_graph
+    from graphmine_tpu.ops.lpa import label_propagation
+    from graphmine_tpu.parallel.sharded import (
+        partition_graph,
+        shard_graph_arrays,
+        sharded_label_propagation,
+    )
+
+    rng = np.random.default_rng(7)
+    v, e = 96, 400
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    g = build_graph(src, dst, num_vertices=v)
+    want = np.asarray(label_propagation(g, max_iter=4))
+
+    fast = partition_graph(g, mesh=mesh8, build_bucket_plan=True)
+    assert fast.bucket_send
+    slow = partition_graph(g, mesh=mesh8)
+    assert not slow.bucket_send  # opt-in: default partition has no plan
+    got_fast = np.asarray(sharded_label_propagation(
+        shard_graph_arrays(fast, mesh8), mesh8, max_iter=4))
+    got_slow = np.asarray(sharded_label_propagation(
+        shard_graph_arrays(slow, mesh8), mesh8, max_iter=4))
+    np.testing.assert_array_equal(want, got_fast)
+    np.testing.assert_array_equal(want, got_slow)
