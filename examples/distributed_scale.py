@@ -1,0 +1,88 @@
+"""Distributed graph mining at scale: mesh, sharded supersteps, checkpoint.
+
+What the reference could never do — `SparkContext("local[*]")`
+(``Graphframes.py:12``) pinned it to one machine — expressed as the
+mesh-native equivalents this framework treats as first-class:
+
+1. multi-host bootstrap (no-op on one host, pods auto-detect)
+2. an ICI (or dcn×ici multi-slice) device mesh
+3. vertex-range-sharded label propagation with the degree-bucketed fast
+   kernel per shard (one tiled all_gather per superstep)
+4. the ring schedule when no device may hold the full label vector
+5. orbax checkpoint of distributed label state, restored onto the mesh
+
+Runs anywhere: on a laptop/CI set
+``XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu``
+to get 8 virtual devices (the TPU analog of ``local[*]``); on a real pod
+the same code spans every chip jax sees.
+
+Run:  python examples/distributed_scale.py
+"""
+
+import numpy as np
+
+import graphmine_tpu as gm
+from graphmine_tpu.datasets import rmat
+from graphmine_tpu.parallel import (
+    initialize_distributed,
+    make_mesh,
+    ring_label_propagation,
+    sharded_connected_components,
+    sharded_label_propagation,
+)
+from graphmine_tpu.parallel.sharded import partition_graph, shard_graph_arrays
+from graphmine_tpu.pipeline.checkpoint import load_sharded, save_sharded
+
+# ── 1. bootstrap ─────────────────────────────────────────────────────────
+# On a TPU pod each host calls this before touching devices; coordinator
+# details come from the environment. Single-process: returns False, same
+# code path continues.
+multi_host = initialize_distributed()
+print(f"multi-host: {multi_host}")
+
+import jax  # after initialize_distributed, so the fleet is visible
+
+print(f"devices: {len(jax.devices())}")
+
+# ── 2. mesh + graph ──────────────────────────────────────────────────────
+mesh = make_mesh()                       # all visible devices, 1-D ICI axis
+src, dst = rmat(scale=14, edge_factor=12, seed=7)
+v = 1 << 14
+
+# Host-side partition: vertex-range shards of the message CSR, plus the
+# stacked degree-bucket plan for the fast LPA shard body.
+sg = shard_graph_arrays(
+    partition_graph(src, dst, num_vertices=v, mesh=mesh, build_bucket_plan=True),
+    mesh,
+)
+
+# ── 3. sharded supersteps ────────────────────────────────────────────────
+labels = sharded_label_propagation(sg, mesh, max_iter=5)
+comps = sharded_connected_components(sg, mesh)
+print(f"communities: {len(np.unique(np.asarray(labels)))}")
+print(f"components:  {len(np.unique(np.asarray(comps)))}")
+
+# Parity guarantee (tested in tests/test_sharded.py): identical labels to
+# the single-device kernel, any mesh size, any shard body.
+g = gm.build_graph(src, dst, num_vertices=v)
+assert np.array_equal(np.asarray(labels), np.asarray(gm.label_propagation(g, max_iter=5)))
+
+# ── 4. ring schedule ─────────────────────────────────────────────────────
+# When V outgrows one device's HBM: labels stay sharded, each superstep
+# rotates label chunks around the mesh with ppermute (this domain's ring
+# attention). Same answer, bounded per-device memory.
+ring = ring_label_propagation(sg, mesh, max_iter=5)
+assert np.array_equal(np.asarray(ring), np.asarray(labels))
+
+# ── 5. checkpoint / resume ───────────────────────────────────────────────
+# Orbax writes each shard from its owning host (multi-host safe); restore
+# places the label array straight onto the mesh sharding — no host bounce.
+import tempfile
+
+with tempfile.TemporaryDirectory() as ckdir:
+    save_sharded(ckdir, labels, iteration=5)
+    restored, it = load_sharded(ckdir)
+    assert it == 5 and np.array_equal(np.asarray(restored), np.asarray(labels))
+    print("checkpoint roundtrip ok")
+
+print("distributed example complete")

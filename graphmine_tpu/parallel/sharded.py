@@ -146,10 +146,13 @@ def partition_graph(
             deg, send_pad, counts, vc, d
         )
 
+    # Fields stay host-side (NumPy): shard_graph_arrays does the one
+    # device placement, directly to the mesh sharding — no staging copy
+    # on the default device.
     return ShardedGraph(
-        msg_recv_local=jnp.asarray(recv_local),
-        msg_send=jnp.asarray(send_pad),
-        degrees=jnp.asarray(deg),
+        msg_recv_local=recv_local,
+        msg_send=send_pad,
+        degrees=deg,
         num_vertices=num_vertices,
         chunk_size=vc,
         num_shards=d,
@@ -194,20 +197,31 @@ def _build_shard_bucket_plan(deg, send_pad, counts, chunk_size, d):
         for s, (rows, mat) in enumerate(per_shard):
             send_c[s, : len(rows)] = mat
             tgt_c[s, : len(rows)] = rows
-        bucket_send.append(jnp.asarray(send_c))
-        bucket_target.append(jnp.asarray(tgt_c))
+        bucket_send.append(send_c)
+        bucket_target.append(tgt_c)
     return tuple(bucket_send), tuple(bucket_target)
 
 
-def shard_graph_arrays(sg: ShardedGraph, mesh) -> ShardedGraph:
-    """Place the per-shard arrays on the mesh (leading dim over the vertex axis)."""
+def shard_graph_arrays(sg: ShardedGraph, mesh, lpa_only: bool = False) -> ShardedGraph:
+    """Place the per-shard arrays on the mesh (leading dim over the vertex axis).
+
+    ``lpa_only`` (valid only with a bucket plan): drop the sort-body CSR
+    arrays — the bucketed LPA shard body never reads them, and at
+    100M-edge scale they are ~GBs of idle HBM (they cannot merely stay on
+    host: the jitted entry points stage every pytree leaf to device).
+    Pass such a graph only to ``sharded_label_propagation``; CC/PageRank/
+    ring consumers fail loudly on the ``None`` fields.
+    """
     axes = _vertex_axes(mesh)
     spec = NamedSharding(mesh, P(axes, None))
     spec3 = NamedSharding(mesh, P(axes, None, None))
+    if lpa_only and not sg.bucket_send:
+        raise ValueError("lpa_only requires partition_graph(build_bucket_plan=True)")
+    place = (lambda a, s: None) if lpa_only else jax.device_put
     return ShardedGraph(
-        msg_recv_local=jax.device_put(sg.msg_recv_local, spec),
-        msg_send=jax.device_put(sg.msg_send, spec),
-        degrees=jax.device_put(sg.degrees, spec),
+        msg_recv_local=place(sg.msg_recv_local, spec),
+        msg_send=place(sg.msg_send, spec),
+        degrees=place(sg.degrees, spec),
         num_vertices=sg.num_vertices,
         chunk_size=sg.chunk_size,
         num_shards=sg.num_shards,

@@ -198,7 +198,9 @@ def _run_lpa(
         mesh = make_mesh(n_dev)
         with m.timed("partition", shards=n_dev):
             sg = shard_graph_arrays(
-                partition_graph(graph, mesh=mesh, build_bucket_plan=True), mesh
+                partition_graph(graph, mesh=mesh, build_bucket_plan=True),
+                mesh,
+                lpa_only=True,
             )
 
         def one_iter(lbl):

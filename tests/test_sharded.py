@@ -206,3 +206,13 @@ def test_sort_fallback_body_matches_bucketed(mesh8):
         shard_graph_arrays(slow, mesh8), mesh8, max_iter=4))
     np.testing.assert_array_equal(want, got_fast)
     np.testing.assert_array_equal(want, got_slow)
+
+    # lpa_only placement: CSR arrays dropped (no idle HBM), LPA still exact
+    import pytest
+
+    lean = shard_graph_arrays(fast, mesh8, lpa_only=True)
+    assert lean.msg_send is None and lean.degrees is None
+    got_lean = np.asarray(sharded_label_propagation(lean, mesh8, max_iter=4))
+    np.testing.assert_array_equal(want, got_lean)
+    with pytest.raises(ValueError, match="lpa_only"):
+        shard_graph_arrays(slow, mesh8, lpa_only=True)
