@@ -191,3 +191,45 @@ def test_bucketed_hist_path_matches_sort_based(rng, monkeypatch):
     assert plan2.hist_vertex_ids is not None and plan2.hist_vertex_ids.size == 2
     got2 = np.asarray(jax.jit(bm.lpa_superstep_bucketed)(labels, g, plan2))
     np.testing.assert_array_equal(want, got2)
+
+
+def test_auto_plan_path_matches_sort_path(rng):
+    """plan='auto' (the default) engages the fused+histogram kernel above
+    the message threshold and must match plan=None exactly — including a
+    >2048-degree hub (histogram path) and the plan cache."""
+    from graphmine_tpu.ops import lpa as lpa_mod
+
+    v = 40_000
+    hub_e = 3_000
+    src = np.concatenate([
+        np.zeros(hub_e, np.int32),                       # hub 0, degree 3000
+        rng.integers(1, v, 31_000).astype(np.int32),
+    ])
+    dst = np.concatenate([
+        rng.integers(1, v, hub_e).astype(np.int32),
+        rng.integers(1, v, 31_000).astype(np.int32),
+    ])
+    g = build_graph(src, dst, num_vertices=v)
+    assert g.num_messages >= (1 << 16)
+
+    lpa_mod._auto_plan_cache.clear()
+    auto = np.asarray(label_propagation(g, max_iter=3))          # builds plan
+    assert len(lpa_mod._auto_plan_cache) == 1
+    auto2 = np.asarray(label_propagation(g, max_iter=3))         # cache hit
+    assert len(lpa_mod._auto_plan_cache) == 1
+    none = np.asarray(label_propagation(g, max_iter=3, plan=None))
+    np.testing.assert_array_equal(auto, none)
+    np.testing.assert_array_equal(auto, auto2)
+
+    # custom init_labels (possibly outside [0, V)) must stay on the sort
+    # path — the fused histogram assumes labels in [0, V)
+    init = jnp.arange(v, dtype=jnp.int32) + jnp.int32(1_000_000)
+    got = np.asarray(label_propagation(g, max_iter=2, init_labels=init))
+    want = np.asarray(label_propagation(g, max_iter=2, init_labels=init, plan=None))
+    np.testing.assert_array_equal(got, want)
+    assert got.max() >= v  # out-of-range labels survived untouched
+
+    import pytest
+    with pytest.raises(ValueError, match="plan must be"):
+        label_propagation(g, plan="none")
+
