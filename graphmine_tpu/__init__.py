@@ -41,6 +41,7 @@ from graphmine_tpu.ops.kcore import core_numbers
 from graphmine_tpu import datasets
 from graphmine_tpu.table import Table, read_parquet
 from graphmine_tpu.ops.svdpp import svd_plus_plus, svdpp_predict
+from graphmine_tpu.interop import from_networkx, graph_from_networkx, to_networkx
 
 __all__ = [
     "Graph",
@@ -76,5 +77,8 @@ __all__ = [
     "datasets",
     "Table",
     "read_parquet",
+    "to_networkx",
+    "from_networkx",
+    "graph_from_networkx",
     "__version__",
 ]

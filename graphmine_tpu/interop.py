@@ -1,0 +1,82 @@
+"""NetworkX interop: validation-scale bridges to the reference ecosystem.
+
+The reference's ``Overview:8`` names NetworkX as a project technology
+(nothing in its code uses it); these converters serve the role it would
+have played — cross-checking results on graphs small enough for a
+single-threaded host library. The TPU engine remains the scale path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from graphmine_tpu.graph.container import Graph, build_graph
+from graphmine_tpu.io.edges import EdgeTable, from_arrays
+
+
+def to_networkx(obj, labels=None, directed: bool = True, multigraph: bool = False):
+    """Convert an :class:`EdgeTable` or :class:`Graph` to a NetworkX graph.
+
+    ``multigraph=True`` preserves duplicate edges (Multi(Di)Graph) — use it
+    for oracle comparisons against this engine, which deliberately keeps
+    edge multiplicity (LPA parity with ``Graphframes.py:70-81``); the
+    default (Di)Graph collapses duplicates. ``labels``: optional
+    per-vertex community labels stored as a ``"community"`` node
+    attribute. EdgeTable names become ``"name"`` attributes.
+    """
+    import networkx as nx
+
+    cls = {
+        (True, True): nx.MultiDiGraph,
+        (True, False): nx.MultiGraph,
+        (False, True): nx.DiGraph,
+        (False, False): nx.Graph,
+    }[(multigraph, directed)]
+    g = cls()
+    if isinstance(obj, EdgeTable):
+        src, dst = np.asarray(obj.src), np.asarray(obj.dst)
+        n = obj.num_vertices
+        names = obj.names
+    elif isinstance(obj, Graph):
+        src, dst = np.asarray(obj.src), np.asarray(obj.dst)
+        n = obj.num_vertices
+        names = None
+    else:
+        raise TypeError(f"expected EdgeTable or Graph, got {type(obj).__name__}")
+    g.add_nodes_from(range(n))
+    g.add_edges_from(zip(src.tolist(), dst.tolist()))
+    if names is not None:
+        nx.set_node_attributes(g, {i: str(names[i]) for i in range(n)}, "name")
+    if labels is not None:
+        lab = np.asarray(labels)
+        nx.set_node_attributes(g, {i: int(lab[i]) for i in range(n)}, "community")
+    return g
+
+
+def from_networkx(nxg) -> EdgeTable:
+    """Convert a NetworkX graph to an :class:`EdgeTable` (dense int32 ids).
+
+    Node objects are densified in insertion order; a ``"name"`` node
+    attribute (what :func:`to_networkx` writes) becomes the vertex name,
+    falling back to ``str(node)`` — so an EdgeTable -> nx -> EdgeTable
+    round trip preserves names. Undirected graphs contribute each edge
+    once (the engine's symmetric message CSR propagates both directions
+    anyway — LPA parity with ``Graphframes.py:81``).
+    """
+    nodes = list(nxg.nodes())
+    index = {u: i for i, u in enumerate(nodes)}
+    edges = np.asarray(
+        [(index[u], index[v]) for u, v in nxg.edges()], dtype=np.int32
+    ).reshape(-1, 2)
+    names = np.asarray([str(nxg.nodes[u].get("name", u)) for u in nodes])
+    return from_arrays(
+        np.ascontiguousarray(edges[:, 0]),
+        np.ascontiguousarray(edges[:, 1]),
+        names=names,
+    )
+
+
+def graph_from_networkx(nxg) -> Graph:
+    """Shortcut: NetworkX graph -> device-resident message-CSR Graph."""
+    et = from_networkx(nxg)
+    return build_graph(et.src, et.dst, num_vertices=et.num_vertices)
