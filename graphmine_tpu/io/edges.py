@@ -62,13 +62,25 @@ def _isnull(col: np.ndarray) -> np.ndarray:
     return np.zeros(len(col), dtype=bool)
 
 
-def load_parquet_edges(path: str) -> EdgeTable:
+def load_parquet_edges(path: str, batch_rows: int | None = None) -> EdgeTable:
     """Read a parquet file/dir/glob of outlinks and build the edge table.
 
     Parity with ``Graphframes.py:16-30``: glob support, null-domain filter
     (done columnar via the Arrow validity mask, not per-row Python),
     edges = (ParentDomain, ChildDomain) with duplicates kept.
+
+    ``batch_rows``: stream the files in batches of at most this many rows
+    through an incremental interner instead of materializing every string
+    column at once — the working capability behind the reference's
+    abandoned driver-memory "data slicer" (``Graphframes.py:34-47``).
+    Same graph, null filter, and duplicate semantics as the bulk path
+    (tested); vertex ids are assigned in per-batch first-appearance order,
+    so raw id values differ from the bulk path. Names and name-keyed edges
+    (with multiplicity) are identical; LPA partitions can differ on mode
+    *ties*, whose smallest-label rule reads the id assignment.
     """
+    if batch_rows is not None:
+        return _load_parquet_edges_streaming(path, batch_rows)
     import pyarrow as pa
     import pyarrow.compute as pc
     import pyarrow.parquet as pq
@@ -83,6 +95,38 @@ def load_parquet_edges(path: str) -> EdgeTable:
     child = table.column("_c2").to_numpy(zero_copy_only=False)
     (src, dst), names = factorize(parent, child)
     return EdgeTable(src=src, dst=dst, names=names, num_rows_raw=num_rows_raw)
+
+
+def _load_parquet_edges_streaming(path: str, batch_rows: int) -> EdgeTable:
+    """Batched parquet scan + incremental intern; peak host memory is
+    O(batch + vocabulary + edges) instead of O(total rows x string size)."""
+    import pyarrow.compute as pc
+    import pyarrow.parquet as pq
+
+    from graphmine_tpu.io.factorize import IncrementalFactorizer
+
+    if batch_rows <= 0:
+        raise ValueError(f"batch_rows must be positive, got {batch_rows}")
+    interner = IncrementalFactorizer()
+    src_parts, dst_parts = [], []
+    num_rows_raw = 0
+    for p in _resolve_paths(path):
+        pf = pq.ParquetFile(p)
+        for batch in pf.iter_batches(batch_size=batch_rows, columns=["_c1", "_c2"]):
+            num_rows_raw += batch.num_rows
+            valid = pc.and_(
+                pc.is_valid(batch.column(0)), pc.is_valid(batch.column(1))
+            )
+            batch = batch.filter(valid)  # Graphframes.py:30 null filter
+            parent = batch.column(0).to_numpy(zero_copy_only=False)
+            child = batch.column(1).to_numpy(zero_copy_only=False)
+            src_parts.append(interner.add(parent))
+            dst_parts.append(interner.add(child))
+    src = np.concatenate(src_parts) if src_parts else np.empty(0, np.int32)
+    dst = np.concatenate(dst_parts) if dst_parts else np.empty(0, np.int32)
+    return EdgeTable(
+        src=src, dst=dst, names=interner.names(), num_rows_raw=num_rows_raw
+    )
 
 
 def _resolve_paths(path: str) -> list[str]:

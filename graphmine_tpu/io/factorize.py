@@ -50,3 +50,36 @@ def _factorize_first_appearance(values: np.ndarray) -> tuple[np.ndarray, np.ndar
     rank[order] = np.arange(len(order))
     codes = rank[inv].astype(np.int32)
     return codes, uniq_sorted[order]
+
+
+class IncrementalFactorizer:
+    """Streaming string -> dense int32 interner for batched ingestion.
+
+    Each :meth:`add` call encodes one column batch, assigning new codes in
+    first-appearance order *within the batch* (the batch's unique values
+    are looked up / inserted via a dict — O(batch uniques), vectorized
+    decode). Peak memory is the vocabulary plus one batch, which is what
+    the reference's abandoned data slicer (``Graphframes.py:34-47``) was
+    groping toward.
+    """
+
+    def __init__(self):
+        self._index: dict = {}
+        self._names: list = []
+
+    def add(self, column: np.ndarray) -> np.ndarray:
+        column = np.asarray(column)
+        codes_batch, uniques = _factorize_first_appearance(column)
+        lut = np.empty(len(uniques), dtype=np.int32)
+        index, names = self._index, self._names
+        for i, val in enumerate(uniques.tolist()):
+            code = index.get(val)
+            if code is None:
+                code = len(names)
+                index[val] = code
+                names.append(val)
+            lut[i] = code
+        return lut[codes_batch].astype(np.int32)
+
+    def names(self) -> np.ndarray:
+        return np.asarray(self._names, dtype=object)
