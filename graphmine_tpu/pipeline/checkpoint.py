@@ -15,21 +15,60 @@ import os
 import numpy as np
 
 
-def save_labels(checkpoint_dir: str, labels, iteration: int, tag: str = "lpa") -> str:
+def graph_fingerprint(src, dst) -> str:
+    """Content hash of the int edge arrays — the id-assignment identity.
+
+    Labels index vertices by the ids the loader assigned; any change to
+    the data OR to id-assignment order (e.g. bulk vs ``batch_rows``
+    streaming ingestion, which documents different id orders) changes
+    this fingerprint, so a stale checkpoint cannot silently relabel a
+    permuted graph.
+    """
+    import hashlib
+
+    h = hashlib.sha1()
+    h.update(np.ascontiguousarray(np.asarray(src, np.int32)).tobytes())
+    h.update(np.ascontiguousarray(np.asarray(dst, np.int32)).tobytes())
+    return h.hexdigest()
+
+
+def save_labels(
+    checkpoint_dir: str, labels, iteration: int, tag: str = "lpa",
+    fingerprint: str | None = None,
+) -> str:
     os.makedirs(checkpoint_dir, exist_ok=True)
     path = os.path.join(checkpoint_dir, f"{tag}_labels.npz")
     tmp = path + ".tmp.npz"  # .npz suffix keeps np.savez from renaming
-    np.savez(tmp, labels=np.asarray(labels), iteration=np.int64(iteration))
+    np.savez(
+        tmp,
+        labels=np.asarray(labels),
+        iteration=np.int64(iteration),
+        fingerprint=np.str_(fingerprint or ""),
+    )
     os.replace(tmp, path)
     return path
 
 
-def load_labels(checkpoint_dir: str, tag: str = "lpa"):
-    """Returns (labels, iteration) or None when no checkpoint exists."""
+def load_labels(checkpoint_dir: str, tag: str = "lpa", fingerprint: str | None = None):
+    """Returns (labels, iteration) or None when no checkpoint exists.
+
+    ``fingerprint``: when given and the checkpoint recorded one, the two
+    must match — a mismatch means the checkpoint indexes a different
+    graph or id assignment, and resuming would silently mislabel every
+    vertex (raises ValueError instead).
+    """
     path = os.path.join(checkpoint_dir, f"{tag}_labels.npz")
     if not os.path.exists(path):
         return None
     with np.load(path) as z:
+        saved_fp = str(z["fingerprint"]) if "fingerprint" in z else ""
+        if fingerprint and saved_fp and fingerprint != saved_fp:
+            raise ValueError(
+                f"checkpoint at {path} was written for a different graph or "
+                f"vertex-id assignment (fingerprint {saved_fp[:12]}... != "
+                f"{fingerprint[:12]}...); delete the checkpoint or reload the "
+                "data the way the original run did (e.g. same batch_rows)"
+            )
         return z["labels"], int(z["iteration"])
 
 

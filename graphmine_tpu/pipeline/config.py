@@ -18,6 +18,7 @@ class PipelineConfig:
     # data
     data_path: str = "/root/reference/CommunityDetection/data/outlinks_pq"
     data_format: str = "parquet"  # parquet | edgelist
+    batch_rows: int | None = None  # parquet only: stream in bounded batches
     # engine (the plugin boundary from BASELINE.json)
     backend: str = "jax"  # jax | graphframes
     num_devices: int | None = None  # None = all visible (local[*] parity, :12)
@@ -53,6 +54,10 @@ class PipelineConfig:
             )
         if self.max_iter < 0 or self.sub_max_iter < 0:
             raise ValueError("max_iter must be >= 0")
+        if self.batch_rows is not None and self.batch_rows <= 0:
+            raise ValueError("batch_rows must be positive")
+        if self.batch_rows is not None and self.data_format != "parquet":
+            raise ValueError("batch_rows applies to parquet input only")
         if not 0 < self.decile < 1:
             raise ValueError("decile must be in (0, 1)")
         return self

@@ -52,7 +52,7 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
     # ---- CS-1 ingestion -------------------------------------------------
     with m.timed("load", path=config.data_path, format=config.data_format):
         if config.data_format == "parquet":
-            table = load_parquet_edges(config.data_path)
+            table = load_parquet_edges(config.data_path, batch_rows=config.batch_rows)
         else:
             table = load_edge_list(config.data_path)
     m.emit(
@@ -180,8 +180,14 @@ def _run_lpa(
     start_iter = 0
     labels = jnp.arange(graph.num_vertices, dtype=jnp.int32)
 
+    # One O(E) hash per run; ties every checkpoint to this exact graph and
+    # id assignment (bulk vs batch_rows ingestion assign different ids).
+    fingerprint = (
+        ckpt.graph_fingerprint(table.src, table.dst) if config.checkpoint_dir else None
+    )
+
     if config.resume and config.checkpoint_dir:
-        loaded = ckpt.load_labels(config.checkpoint_dir)
+        loaded = ckpt.load_labels(config.checkpoint_dir, fingerprint=fingerprint)
         if loaded is not None:
             saved_labels, start_iter = loaded
             if start_iter > config.max_iter:
@@ -232,7 +238,9 @@ def _run_lpa(
             labels = new
             m.lpa_iteration(it + 1, changed, graph.num_edges, dt, chips)
             if config.checkpoint_dir:
-                ckpt.save_labels(config.checkpoint_dir, labels, it + 1)
+                ckpt.save_labels(
+                    config.checkpoint_dir, labels, it + 1, fingerprint=fingerprint
+                )
     return labels
 
 

@@ -125,3 +125,30 @@ def test_orbax_checkpoint_roundtrip(tmp_path):
     assert it == 7
     assert labels.sharding == sharding
     np.testing.assert_array_equal(np.asarray(labels), np.arange(16))
+
+
+def test_checkpoint_fingerprint_guards_resume(tmp_path):
+    """A checkpoint written for one graph/id-assignment must refuse to
+    resume another (e.g. bulk vs batch_rows ingestion permute vertex ids)."""
+    from graphmine_tpu.pipeline.checkpoint import (
+        graph_fingerprint,
+        load_labels,
+        save_labels,
+    )
+
+    src = np.array([0, 1, 2], np.int32)
+    dst = np.array([1, 2, 0], np.int32)
+    fp = graph_fingerprint(src, dst)
+    save_labels(str(tmp_path), np.arange(3, dtype=np.int32), 2, fingerprint=fp)
+
+    labels, it = load_labels(str(tmp_path), fingerprint=fp)
+    assert it == 2
+
+    fp_other = graph_fingerprint(dst, src)  # permuted id roles
+    assert fp_other != fp
+    with pytest.raises(ValueError, match="different graph"):
+        load_labels(str(tmp_path), fingerprint=fp_other)
+
+    # legacy checkpoints (no fingerprint recorded) still load
+    save_labels(str(tmp_path), np.arange(3, dtype=np.int32), 1, tag="old")
+    assert load_labels(str(tmp_path), tag="old", fingerprint=fp)[1] == 1
