@@ -62,6 +62,15 @@ def load_labels(checkpoint_dir: str, tag: str = "lpa", fingerprint: str | None =
         return None
     with np.load(path) as z:
         saved_fp = str(z["fingerprint"]) if "fingerprint" in z else ""
+        if fingerprint and not saved_fp:
+            import warnings
+
+            warnings.warn(
+                f"checkpoint at {path} predates graph fingerprinting; cannot "
+                "verify it matches this graph/id assignment — resuming "
+                "unchecked (re-save to upgrade)",
+                stacklevel=2,
+            )
         if fingerprint and saved_fp and fingerprint != saved_fp:
             raise ValueError(
                 f"checkpoint at {path} was written for a different graph or "
