@@ -182,10 +182,12 @@ class GraphFrame:
         return strongly_connected_components(self.graph(symmetric=False))
 
     def pagerank(self, alpha: float = 0.85, max_iter: int = 100, tol: float = 1e-6,
-                 reset=None):
+                 reset=None, weights=None):
+        """``weights``: optional [E] non-negative edge weights aligned with
+        the edge table order (rank splits across out-edges by weight)."""
         from graphmine_tpu.ops.pagerank import pagerank
         return pagerank(self.graph(symmetric=False), alpha=alpha, max_iter=max_iter,
-                        tol=tol, reset=reset)
+                        tol=tol, reset=reset, weights=weights)
 
     def shortest_paths(self, landmarks, direction: str = "out"):
         from graphmine_tpu.ops.paths import shortest_paths

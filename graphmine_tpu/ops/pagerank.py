@@ -32,19 +32,34 @@ def pagerank(
     max_iter: int = 100,
     tol: float = 1e-6,
     reset: jax.Array | None = None,
+    weights: jax.Array | None = None,
 ) -> jax.Array:
     """PageRank vector ``[V]`` (float32, sums to 1).
 
     ``reset``: optional personalization distribution (normalized
-    internally); ``None`` = uniform teleport. Converges when the L1 delta
-    drops below ``tol`` (checked inside the while_loop — no host sync per
+    internally); ``None`` = uniform teleport. ``weights``: optional
+    non-negative per-edge weights ``[E]`` (aligned with ``graph.src``) —
+    each vertex splits its rank across out-edges in proportion to weight
+    (NetworkX weighted-pagerank semantics; vertices whose out-weight sums
+    to 0 are treated as dangling). Converges when the L1 delta drops
+    below ``tol`` (checked inside the while_loop — no host sync per
     iteration), bounded by ``max_iter``.
     """
     v = graph.num_vertices
     src, dst = graph.src, graph.dst
-    out_deg = jax.ops.segment_sum(jnp.ones_like(src), src, num_segments=v)
-    inv_out = jnp.where(out_deg > 0, 1.0 / jnp.maximum(out_deg, 1), 0.0).astype(jnp.float32)
-    dangling = out_deg == 0
+    if weights is None:
+        out_w = jax.ops.segment_sum(
+            jnp.ones_like(src, jnp.float32), src, num_segments=v
+        )
+        edge_frac = None
+    else:
+        w = jnp.maximum(weights.astype(jnp.float32), 0.0)
+        out_w = jax.ops.segment_sum(w, src, num_segments=v)
+        edge_frac = w / jnp.maximum(out_w[src], 1e-30)
+    inv_out = jnp.where(out_w > 0, 1.0 / jnp.maximum(out_w, 1e-30), 0.0).astype(
+        jnp.float32
+    )
+    dangling = out_w <= 0
     if reset is None:
         reset_v = jnp.full((v,), 1.0 / v, jnp.float32)
     else:
@@ -53,8 +68,10 @@ def pagerank(
 
     def step(state):
         pr, _, it = state
-        contrib = pr * inv_out
-        inflow = jax.ops.segment_sum(contrib[src], dst, num_segments=v)
+        if edge_frac is None:
+            inflow = jax.ops.segment_sum((pr * inv_out)[src], dst, num_segments=v)
+        else:
+            inflow = jax.ops.segment_sum(pr[src] * edge_frac, dst, num_segments=v)
         dangling_mass = jnp.sum(jnp.where(dangling, pr, 0.0))
         new = alpha * (inflow + dangling_mass * reset_v) + (1.0 - alpha) * reset_v
         delta = jnp.abs(new - pr).sum()

@@ -70,3 +70,27 @@ def test_lpa_partition_sanity_vs_networkx():
     ours = np.asarray(label_propagation(g, max_iter=10))
     assert len({int(x) for x in ours[:5]}) == 1
     assert len({int(x) for x in ours[5:]}) == 1
+
+
+def test_weighted_pagerank_matches_networkx():
+    rng = np.random.default_rng(5)
+    v, e = 60, 400
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    w = rng.uniform(0.1, 3.0, e).astype(np.float32)
+    g = build_graph(src, dst, num_vertices=v, symmetric=False)
+
+    from graphmine_tpu.ops.pagerank import pagerank
+
+    ours_w = np.asarray(pagerank(g, max_iter=200, tol=1e-10, weights=w))
+    ours_u = np.asarray(pagerank(g, max_iter=200, tol=1e-10))
+
+    nxg = nx.MultiDiGraph()
+    nxg.add_nodes_from(range(v))
+    for s, d, wt in zip(src.tolist(), dst.tolist(), w.tolist()):
+        nxg.add_edge(s, d, weight=wt)
+    want_w = nx.pagerank(nxg, alpha=0.85, weight="weight", tol=1e-12, max_iter=500)
+    want_u = nx.pagerank(nxg, alpha=0.85, weight=None, tol=1e-12, max_iter=500)
+    np.testing.assert_allclose(ours_w, [want_w[i] for i in range(v)], atol=2e-5)
+    np.testing.assert_allclose(ours_u, [want_u[i] for i in range(v)], atol=2e-5)
+    assert not np.allclose(ours_w, ours_u)  # weights actually matter
