@@ -45,6 +45,10 @@ class Graph:
     msg_ptr: jax.Array
     num_vertices: int = dataclasses.field(metadata=dict(static=True))
     symmetric: bool = dataclasses.field(metadata=dict(static=True), default=True)
+    # Optional float32 [M] per-message weights in CSR order (both directions
+    # of an edge carry its weight). Set via build_graph(edge_weights=...);
+    # weighted LPA argmaxes the per-label weight sum instead of the count.
+    msg_weight: jax.Array | None = None
 
     @property
     def num_edges(self) -> int:
@@ -84,15 +88,17 @@ def message_ptr(
     return ptr
 
 
-def _message_csr(src, dst, num_vertices, symmetric, use_native=True):
-    """(ptr int64 [V+1], recv_sorted, send_sorted int32 [M]) — messages
-    grouped by receiver, stable order. Native counting sort when available."""
+def _message_csr(src, dst, num_vertices, symmetric, use_native=True, weights=None):
+    """(ptr int64 [V+1], recv_sorted, send_sorted int32 [M], w_sorted|None)
+    — messages grouped by receiver, stable order. Native counting sort when
+    available; a weight payload rides the NumPy sort path (both directions
+    of an edge carry its weight)."""
     if len(src) and (
         min(src.min(), dst.min()) < 0
         or max(src.max(), dst.max()) >= num_vertices
     ):
         raise ValueError(f"edge endpoint out of range [0, {num_vertices})")
-    if use_native:
+    if use_native and weights is None:
         from graphmine_tpu.io import native
 
         out = native.build_message_csr(src, dst, num_vertices, symmetric)
@@ -100,7 +106,7 @@ def _message_csr(src, dst, num_vertices, symmetric, use_native=True):
             ptr, recv, send = out
             if ptr[-1] >= np.iinfo(np.int32).max:
                 raise ValueError("message count exceeds int32; shard the build")
-            return ptr, recv, send
+            return ptr, recv, send, None
     if symmetric:
         recv = np.concatenate([dst, src])
         send = np.concatenate([src, dst])
@@ -108,12 +114,16 @@ def _message_csr(src, dst, num_vertices, symmetric, use_native=True):
         recv, send = dst, src
     order = np.argsort(recv, kind="stable")
     ptr = message_ptr(src, dst, num_vertices, symmetric, recv=recv)
-    return ptr, recv[order], send[order]
+    w_sorted = None
+    if weights is not None:
+        w_all = np.concatenate([weights, weights]) if symmetric else weights
+        w_sorted = w_all[order]
+    return ptr, recv[order], send[order], w_sorted
 
 
 def build_graph(
     src, dst, num_vertices: int | None = None, symmetric: bool = True,
-    use_native: bool = True,
+    use_native: bool = True, edge_weights=None,
 ) -> Graph:
     """Build a :class:`Graph` from endpoint arrays (host-side).
 
@@ -122,10 +132,26 @@ def build_graph(
     The message grouping uses the native C++ counting-sort builder
     (``native/graph_builder.cpp``, O(M+V)) when built, else a NumPy stable
     argsort (O(M log M)); both produce byte-identical layouts (tested).
+
+    ``edge_weights``: optional non-negative float [E] per-edge weights;
+    both message directions of an edge carry its weight, and weighted LPA
+    (:func:`~graphmine_tpu.ops.lpa.label_propagation`) argmaxes weight
+    sums instead of counts. Weight permutation needs the NumPy sort path.
     """
     src, dst, num_vertices = _prepare_edges(src, dst, num_vertices)
-    ptr, recv, send = _message_csr(src, dst, num_vertices, symmetric, use_native)
-    return _graph_from_csr(src, dst, ptr, recv, send, num_vertices, symmetric)
+    w = None
+    if edge_weights is not None:
+        w = np.asarray(edge_weights, dtype=np.float32)
+        if w.shape != src.shape:
+            raise ValueError("edge_weights must be one float per edge")
+        if len(w) and w.min() < 0:
+            raise ValueError("edge_weights must be non-negative")
+    ptr, recv, send, w_sorted = _message_csr(
+        src, dst, num_vertices, symmetric, use_native, weights=w
+    )
+    return _graph_from_csr(
+        src, dst, ptr, recv, send, num_vertices, symmetric, msg_weight=w_sorted
+    )
 
 
 def _prepare_edges(src, dst, num_vertices):
@@ -139,7 +165,9 @@ def _prepare_edges(src, dst, num_vertices):
     return src, dst, num_vertices
 
 
-def _graph_from_csr(src, dst, ptr, recv, send, num_vertices, symmetric) -> Graph:
+def _graph_from_csr(
+    src, dst, ptr, recv, send, num_vertices, symmetric, msg_weight=None
+) -> Graph:
     """Assemble the device-resident Graph from a host-built message CSR."""
     return Graph(
         src=jnp.asarray(src),
@@ -149,6 +177,7 @@ def _graph_from_csr(src, dst, ptr, recv, send, num_vertices, symmetric) -> Graph
         msg_ptr=jnp.asarray(ptr.astype(np.int32)),
         num_vertices=num_vertices,
         symmetric=symmetric,
+        msg_weight=None if msg_weight is None else jnp.asarray(msg_weight),
     )
 
 

@@ -136,7 +136,7 @@ class BucketedModePlan:
         dst = np.asarray(dst, dtype=np.int32)
         if src.shape != dst.shape or src.ndim != 1:
             raise ValueError("src/dst must be equal-length 1-D arrays")
-        ptr, _, send_sorted = _message_csr(src, dst, num_vertices, symmetric)
+        ptr, _, send_sorted, _ = _message_csr(src, dst, num_vertices, symmetric)
         return cls.from_ptr(ptr, num_vertices, send_sorted)
 
     @classmethod
@@ -213,7 +213,7 @@ def build_graph_and_plan(
     )
 
     src, dst, num_vertices = _prepare_edges(src, dst, num_vertices)
-    ptr, recv, send = _message_csr(src, dst, num_vertices, symmetric, use_native)
+    ptr, recv, send, _ = _message_csr(src, dst, num_vertices, symmetric, use_native)
     graph = _graph_from_csr(src, dst, ptr, recv, send, num_vertices, symmetric)
     return graph, BucketedModePlan.from_ptr(ptr, num_vertices, send)
 
@@ -303,6 +303,11 @@ def lpa_superstep_bucketed(
     :meth:`BucketedModePlan.from_edges`) the [M] message array is never
     materialized: each bucket gathers sender labels directly — one gather
     instead of two, saving an [M]-sized HBM round trip per superstep."""
+    if graph.msg_weight is not None:
+        raise ValueError(
+            "the bucketed kernel computes unweighted modes; weighted graphs "
+            "use the sort path (label_propagation with plan=None)"
+        )
     if plan.send_idx is not None:
         if (
             labels.shape[0] != plan.num_vertices

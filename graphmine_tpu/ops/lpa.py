@@ -30,10 +30,16 @@ from graphmine_tpu.ops.segment import segment_mode
 
 
 def lpa_superstep(labels: jax.Array, graph: Graph) -> jax.Array:
-    """One synchronous LPA superstep: gather → segment-mode → select."""
+    """One synchronous LPA superstep: gather → segment-mode → select.
+
+    On a weighted graph (``build_graph(edge_weights=...)``) the mode is
+    the label with the largest incoming *weight sum* (ties toward the
+    smallest label) — classic weighted LPA; unweighted is the all-ones
+    special case."""
     msg = labels[graph.msg_send]
     mode, _ = segment_mode(
-        graph.msg_recv, msg, num_segments=graph.num_vertices, indices_are_sorted=True
+        graph.msg_recv, msg, num_segments=graph.num_vertices,
+        indices_are_sorted=True, weights=graph.msg_weight,
     )
     deg = graph.degrees()
     return jnp.where(deg > 0, mode, labels).astype(jnp.int32)
@@ -70,6 +76,7 @@ def label_propagation(
         plan = None
         if (
             init_labels is None
+            and graph.msg_weight is None  # fused kernel counts, not weights
             and not isinstance(graph.msg_ptr, jax.core.Tracer)
             and graph.num_messages >= (1 << 16)
         ):

@@ -113,6 +113,11 @@ def partition_graph(
         # One source of truth for message-CSR construction semantics.
         graph_or_src = build_graph(graph_or_src, dst, num_vertices=num_vertices)
     g = graph_or_src
+    if g.msg_weight is not None:
+        raise NotImplementedError(
+            "sharded supersteps are unweighted; run weighted LPA single-device "
+            "(label_propagation on the weighted Graph)"
+        )
     recv = np.asarray(g.msg_recv)
     send = np.asarray(g.msg_send)
     num_vertices = g.num_vertices

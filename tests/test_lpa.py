@@ -233,3 +233,93 @@ def test_auto_plan_path_matches_sort_path(rng):
     with pytest.raises(ValueError, match="plan must be"):
         label_propagation(g, plan="none")
 
+
+
+def test_weighted_lpa_matches_bruteforce(rng):
+    """Weighted LPA (argmax of incoming weight sums, ties -> smallest
+    label) vs a numpy brute-force oracle; all-ones weights reproduce the
+    unweighted kernel exactly."""
+    v, e = 40, 200
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    w = rng.uniform(0.5, 2.0, e).astype(np.float32)
+
+    g_w = build_graph(src, dst, num_vertices=v, edge_weights=w)
+    g_1 = build_graph(src, dst, num_vertices=v, edge_weights=np.ones(e, np.float32))
+    g_u = build_graph(src, dst, num_vertices=v)
+
+    labels0 = np.arange(v, dtype=np.int32)
+
+    def brute_step(lab, weights):
+        out = lab.copy()
+        for u in range(v):
+            sums = {}
+            for s, d, wt in zip(src, dst, weights):
+                if d == u:
+                    sums[lab[s]] = sums.get(lab[s], 0.0) + wt
+                if s == u:
+                    sums[lab[d]] = sums.get(lab[d], 0.0) + wt
+            if sums:
+                best = max(sums.values())
+                out[u] = min(l for l, x in sums.items() if np.isclose(x, best))
+        return out
+
+    want = labels0.copy()
+    got = jnp.asarray(labels0)
+    for _ in range(3):
+        want = brute_step(want, w.astype(np.float64))
+        got = lpa_superstep(got, g_w)
+    np.testing.assert_array_equal(want, np.asarray(got))
+
+    # ones-weighted == unweighted, full run
+    np.testing.assert_array_equal(
+        np.asarray(label_propagation(g_1, max_iter=5)),
+        np.asarray(label_propagation(g_u, max_iter=5, plan=None)),
+    )
+
+    # guards: fused kernel and sharded partition refuse weighted graphs
+    import pytest
+
+    from graphmine_tpu.ops.bucketed_mode import BucketedModePlan, lpa_superstep_bucketed
+    plan = BucketedModePlan.from_graph(g_u)
+    with pytest.raises(ValueError, match="unweighted"):
+        lpa_superstep_bucketed(jnp.asarray(labels0), g_w, plan)
+    from graphmine_tpu.parallel.sharded import partition_graph
+    with pytest.raises(NotImplementedError, match="unweighted"):
+        partition_graph(g_w, num_shards=2)
+
+
+def test_weighted_build_validation():
+    import pytest
+
+    with pytest.raises(ValueError, match="non-negative"):
+        build_graph([0, 1], [1, 0], num_vertices=2,
+                    edge_weights=np.array([1.0, -0.5], np.float32))
+    with pytest.raises(ValueError, match="out of range"):
+        build_graph([0, 5], [1, 2], num_vertices=3,
+                    edge_weights=np.array([1.0, 1.0], np.float32))
+    with pytest.raises(ValueError, match="one float per edge"):
+        build_graph([0, 1], [1, 0], num_vertices=2,
+                    edge_weights=np.array([1.0], np.float32))
+
+
+def test_weighted_mode_no_catastrophic_cancellation():
+    """Per-run accumulation: a huge prefix run must not quantize away
+    small weight differences later in the array (float32 global-cumsum
+    differencing fails this at ~2^24 elements)."""
+    from graphmine_tpu.ops.segment import segment_mode
+
+    m = (1 << 24) + 16
+    seg = np.zeros(m, np.int32)
+    val = np.zeros(m, np.int32)
+    w = np.ones(m, np.float32)
+    # segment 1 at the tail: label 1 sums to 5.0, label 2 sums to 5.7
+    seg[-16:] = 1
+    val[-16:-8] = 1
+    w[-16:-8] = np.float32(5.0 / 8)
+    val[-8:] = 2
+    w[-8:] = np.float32(5.7 / 8)
+    mode, count = segment_mode(jnp.asarray(seg), jnp.asarray(val), 2,
+                               weights=jnp.asarray(w))
+    assert int(mode[1]) == 2
+    np.testing.assert_allclose(float(count[1]), 5.7, rtol=1e-5)

@@ -75,8 +75,8 @@ def test_native_message_csr_matches_numpy():
     src = rng.integers(0, 50, 400).astype(np.int32)
     dst = rng.integers(0, 50, 400).astype(np.int32)
     for sym in (True, False):
-        pn, rn, sn = _message_csr(src, dst, 50, sym, use_native=True)
-        pp, rp, sp = _message_csr(src, dst, 50, sym, use_native=False)
+        pn, rn, sn, _ = _message_csr(src, dst, 50, sym, use_native=True)
+        pp, rp, sp, _ = _message_csr(src, dst, 50, sym, use_native=False)
         np.testing.assert_array_equal(pn, pp)
         np.testing.assert_array_equal(rn, rp)
         np.testing.assert_array_equal(sn, sp)
