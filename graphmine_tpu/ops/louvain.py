@@ -68,16 +68,14 @@ def _level_from_graph(graph: Graph) -> _Level:
             "louvain needs the symmetric message list (both edge "
             "directions); rebuild the graph with symmetric=True"
         )
+    from graphmine_tpu.ops.modularity import message_weights
+
     recv = np.asarray(graph.msg_recv)
     send = np.asarray(graph.msg_send)
     v = graph.num_vertices
-    is_self = recv == send
-    # A self-loop edge appears twice in the symmetric message list; carrying
-    # it as self_weight 0.5 per appearance preserves the degree convention
-    # (one self-loop of weight w adds 2w to its vertex's degree).
-    w = np.where(is_self, 0.0, 1.0).astype(np.float32)
-    self_w = np.zeros(v, np.float32)
-    np.add.at(self_w, recv[is_self], 0.5)
+    # Shared self-loop/weight convention (modularity.message_weights) so the
+    # gain computation optimizes exactly the score modularity() reports.
+    w, self_w = (np.asarray(a, dtype=np.float32) for a in message_weights(graph))
     return _pad_level(recv, send, w, self_w, v)
 
 

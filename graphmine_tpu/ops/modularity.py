@@ -50,21 +50,38 @@ def modularity_weighted(
     return sigma_in / two_m - gamma * jnp.sum((sigma_tot / two_m) ** 2)
 
 
-def modularity(labels: jax.Array, graph: Graph, gamma: float = 1.0) -> jax.Array:
-    """Modularity of ``labels`` on a :class:`Graph` (unit edge weights,
-    duplicate edges counted with multiplicity, self-loops handled)."""
+def message_weights(graph: Graph) -> tuple[jax.Array, jax.Array]:
+    """Split a symmetric graph's messages into ``(w [M], self_w [V])``.
+
+    The single home of the self-loop convention shared by modularity and
+    Louvain's level construction: self-loop messages carry weight 0 in
+    ``w`` and accumulate half their weight per appearance into ``self_w``
+    (each self-loop edge appears twice in the symmetric list, so a
+    self-loop of weight x adds 2x to its vertex's degree). Per-edge
+    weights come from ``graph.msg_weight`` when present, else 1.
+    """
     if not graph.symmetric:
         raise ValueError(
-            "modularity needs the symmetric message list (both edge "
-            "directions); rebuild the graph with symmetric=True"
+            "the message-weight decomposition needs the symmetric message "
+            "list (both edge directions); rebuild with symmetric=True"
         )
     v = graph.num_vertices
     is_self = graph.msg_recv == graph.msg_send
-    w = jnp.where(is_self, 0.0, 1.0)
-    # Every self-loop edge appears twice in the symmetric message list;
-    # weight-1 edge => self_weight 1 means counting each appearance as 1/2.
+    base = 1.0 if graph.msg_weight is None else graph.msg_weight.astype(jnp.float32)
+    w = jnp.where(is_self, 0.0, base)
     self_w = jax.ops.segment_sum(
-        jnp.where(is_self, 0.5, 0.0), graph.msg_recv, num_segments=v,
+        jnp.where(is_self, 0.5 * base, 0.0), graph.msg_recv, num_segments=v,
         indices_are_sorted=True,
     )
-    return modularity_weighted(labels, graph.msg_recv, graph.msg_send, w, self_w, v, gamma)
+    return w, self_w
+
+
+def modularity(labels: jax.Array, graph: Graph, gamma: float = 1.0) -> jax.Array:
+    """Modularity of ``labels`` on a :class:`Graph` — per-edge weights when
+    the graph carries them (``build_graph(edge_weights=...)``), else unit
+    weights; duplicate edges counted with multiplicity, self-loops handled."""
+    w, self_w = message_weights(graph)
+    return modularity_weighted(
+        labels, graph.msg_recv, graph.msg_send, w, self_w,
+        graph.num_vertices, gamma,
+    )

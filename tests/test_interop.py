@@ -94,3 +94,47 @@ def test_weighted_pagerank_matches_networkx():
     np.testing.assert_allclose(ours_w, [want_w[i] for i in range(v)], atol=2e-5)
     np.testing.assert_allclose(ours_u, [want_u[i] for i in range(v)], atol=2e-5)
     assert not np.allclose(ours_w, ours_u)  # weights actually matter
+
+
+def test_weighted_modularity_and_louvain_match_networkx():
+    """Weighted graphs: our modularity agrees with the NetworkX oracle on
+    arbitrary labels, and weighted Louvain recovers a weight-planted
+    partition that unweighted Louvain cannot see."""
+    from graphmine_tpu.ops.louvain import louvain
+    from graphmine_tpu.ops.modularity import modularity
+
+    rng = np.random.default_rng(11)
+    v = 24
+    # two halves; ALL pairs connected, but intra-half edges weigh 50x more
+    src, dst, w = [], [], []
+    for a in range(v):
+        for b in range(a + 1, v):
+            src.append(a); dst.append(b)
+            same = (a < v // 2) == (b < v // 2)
+            w.append(50.0 if same else 1.0)
+    src = np.asarray(src, np.int32); dst = np.asarray(dst, np.int32)
+    w = np.asarray(w, np.float32)
+    g = build_graph(src, dst, num_vertices=v, edge_weights=w)
+
+    labels = rng.integers(0, 3, v).astype(np.int32)
+    ours = float(modularity(labels, g))
+    nxg = nx.Graph()
+    nxg.add_nodes_from(range(v))
+    for s, d, wt in zip(src.tolist(), dst.tolist(), w.tolist()):
+        nxg.add_edge(s, d, weight=wt)
+    part = {}
+    for i, l in enumerate(labels):
+        part.setdefault(int(l), set()).add(i)
+    want = nx.community.modularity(nxg, part.values(), weight="weight")
+    np.testing.assert_allclose(ours, want, atol=1e-5)
+
+    lab_w, q_w = louvain(g)
+    lab_w = np.asarray(lab_w)
+    # weighted louvain splits the halves along the planted weights
+    assert len(set(lab_w[: v // 2].tolist())) == 1
+    assert len(set(lab_w[v // 2:].tolist())) == 1
+    assert lab_w[0] != lab_w[-1]
+    # the unweighted graph is a uniform clique: no such structure exists
+    g_u = build_graph(src, dst, num_vertices=v)
+    _, q_u = louvain(g_u)
+    assert float(q_w) > 0.3 > float(q_u) + 0.25
