@@ -45,14 +45,14 @@ def powerlaw_edges(v: int, e: int, seed: int = 0):
 
 
 def _setup_jax_cache():
-    """Persistent compile cache: the superstep program at bench sizes is
-    expensive to compile on TPU; repeat bench runs should pay it once.
-    Returns the fused-kernel entry points both tiers use."""
-    import jax
+    """Persistent compile cache (repo-local dir so repeat bench runs pay
+    compilation once). Returns the fused-kernel entry points both tiers
+    use."""
+    from graphmine_tpu.compile_cache import enable_compile_cache
 
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    enable_compile_cache(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    )
 
     from graphmine_tpu.ops.bucketed_mode import (
         build_graph_and_plan,

