@@ -95,8 +95,8 @@ class GraphFrame:
         for k, c in self.vertices.items():
             if len(c) != self.num_vertices:
                 raise ValueError(f"vertex column {k!r} has length {len(c)}, want {self.num_vertices}")
-        self._graph: Graph | None = None
-        self._graph_directed: Graph | None = None
+        self.weight_col: str | None = "weight"  # set None to opt out
+        self._graphs: dict = {}  # (symmetric, weighted) -> Graph
         self._tri = None  # cached ops.triangles._triangles result
 
     # -- engine binding ----------------------------------------------------
@@ -105,20 +105,31 @@ class GraphFrame:
     def num_edges(self) -> int:
         return len(self.edges["src"])
 
-    def graph(self, symmetric: bool = True) -> Graph:
-        """The device-resident :class:`Graph` (cached per direction mode)."""
-        if symmetric:
-            if self._graph is None:
-                self._graph = build_graph(
-                    self.edges["src"], self.edges["dst"], num_vertices=self.num_vertices
-                )
-            return self._graph
-        if self._graph_directed is None:
-            self._graph_directed = build_graph(
+    def edge_weights(self) -> np.ndarray | None:
+        """The numeric ``weight`` edge column (GraphFrames convention), or
+        None. Non-numeric 'weight' columns stay inert metadata; set
+        ``self.weight_col`` to another name or ``None`` to opt out."""
+        col = self.edges.get(self.weight_col) if self.weight_col else None
+        if col is None or not np.issubdtype(np.asarray(col).dtype, np.number):
+            return None
+        return col
+
+    def graph(self, symmetric: bool = True, weighted: bool = False) -> Graph:
+        """The device-resident :class:`Graph` (cached per mode).
+
+        ``weighted=True`` attaches :meth:`edge_weights` to the graph —
+        requested only by the weight-aware wrappers (labelPropagation,
+        louvain, modularity), so weight-indifferent ops (CC, triangles,
+        BFS, ...) keep the native build path and the fused LPA kernel."""
+        w = self.edge_weights() if weighted else None
+        key = (symmetric, w is not None)
+        if key not in self._graphs:
+            self._graphs[key] = build_graph(
                 self.edges["src"], self.edges["dst"],
-                num_vertices=self.num_vertices, symmetric=False,
+                num_vertices=self.num_vertices, symmetric=symmetric,
+                edge_weights=w,
             )
-        return self._graph_directed
+        return self._graphs[key]
 
     @classmethod
     def from_edge_table(cls, table: EdgeTable) -> "GraphFrame":
@@ -171,7 +182,7 @@ class GraphFrame:
 
     def label_propagation(self, max_iter: int = 5, **kw):
         from graphmine_tpu.ops.lpa import label_propagation
-        return label_propagation(self.graph(), max_iter=max_iter, **kw)
+        return label_propagation(self.graph(weighted=True), max_iter=max_iter, **kw)
 
     def connected_components(self, **kw):
         from graphmine_tpu.ops.cc import connected_components
@@ -184,8 +195,12 @@ class GraphFrame:
     def pagerank(self, alpha: float = 0.85, max_iter: int = 100, tol: float = 1e-6,
                  reset=None, weights=None):
         """``weights``: optional [E] non-negative edge weights aligned with
-        the edge table order (rank splits across out-edges by weight)."""
+        the edge table order (rank splits across out-edges by weight);
+        defaults to the numeric ``"weight"`` edge column when present.
+        Note parallelPersonalizedPageRank is unweighted."""
         from graphmine_tpu.ops.pagerank import pagerank
+        if weights is None:
+            weights = self.edge_weights()
         return pagerank(self.graph(symmetric=False), alpha=alpha, max_iter=max_iter,
                         tol=tol, reset=reset, weights=weights)
 
@@ -271,11 +286,11 @@ class GraphFrame:
 
     def louvain(self, **kw):
         from graphmine_tpu.ops.louvain import louvain
-        return louvain(self.graph(), **kw)
+        return louvain(self.graph(weighted=True), **kw)
 
     def modularity(self, labels, **kw):
         from graphmine_tpu.ops.modularity import modularity
-        return modularity(labels, self.graph(), **kw)
+        return modularity(labels, self.graph(weighted=True), **kw)
 
     def core_numbers(self, **kw):
         from graphmine_tpu.ops.kcore import core_numbers
@@ -338,8 +353,7 @@ class GraphFrame:
 
     def unpersist(self) -> "GraphFrame":
         """Drop cached device graphs (frees HBM for a frame going cold)."""
-        self._graph = None
-        self._graph_directed = None
+        self._graphs.clear()
         self._tri = None
         return self
 

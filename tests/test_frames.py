@@ -150,6 +150,62 @@ def test_persist_cache_unpersist():
     gf = GraphFrame((np.array([0, 1], np.int32), np.array([1, 0], np.int32)))
     assert gf.persist() is gf and gf.cache() is gf
     _ = gf.graph()
-    assert gf._graph is not None
+    assert gf._graphs
     gf.unpersist()
-    assert gf._graph is None
+    assert not gf._graphs
+
+
+def test_weight_edge_column_flows_through():
+    """The GraphFrames 'weight' edge-column convention: communities,
+    modularity, and pageRank all see the weights without extra plumbing."""
+    import numpy as np
+
+    from graphmine_tpu.frames import GraphFrame
+
+    v = 8
+    src, dst, w = [], [], []
+    for a in range(v):
+        for b in range(a + 1, v):
+            src.append(a); dst.append(b)
+            w.append(100.0 if (a < 4) == (b < 4) else 1.0)
+    gf_w = GraphFrame({"src": np.asarray(src, np.int32),
+                       "dst": np.asarray(dst, np.int32),
+                       "weight": np.asarray(w, np.float32)})
+    gf_u = GraphFrame({"src": np.asarray(src, np.int32),
+                       "dst": np.asarray(dst, np.int32)})
+    assert gf_w.graph(weighted=True).msg_weight is not None
+    assert gf_u.graph(weighted=True).msg_weight is None
+
+    lab_w, q_w = gf_w.louvain()
+    lab_w = np.asarray(lab_w)
+    assert len(set(lab_w[:4].tolist())) == 1 and lab_w[0] != lab_w[-1]
+    _, q_u = gf_u.louvain()
+    assert float(q_w) > float(q_u)  # weights reveal the planted split
+
+    pr_w = np.asarray(gf_w.pagerank(max_iter=50))
+    pr_u = np.asarray(gf_u.pagerank(max_iter=50))
+    assert not np.allclose(pr_w, pr_u)
+
+
+def test_weight_column_opt_out_and_non_numeric():
+    import numpy as np
+
+    from graphmine_tpu.frames import GraphFrame
+
+    src = np.array([0, 1], np.int32)
+    dst = np.array([1, 0], np.int32)
+    # non-numeric weight column stays inert metadata
+    gf = GraphFrame({"src": src, "dst": dst,
+                     "weight": np.array(["strong", "weak"])})
+    assert gf.edge_weights() is None
+    assert gf.graph(weighted=True).msg_weight is None
+    np.asarray(gf.connected_components())  # no crash
+
+    # numeric weight honored by weight-aware graph, ignored by default
+    gf2 = GraphFrame({"src": src, "dst": dst,
+                      "weight": np.array([2.0, 3.0], np.float32)})
+    assert gf2.graph(weighted=True).msg_weight is not None
+    assert gf2.graph().msg_weight is None  # CC/triangles keep the fast path
+    gf2.weight_col = None                  # explicit opt-out
+    gf2.unpersist()
+    assert gf2.graph(weighted=True).msg_weight is None
