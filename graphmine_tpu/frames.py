@@ -118,9 +118,10 @@ class GraphFrame:
         """The device-resident :class:`Graph` (cached per mode).
 
         ``weighted=True`` attaches :meth:`edge_weights` to the graph —
-        requested only by the weight-aware wrappers (labelPropagation,
-        louvain, modularity), so weight-indifferent ops (CC, triangles,
-        BFS, ...) keep the native build path and the fused LPA kernel."""
+        requested by the weight-aware wrappers (louvain, modularity, and
+        label_propagation(weighted=True); LPA defaults to unweighted for
+        GraphX parity), so weight-indifferent ops (CC, triangles, BFS,
+        ...) keep the native build path and the fused LPA kernel."""
         w = self.edge_weights() if weighted else None
         key = (symmetric, w is not None)
         if key not in self._graphs:
@@ -180,9 +181,14 @@ class GraphFrame:
 
     # -- algorithms (GraphFrames parity) -----------------------------------
 
-    def label_propagation(self, max_iter: int = 5, **kw):
+    def label_propagation(self, max_iter: int = 5, weighted: bool = False, **kw):
+        """GraphX/GraphFrames parity: unweighted by default even when a
+        'weight' column exists (their labelPropagation ignores weights).
+        ``weighted=True`` opts into weight-sum LPA (sort path)."""
         from graphmine_tpu.ops.lpa import label_propagation
-        return label_propagation(self.graph(weighted=True), max_iter=max_iter, **kw)
+        return label_propagation(
+            self.graph(weighted=weighted), max_iter=max_iter, **kw
+        )
 
     def connected_components(self, **kw):
         from graphmine_tpu.ops.cc import connected_components

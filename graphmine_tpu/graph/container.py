@@ -144,8 +144,8 @@ def build_graph(
         w = np.asarray(edge_weights, dtype=np.float32)
         if w.shape != src.shape:
             raise ValueError("edge_weights must be one float per edge")
-        if len(w) and w.min() < 0:
-            raise ValueError("edge_weights must be non-negative")
+        if len(w) and not np.all(w >= 0):  # also catches NaN (NaN >= 0 is False)
+            raise ValueError("edge_weights must be non-negative and not NaN")
     ptr, recv, send, w_sorted = _message_csr(
         src, dst, num_vertices, symmetric, use_native, weights=w
     )

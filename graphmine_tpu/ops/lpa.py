@@ -85,6 +85,25 @@ def label_propagation(
         raise ValueError(
             f"plan must be 'auto', None, or a BucketedModePlan; got {plan!r}"
         )
+    if (
+        isinstance(plan, BucketedModePlan)
+        and plan.hist_vertex_ids is not None
+        and init_labels is not None
+        and not isinstance(init_labels, jax.core.Tracer)
+    ):
+        # The fused histogram path scatter-adds labels as indices in
+        # [0, V); out-of-range labels would silently drop and argmax an
+        # all-zero histogram to label 0. Check while still concrete.
+        import numpy as _np
+
+        il = _np.asarray(init_labels)
+        if len(il) and (il.min() < 0 or il.max() >= plan.num_vertices):
+            raise ValueError(
+                "fused plans with a histogram path need init_labels in "
+                f"[0, {plan.num_vertices}); got range "
+                f"[{int(il.min())}, {int(il.max())}] — pass plan=None for "
+                "arbitrary label values"
+            )
     return _label_propagation(graph, max_iter, init_labels, return_history, plan)
 
 

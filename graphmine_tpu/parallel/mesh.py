@@ -88,14 +88,16 @@ def initialize_distributed(**kw) -> bool:
     try:
         jax.distributed.initialize(**kw)
     except ValueError as e:
-        # Swallow exactly the benign no-cluster case ("coordinator_address
-        # should be defined": nothing auto-detectable, nothing requested).
-        # Every other failure — explicit kwargs, a partially-configured
-        # cluster via JAX_* env vars ("Number of processes must be
-        # defined."), RuntimeError from a detected-but-unreachable
-        # coordinator — propagates, so a degraded pod run can never
-        # silently continue as N independent single-process runs.
-        if kw or "coordinator_address should be defined" not in str(e):
+        # Swallow only the benign no-cluster case: nothing auto-detectable
+        # and nothing requested — jax then complains about the missing
+        # coordinator_address. Matching on the variable name (not the full
+        # sentence) tolerates jax rewording the message. Every other
+        # failure — explicit kwargs, a partially-configured cluster
+        # ("Number of processes must be defined."), RuntimeError from a
+        # detected-but-unreachable coordinator — propagates, so a degraded
+        # pod run can never silently continue as N independent
+        # single-process runs.
+        if kw or "coordinator_address" not in str(e):
             raise
         return False
     return True

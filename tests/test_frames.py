@@ -209,3 +209,24 @@ def test_weight_column_opt_out_and_non_numeric():
     gf2.weight_col = None                  # explicit opt-out
     gf2.unpersist()
     assert gf2.graph(weighted=True).msg_weight is None
+
+
+def test_frame_lpa_unweighted_by_default_for_graphx_parity():
+    import numpy as np
+
+    from graphmine_tpu.frames import GraphFrame
+
+    # weights that would flip the LPA outcome if honored
+    src = np.array([0, 1], np.int32)
+    dst = np.array([2, 2], np.int32)
+    gf = GraphFrame({"src": src, "dst": dst,
+                     "weight": np.array([100.0, 1.0], np.float32)})
+    default = np.asarray(gf.label_propagation(max_iter=1))
+    weighted = np.asarray(gf.label_propagation(max_iter=1, weighted=True))
+    assert default[2] == 0   # unweighted tie -> smallest label (GraphX rule)
+    assert weighted[2] == 0  # weight 100 also favors label 0
+    # reversed weights: only the weighted run changes its answer
+    gf2 = GraphFrame({"src": src, "dst": dst,
+                      "weight": np.array([1.0, 100.0], np.float32)})
+    assert np.asarray(gf2.label_propagation(max_iter=1))[2] == 0
+    assert np.asarray(gf2.label_propagation(max_iter=1, weighted=True))[2] == 1

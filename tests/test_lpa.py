@@ -323,3 +323,35 @@ def test_weighted_mode_no_catastrophic_cancellation():
                                weights=jnp.asarray(w))
     assert int(mode[1]) == 2
     np.testing.assert_allclose(float(count[1]), 5.7, rtol=1e-5)
+
+
+def test_weight_nan_rejected_and_hist_plan_label_range_guard(rng):
+    import pytest
+
+    with pytest.raises(ValueError, match="NaN"):
+        build_graph([0, 1], [1, 0], num_vertices=2,
+                    edge_weights=np.array([1.0, np.nan], np.float32))
+
+    # explicit fused plan + out-of-range init_labels: loud error, not
+    # silent label-0 corruption via the dropped histogram scatter
+    import importlib
+
+    bm = importlib.import_module("graphmine_tpu.ops.bucketed_mode")
+    v, e = 100, 1500
+    src = np.concatenate([np.zeros(900, np.int32),
+                          rng.integers(1, v, 600).astype(np.int32)])
+    dst = rng.integers(1, v, 1500).astype(np.int32)
+    import unittest.mock
+    with unittest.mock.patch.object(bm, "_HIST_MIN_DEG", 8):
+        plan = bm.BucketedModePlan.from_edges(src, dst, v)
+    assert plan.hist_vertex_ids is not None
+    g = build_graph(src, dst, num_vertices=v)
+    bad = jnp.arange(v, dtype=jnp.int32) + 1_000_000
+    import pytest
+    with pytest.raises(ValueError, match="histogram path"):
+        label_propagation(g, max_iter=1, init_labels=bad, plan=plan)
+    # in-range custom labels still work through the fused plan
+    ok = jnp.asarray(rng.integers(0, v, v).astype(np.int32))
+    want = np.asarray(label_propagation(g, max_iter=2, init_labels=ok, plan=None))
+    got = np.asarray(label_propagation(g, max_iter=2, init_labels=ok, plan=plan))
+    np.testing.assert_array_equal(want, got)
