@@ -115,6 +115,11 @@ def ring_label_propagation(
     memory/communication schedule. Returns int32 labels ``[V]``.
     """
     _check_mesh(sg, mesh)
+    if sg.msg_weight is not None:
+        raise NotImplementedError(
+            "the ring schedule computes unweighted modes; weighted LPA runs "
+            "via sharded_label_propagation (sort body) or single-device"
+        )
     step_fn = _ring_step_fn(sg, mesh, _lpa_ring_body)
     labels = _padded_init_labels(sg) if init_labels is None else _pad_labels(init_labels, sg)
     labels = _scan_supersteps(

@@ -81,6 +81,10 @@ class ShardedGraph:
     # no plan; the sort-based segment_mode body is used instead.
     bucket_send: tuple = ()
     bucket_target: tuple = ()
+    # Optional float32 [D, Mp] per-message weights (weighted LPA via the
+    # sort shard body; padding slots carry weight 0 and are dropped by the
+    # recv sentinel anyway).
+    msg_weight: jax.Array | None = None
 
     @property
     def padded_vertices(self) -> int:
@@ -113,13 +117,15 @@ def partition_graph(
         # One source of truth for message-CSR construction semantics.
         graph_or_src = build_graph(graph_or_src, dst, num_vertices=num_vertices)
     g = graph_or_src
-    if g.msg_weight is not None:
-        raise NotImplementedError(
-            "sharded supersteps are unweighted; run weighted LPA single-device "
-            "(label_propagation on the weighted Graph)"
+    if g.msg_weight is not None and build_bucket_plan:
+        raise ValueError(
+            "the bucketed shard body computes unweighted modes; partition a "
+            "weighted graph with build_bucket_plan=False (the sort body "
+            "honors the weights)"
         )
     recv = np.asarray(g.msg_recv)
     send = np.asarray(g.msg_send)
+    w_msg = None if g.msg_weight is None else np.asarray(g.msg_weight, np.float32)
     num_vertices = g.num_vertices
 
     d = num_shards
@@ -132,6 +138,7 @@ def partition_graph(
 
     recv_local = np.full((d, mp), vc, dtype=np.int32)  # Vc = drop sentinel
     send_pad = np.zeros((d, mp), dtype=np.int32)
+    w_pad = None if w_msg is None else np.zeros((d, mp), dtype=np.float32)
     offsets = np.zeros(d + 1, dtype=np.int64)
     np.cumsum(counts, out=offsets[1:])
     for s in range(d):
@@ -139,6 +146,8 @@ def partition_graph(
         n = hi - lo
         recv_local[s, :n] = recv[lo:hi] - s * vc
         send_pad[s, :n] = send[lo:hi]
+        if w_pad is not None:
+            w_pad[s, :n] = w_msg[lo:hi]
 
     deg = np.zeros((d, vc), dtype=np.int32)
     deg_flat = np.bincount(recv, minlength=d * vc)[: d * vc]
@@ -163,6 +172,7 @@ def partition_graph(
         num_shards=d,
         bucket_send=bucket_send,
         bucket_target=bucket_target,
+        msg_weight=w_pad,
     )
 
 
@@ -232,6 +242,7 @@ def shard_graph_arrays(sg: ShardedGraph, mesh, lpa_only: bool = False) -> Sharde
         num_shards=sg.num_shards,
         bucket_send=tuple(jax.device_put(b, spec3) for b in sg.bucket_send),
         bucket_target=tuple(jax.device_put(t, spec) for t in sg.bucket_target),
+        msg_weight=None if sg.msg_weight is None else jax.device_put(sg.msg_weight, spec),
     )
 
 
@@ -251,13 +262,17 @@ def _check_mesh(sg: ShardedGraph, mesh) -> None:
         )
 
 
-def _lpa_shard_body(labels_full, recv_local, send, deg, *, chunk_size, axes):
-    """Per-device LPA superstep body (runs under shard_map)."""
+def _lpa_shard_body(labels_full, recv_local, send, deg, weight, *, chunk_size, axes):
+    """Per-device LPA superstep body (runs under shard_map). ``weight``:
+    optional [1, Mp] per-message weights (weighted mode), else None."""
     recv_local = recv_local[0]
     send = send[0]
     deg = deg[0]
     msg = labels_full[send]
-    mode, _ = segment_mode(recv_local, msg, num_segments=chunk_size)
+    mode, _ = segment_mode(
+        recv_local, msg, num_segments=chunk_size,
+        weights=None if weight is None else weight[0],
+    )
     start = lax.axis_index(axes).astype(jnp.int32) * chunk_size
     own = lax.dynamic_slice(labels_full, (start,), (chunk_size,))
     new_own = jnp.where(deg > 0, mode, own).astype(jnp.int32)
@@ -353,6 +368,12 @@ def sharded_label_propagation(
     axes = _vertex_axes(mesh)
     rep = P()
     if sg.bucket_send:
+        if sg.msg_weight is not None:
+            raise ValueError(
+                "the bucketed shard body computes unweighted modes but this "
+                "graph carries msg_weight; partition with "
+                "build_bucket_plan=False for weighted LPA"
+            )
         # Fast path: stacked degree-bucket plan (built by partition_graph).
         n = len(sg.bucket_send)
         body = jax.shard_map(
@@ -367,14 +388,17 @@ def sharded_label_propagation(
         step = lambda l: body(l, sg.bucket_send, sg.bucket_target)
     else:
         in_specs, _ = _shard_specs(mesh)
+        data_spec = P(axes, None)
         body = jax.shard_map(
             partial(_lpa_shard_body, chunk_size=sg.chunk_size, axes=axes),
             mesh=mesh,
-            in_specs=in_specs,
+            in_specs=in_specs + (data_spec,),  # None weights: empty subtree
             out_specs=rep,
             check_vma=False,
         )
-        step = lambda l: body(l, sg.msg_recv_local, sg.msg_send, sg.degrees)
+        step = lambda l: body(
+            l, sg.msg_recv_local, sg.msg_send, sg.degrees, sg.msg_weight
+        )
     labels = _padded_init_labels(sg) if init_labels is None else _pad_labels(init_labels, sg)
     labels = _scan_supersteps(step, labels, max_iter)
     return labels[: sg.num_vertices]

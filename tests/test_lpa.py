@@ -285,8 +285,9 @@ def test_weighted_lpa_matches_bruteforce(rng):
     with pytest.raises(ValueError, match="unweighted"):
         lpa_superstep_bucketed(jnp.asarray(labels0), g_w, plan)
     from graphmine_tpu.parallel.sharded import partition_graph
-    with pytest.raises(NotImplementedError, match="unweighted"):
-        partition_graph(g_w, num_shards=2)
+    assert partition_graph(g_w, num_shards=2).msg_weight is not None
+    with pytest.raises(ValueError, match="unweighted"):
+        partition_graph(g_w, num_shards=2, build_bucket_plan=True)
 
 
 def test_weighted_build_validation():

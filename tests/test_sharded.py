@@ -216,3 +216,39 @@ def test_sort_fallback_body_matches_bucketed(mesh8):
     np.testing.assert_array_equal(want, got_lean)
     with pytest.raises(ValueError, match="lpa_only"):
         shard_graph_arrays(slow, mesh8, lpa_only=True)
+
+
+def test_weighted_sharded_lpa_matches_single_device(mesh8):
+    """Weighted LPA through the sort shard body == single-device weighted
+    kernel; the bucketed plan and ring schedule refuse weighted graphs."""
+    import numpy as np
+    import pytest
+
+    from graphmine_tpu.graph.container import build_graph
+    from graphmine_tpu.ops.lpa import label_propagation
+    from graphmine_tpu.parallel.ring import ring_label_propagation
+    from graphmine_tpu.parallel.sharded import (
+        partition_graph,
+        shard_graph_arrays,
+        sharded_label_propagation,
+    )
+
+    rng = np.random.default_rng(17)
+    v, e = 90, 500
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    w = rng.uniform(0.2, 5.0, e).astype(np.float32)
+    g = build_graph(src, dst, num_vertices=v, edge_weights=w)
+    want = np.asarray(label_propagation(g, max_iter=4))
+    # sanity: weights actually change the outcome on this graph
+    g_u = build_graph(src, dst, num_vertices=v)
+    assert not np.array_equal(want, np.asarray(label_propagation(g_u, max_iter=4, plan=None)))
+
+    sg = shard_graph_arrays(partition_graph(g, mesh=mesh8), mesh8)
+    got = np.asarray(sharded_label_propagation(sg, mesh8, max_iter=4))
+    np.testing.assert_array_equal(want, got)
+
+    with pytest.raises(ValueError, match="unweighted"):
+        partition_graph(g, mesh=mesh8, build_bucket_plan=True)
+    with pytest.raises(NotImplementedError, match="unweighted"):
+        ring_label_propagation(sg, mesh8, max_iter=2)
