@@ -488,6 +488,147 @@ class Table:
             {k: np.concatenate([v, other._cols[k]]) for k, v in self._cols.items()}
         )
 
+    # -- relational ops (Spark DataFrame surface beyond the reference) -------
+
+    def drop(self, *names: str) -> "Table":
+        """Drop columns; missing names are ignored (Spark semantics)."""
+        t = self._replace({k: v for k, v in self._cols.items() if k not in names})
+        t._n = self._n  # dropping every column must not collapse the row count
+        return t
+
+    def dropna(self, subset: Sequence[str] | None = None) -> "Table":
+        """Drop rows with a null in any of ``subset`` (default: all columns)."""
+        mask = np.ones(self._n, dtype=bool)
+        for c in subset or self.columns:
+            mask &= ~_isnull(self._cols[c])
+        return self._replace({k: v[mask] for k, v in self._cols.items()})
+
+    def fillna(self, value, subset: Sequence[str] | None = None) -> "Table":
+        """Replace nulls with ``value`` in type-matching columns (Spark
+        semantics: a string value fills only string columns, a number only
+        numeric columns; other columns pass through unchanged)."""
+        out = dict(self._cols)
+        for name in subset or self.columns:
+            col = out[name]
+            null = _isnull(col)
+            if not null.any():
+                continue
+            if col.dtype == object and isinstance(value, str):
+                out[name] = np.where(null, value, col)
+            elif np.issubdtype(col.dtype, np.floating) and isinstance(
+                value, (int, float)
+            ) and not isinstance(value, bool):
+                out[name] = np.where(null, col.dtype.type(value), col)
+        return self._replace(out)
+
+    def join(self, other: "Table", on, how: str = "inner",
+             suffix: str = "_r") -> "Table":
+        """Equi-join with SQL null semantics (null keys never match).
+
+        ``on`` is a key column name or list present on both sides; output
+        has one copy of each key column (coalesced, Spark USING-clause
+        semantics), then the remaining left columns, then the remaining
+        right columns (renamed with ``suffix`` on collision). Row order:
+        left rows in order (each repeated per match), then — for
+        right/full joins — unmatched right rows. ``how``: inner, left,
+        right, full/outer, left_semi, left_anti (Spark names; ``_outer``
+        suffixes accepted)."""
+        how = _JOIN_ALIASES.get(how.lower())
+        if how is None:
+            raise ValueError(
+                f"unknown join type; supported: {sorted(set(_JOIN_ALIASES))}"
+            )
+        on = [on] if isinstance(on, str) else list(on)
+        for c in on:
+            if c not in self._cols or c not in other._cols:
+                raise KeyError(f"join key {c!r} must exist on both sides")
+
+        lnull = np.zeros(self._n, dtype=bool)
+        rnull = np.zeros(other._n, dtype=bool)
+        for c in on:
+            lnull |= _isnull(self._cols[c])
+            rnull |= _isnull(other._cols[c])
+        lk_cols, rk_cols = [], []
+        for c in on:  # coerce mixed int/float key pairs so 1 matches 1.0
+            a, b = self._cols[c], other._cols[c]
+            if a.dtype != b.dtype and all(
+                np.issubdtype(x.dtype, np.number) for x in (a, b)
+            ):
+                a, b = a.astype(np.float64), b.astype(np.float64)
+            lk_cols.append(a)
+            rk_cols.append(b)
+        lkeys = _row_keys(lk_cols) if on else None
+        rkeys = _row_keys(rk_cols) if on else None
+
+        if how == "cross" or not on:
+            li = np.repeat(np.arange(self._n), other._n)
+            ri = np.tile(np.arange(other._n), self._n)
+            return self._join_emit(other, on, li, ri, suffix)
+
+        r_order = np.argsort(rkeys, kind="stable")
+        r_valid = r_order[~rnull[r_order]]  # null keys never match
+        rk = rkeys[r_valid]
+        lo = np.searchsorted(rk, lkeys, "left")
+        hi = np.searchsorted(rk, lkeys, "right")
+        cnt = np.where(lnull, 0, hi - lo)
+
+        if how == "left_semi":
+            return self.filter(cnt > 0)
+        if how == "left_anti":
+            return self.filter(cnt == 0)
+
+        # One output row per match; left/full keep unmatched left rows as a
+        # single null-padded row (ri = -1 sentinel), in left-row position.
+        keep_unmatched_left = how in ("left", "full")
+        cnt2 = np.maximum(cnt, 1) if keep_unmatched_left else cnt
+        total = int(cnt2.sum())
+        starts = np.concatenate([[0], np.cumsum(cnt2)[:-1]]).astype(np.int64)
+        li = np.repeat(np.arange(self._n), cnt2)
+        ri = np.full(total, -1, dtype=np.int64)
+        has = np.repeat(cnt > 0, cnt2)
+        pos = np.arange(total) - np.repeat(starts, cnt2)
+        ri[has] = r_valid[np.repeat(lo, cnt2)[has] + pos[has]]
+
+        if how in ("right", "full"):
+            rmatched = np.zeros(other._n, dtype=bool)
+            rmatched[ri[ri >= 0]] = True
+            extra = np.flatnonzero(~rmatched)
+            li = np.concatenate([li, np.full(len(extra), -1, dtype=np.int64)])
+            ri = np.concatenate([ri, extra])
+        return self._join_emit(other, on, li, ri, suffix)
+
+    def _join_emit(self, other: "Table", on: list, li: np.ndarray,
+                   ri: np.ndarray, suffix: str) -> "Table":
+        cols: dict[str, np.ndarray] = {}
+        for c in on:  # coalesced key columns (USING semantics)
+            kl = _take_nullable(self._cols[c], li)
+            if (li < 0).any():  # rows from the right side only (right/full)
+                kr = _take_nullable(other._cols[c], ri)
+                kl = np.where(li < 0, kr, kl)
+            cols[c] = kl
+        for c in self.columns:
+            if c not in on:
+                cols[c] = _take_nullable(self._cols[c], li)
+        for c in other.columns:
+            if c not in on:
+                name = c + suffix if c in cols else c
+                if name in cols:
+                    raise ValueError(f"column collision after suffixing: {name!r}")
+                cols[name] = _take_nullable(other._cols[c], ri)
+        return self._replace(cols)
+
+    def group_by(self, *names: str) -> "GroupedTable":
+        """Group rows by key columns (null keys group together, as in SQL
+        GROUP BY); with no keys, one global group (``df.agg`` semantics)."""
+        flat: list[str] = []
+        for n in names:
+            flat.extend(n if isinstance(n, (list, tuple)) else [n])
+        return GroupedTable(self, flat)
+
+    def agg(self, *specs, **named) -> "Table":
+        """Global aggregation over the whole table (one output row)."""
+        return self.group_by().agg(*specs, **named)
+
     # -- bridges -------------------------------------------------------------
 
     def flat_map_distinct(self, *names: str) -> np.ndarray:
@@ -541,6 +682,209 @@ class Table:
         return cls({n: np.asarray(list(v)) for n, v in zip(names, data)})
 
 
+# Spark join-type names (and their no-underscore forms) → canonical type.
+_JOIN_ALIASES = {
+    "inner": "inner", "cross": "cross",
+    "left": "left", "leftouter": "left", "left_outer": "left",
+    "right": "right", "rightouter": "right", "right_outer": "right",
+    "full": "full", "outer": "full", "fullouter": "full", "full_outer": "full",
+    "semi": "left_semi", "leftsemi": "left_semi", "left_semi": "left_semi",
+    "anti": "left_anti", "leftanti": "left_anti", "left_anti": "left_anti",
+}
+
+
+class GroupedTable:
+    """Result of :meth:`Table.group_by` — Spark ``GroupedData`` surface.
+
+    Group order in every output is first appearance in the source table
+    (deterministic, unlike Spark). Aggregates ignore nulls except
+    ``count("*")``; an all-null group yields a null result cell."""
+
+    _FNS = ("count", "sum", "min", "max", "mean", "avg", "first",
+            "count_distinct", "collect_list", "collect_set")
+
+    def __init__(self, table: Table, keys: list):
+        self._t = table
+        self._keys = keys
+        n = len(table)
+        if keys:
+            rk = _row_keys([table[c] for c in keys])
+            _, first_idx, inv = np.unique(rk, return_index=True, return_inverse=True)
+            order = np.argsort(first_idx, kind="stable")
+            rank = np.empty(len(order), dtype=np.int64)
+            rank[order] = np.arange(len(order))
+            self._gid = rank[inv]
+            self._first = first_idx[order]
+            self._ngroups = len(order)
+        else:  # global aggregation: one group, even over an empty table
+            self._gid = np.zeros(n, dtype=np.int64)
+            self._first = np.zeros(0, dtype=np.int64)
+            self._ngroups = 1
+
+    def count(self) -> Table:
+        """Rows per group, Spark ``groupBy(...).count()`` (counts nulls)."""
+        if "count" in self._keys:
+            raise ValueError("grouping key is named 'count'; use agg() to name the output")
+        cols = {c: self._t[c][self._first] for c in self._keys}
+        cols["count"] = np.bincount(self._gid, minlength=self._ngroups).astype(np.int64)
+        return self._t._replace(cols)
+
+    def agg(self, *specs, **named) -> Table:
+        """Aggregate. Specs: Spark dict style ``{"col": "fn"}`` (output
+        named ``fn(col)``), tuples ``("col", "fn")``, or kwargs
+        ``out=("col", "fn")``. Fns: count, sum, min, max, mean/avg,
+        first, count_distinct, collect_list, collect_set."""
+        items: list[tuple[str, str, str]] = []  # (out_name, col, fn)
+        for spec in specs:
+            if isinstance(spec, Mapping):
+                for col, fn in spec.items():
+                    items.append((f"{fn}({col})", col, fn))
+            elif isinstance(spec, (tuple, list)) and len(spec) == 2:
+                col, fn = spec
+                items.append((f"{fn}({col})", col, fn))
+            else:
+                raise TypeError(f"bad agg spec {spec!r}")
+        for out, (col, fn) in named.items():
+            items.append((out, col, fn))
+        if not items:
+            return self.count()
+        cols = {c: self._t[c][self._first] for c in self._keys}
+        for out, col, fn in items:
+            if out in cols:
+                raise ValueError(f"duplicate output column {out!r}")
+            cols[out] = self._agg_one(col, fn.lower())
+        return self._t._replace(cols)
+
+    def _numeric_value_cols(self, names) -> list:
+        if names:
+            return list(names)
+        return [c for c in self._t.columns
+                if c not in self._keys and self._t[c].dtype != object]
+
+    def sum(self, *cols) -> Table:
+        return self.agg({c: "sum" for c in self._numeric_value_cols(cols)})
+
+    def min(self, *cols) -> Table:
+        return self.agg({c: "min" for c in self._numeric_value_cols(cols)})
+
+    def max(self, *cols) -> Table:
+        return self.agg({c: "max" for c in self._numeric_value_cols(cols)})
+
+    def mean(self, *cols) -> Table:
+        return self.agg({c: "mean" for c in self._numeric_value_cols(cols)})
+
+    avg = mean
+
+    def _agg_one(self, col_name: str, fn: str) -> np.ndarray:
+        g, n = self._gid, self._ngroups
+        if fn == "count" and col_name == "*":
+            return np.bincount(g, minlength=n).astype(np.int64)
+        col = self._t[col_name]
+        null = _isnull(col)
+        nonnull_per_group = np.bincount(g[~null], minlength=n).astype(np.int64)
+        if fn == "count":
+            return nonnull_per_group
+        if fn in ("count_distinct", "countdistinct", "nunique"):
+            m = ~null
+            if not m.any():
+                return np.zeros(n, dtype=np.int64)
+            pk = _row_keys([g[m], col[m]])
+            _, idx = np.unique(pk, return_index=True)
+            return np.bincount(g[m][idx], minlength=n).astype(np.int64)
+        if fn in ("sum", "mean", "avg"):
+            if col.dtype == object:
+                raise TypeError(f"{fn} on non-numeric column {col_name!r}")
+            empty = nonnull_per_group == 0
+            if fn == "sum" and np.issubdtype(col.dtype, np.integer) and not empty.any():
+                s_int = np.zeros(n, dtype=np.int64)  # exact above 2**53
+                np.add.at(s_int, g, col.astype(np.int64))
+                return s_int
+            vals = np.where(null, 0, col).astype(np.float64)
+            s = np.bincount(g, weights=vals, minlength=n)
+            if fn == "sum":
+                return np.where(empty, np.nan, s)  # null for all-null groups
+            return np.where(empty, np.nan, s / np.maximum(nonnull_per_group, 1))
+        if fn in ("min", "max"):
+            return _segment_extreme(col, null, g, n, largest=fn == "max")
+        if fn == "first":
+            # First row of each group (Spark first(), ignoreNulls=False).
+            if len(col) == 0:
+                return np.full(n, None, dtype=object)
+            first = self._first if len(self._first) else np.zeros(n, dtype=np.int64)
+            return col[first]
+        if fn in ("collect_list", "collect_set"):
+            order = np.argsort(g[~null], kind="stable")
+            vals, gs = col[~null][order], g[~null][order]
+            bounds = np.concatenate([[0], np.cumsum(np.bincount(gs, minlength=n))])
+            out = np.empty(n, dtype=object)
+            for i in range(n):
+                chunk = vals[bounds[i]:bounds[i + 1]].tolist()
+                out[i] = list(dict.fromkeys(chunk)) if fn == "collect_set" else chunk
+            return out
+        raise ValueError(f"unknown aggregate {fn!r}; supported: {self._FNS}")
+
+
+def _take_nullable(col: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """Gather ``col[idx]`` where ``idx == -1`` yields SQL null (int/bool
+    columns are promoted to object to hold ``None``)."""
+    miss = idx < 0
+    out = col[np.where(miss, 0, idx)] if len(col) else None
+    if out is None:  # gather from an empty side: all rows are null-padded
+        out = np.zeros(len(idx), dtype=col.dtype if col.dtype == object else object)
+        miss = np.ones(len(idx), dtype=bool)
+    if not miss.any():
+        return out
+    if col.dtype == object:
+        out = out.copy()
+        out[miss] = None
+    elif np.issubdtype(col.dtype, np.floating):
+        out = out.copy()
+        out[miss] = np.nan
+    else:
+        out = out.astype(object)
+        out[miss] = None
+    return out
+
+
+def _segment_extreme(col: np.ndarray, null: np.ndarray, gid: np.ndarray,
+                     n: int, largest: bool) -> np.ndarray:
+    """Per-group min/max ignoring nulls, any dtype, via one lexsort.
+
+    Ascending sort within each group with nulls pushed to the far end from
+    the answer: min = group's first element, max = group's last."""
+    if len(col) == 0:
+        if col.dtype == object:
+            return np.full(n, None, dtype=object)
+        return np.full(n, np.nan, dtype=np.float64)
+    if col.dtype == object:
+        vals = np.where(null, "", col).astype(str)
+    else:
+        vals = np.where(null, col[~null][0] if (~null).any() else 0, col)
+    null_key = ~null if largest else null  # nulls first for max, last for min
+    order = np.lexsort((vals, null_key, gid))
+    gs = gid[order]
+    starts = np.flatnonzero(np.r_[True, gs[1:] != gs[:-1]])
+    if largest:
+        pick = np.r_[starts[1:] - 1, len(gs) - 1]
+    else:
+        pick = starts
+    present = np.unique(gs)
+    result = col[order[pick]]  # exact values in the column's own dtype
+    res_null = null[order[pick]]
+    if col.dtype == object:
+        out = np.full(n, None, dtype=object)
+        out[present] = np.where(res_null, None, result)
+        return out
+    if len(present) == n and not res_null.any():
+        out = np.empty(n, dtype=col.dtype)  # no nulls: keep exact int dtype
+        out[present] = result
+        return out
+    # all-null groups (or the keyless-empty case) become NaN
+    out = np.full(n, np.nan, dtype=np.float64)
+    out[present] = np.where(res_null, np.nan, result.astype(np.float64))
+    return out
+
+
 def _as_column(values) -> np.ndarray:
     arr = np.asarray(values)
     if arr.dtype.kind in ("U", "S"):
@@ -579,6 +923,8 @@ Table.where = Table.filter
 Table.orderBy = Table.sort
 Table.dropDuplicates = Table.drop_duplicates
 Table.toDict = Table.to_dict
+Table.groupBy = Table.group_by
+Table.groupby = Table.group_by
 
 
 def read_parquet(path: str, columns: Sequence[str] | None = None) -> Table:

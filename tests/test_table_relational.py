@@ -1,0 +1,282 @@
+"""Relational ops beyond the reference's call sites: join / group_by /
+agg / drop / dropna / fillna — the rest of the Spark DataFrame surface a
+migrating user leans on. Oracle-checked against pure-Python equivalents,
+with SQL null semantics (null keys never match; GROUP BY groups nulls)."""
+
+import numpy as np
+import pytest
+
+from graphmine_tpu.table import Table
+
+
+def left():
+    return Table(
+        {
+            "k": np.array(["a", "b", "b", None, "d"], dtype=object),
+            "lv": np.array([1, 2, 3, 4, 5]),
+        }
+    )
+
+
+def right():
+    return Table(
+        {
+            "k": np.array(["b", "b", "c", None], dtype=object),
+            "rv": np.array([10.0, 20.0, 30.0, 40.0]),
+        }
+    )
+
+
+def rows(t, *cols):
+    return [tuple(t[c][i] for c in cols) for i in range(len(t))]
+
+
+# -- join --------------------------------------------------------------------
+
+
+def test_inner_join_null_keys_never_match():
+    j = left().join(right(), on="k", how="inner")
+    assert j.columns == ["k", "lv", "rv"]
+    # b matches twice per left b-row; nulls on either side never match
+    assert rows(j, "k", "lv", "rv") == [
+        ("b", 2, 10.0),
+        ("b", 2, 20.0),
+        ("b", 3, 10.0),
+        ("b", 3, 20.0),
+    ]
+
+
+def test_left_join_pads_nulls_preserving_left_order():
+    j = left().join(right(), on="k", how="left")
+    assert rows(j, "k", "lv") == [
+        ("a", 1), ("b", 2), ("b", 2), ("b", 3), ("b", 3), (None, 4), ("d", 5),
+    ]
+    rv = j["rv"]
+    assert np.isnan(rv[0]) and np.isnan(rv[5]) and np.isnan(rv[6])
+    assert list(rv[1:5]) == [10.0, 20.0, 10.0, 20.0]
+
+
+def test_right_and_full_join_append_unmatched_right():
+    j = left().join(right(), on="k", how="right")
+    # matched pairs first (left order), then unmatched right rows (c, null)
+    assert rows(j, "k", "rv")[-2:] == [("c", 30.0), (None, 40.0)]
+    assert j["lv"][len(j) - 1] is None  # int column promoted to hold null
+    f = left().join(right(), on="k", how="full")
+    # full = left-join rows + unmatched right rows
+    assert len(f) == 7 + 2
+    assert rows(f, "k")[:1] == [("a",)]
+    assert rows(f, "k")[-2:] == [("c",), (None,)]
+
+
+def test_semi_anti_join():
+    s = left().join(right(), on="k", how="left_semi")
+    assert rows(s, "k", "lv") == [("b", 2), ("b", 3)]
+    a = left().join(right(), on="k", how="left_anti")
+    assert rows(a, "k", "lv") == [("a", 1), (None, 4), ("d", 5)]
+
+
+def test_join_suffixes_collisions_and_multi_key():
+    l = Table(k=np.array([1, 2]), v=np.array([1.0, 2.0]))
+    r = Table(k=np.array([2, 3]), v=np.array([20.0, 30.0]))
+    j = l.join(r, on="k", how="inner")
+    assert j.columns == ["k", "v", "v_r"]
+    assert rows(j, "k", "v", "v_r") == [(2, 2.0, 20.0)]
+    # multi-column key
+    l2 = Table(a=np.array([1, 1, 2]), b=np.array([1, 2, 1]), x=np.array([7, 8, 9]))
+    r2 = Table(a=np.array([1, 2]), b=np.array([2, 1]), y=np.array([70, 80]))
+    j2 = l2.join(r2, on=["a", "b"])
+    assert rows(j2, "a", "b", "x", "y") == [(1, 2, 8, 70), (2, 1, 9, 80)]
+
+
+def test_cross_join():
+    l = Table(x=np.array([1, 2]))
+    r = Table(y=np.array([10, 20, 30]))
+    j = l.join(r, on=[], how="cross")
+    assert len(j) == 6
+    assert rows(j, "x", "y")[:3] == [(1, 10), (1, 20), (1, 30)]
+
+
+def test_join_random_oracle():
+    rng = np.random.default_rng(0)
+    l = Table(k=rng.integers(0, 8, 40), v=rng.normal(size=40))
+    r = Table(k=rng.integers(0, 8, 30), w=rng.normal(size=30))
+    j = l.join(r, on="k", how="inner")
+    expect = sorted(
+        (int(lk), float(lv), float(rw))
+        for lk, lv in zip(l["k"], l["v"])
+        for rk, rw in zip(r["k"], r["w"])
+        if lk == rk
+    )
+    got = sorted((int(a), float(b), float(c)) for a, b, c in rows(j, "k", "v", "w"))
+    assert got == expect
+
+
+def test_join_errors():
+    with pytest.raises(KeyError):
+        left().join(right(), on="missing")
+    with pytest.raises(ValueError):
+        left().join(right(), on="k", how="sideways")
+
+
+# -- group_by / agg ----------------------------------------------------------
+
+
+def grouped_src():
+    return Table(
+        {
+            "g": np.array(["x", "y", "x", None, "y", "x"], dtype=object),
+            "v": np.array([3.0, 1.0, np.nan, 5.0, 2.0, 1.0]),
+            "s": np.array(["p", "q", "r", None, "q", None], dtype=object),
+        }
+    )
+
+
+def test_group_count_first_appearance_order_nulls_grouped():
+    c = grouped_src().group_by("g").count()
+    assert rows(c, "g", "count") == [("x", 3), ("y", 2), (None, 1)]
+
+
+def test_agg_sum_mean_min_max_null_handling():
+    t = grouped_src().group_by("g").agg(
+        {"v": "sum"}, total_mean=("v", "mean"), lo=("v", "min"), hi=("v", "max")
+    )
+    assert rows(t, "g") == [("x",), ("y",), (None,)]
+    assert list(t["sum(v)"]) == [4.0, 3.0, 5.0]  # NaN v ignored
+    assert list(t["total_mean"]) == [2.0, 1.5, 5.0]
+    assert list(t["lo"]) == [1.0, 1.0, 5.0]
+    assert list(t["hi"]) == [3.0, 2.0, 5.0]
+
+
+def test_agg_count_and_count_distinct_ignore_nulls():
+    t = grouped_src().group_by("g").agg(
+        n=("s", "count"), d=("s", "count_distinct"), star=("*", "count")
+    )
+    assert list(t["n"]) == [2, 2, 0]
+    assert list(t["d"]) == [2, 1, 0]
+    assert list(t["star"]) == [3, 2, 1]
+
+
+def test_agg_min_max_strings_and_first_and_collect():
+    t = grouped_src().group_by("g").agg(
+        lo=("s", "min"), hi=("s", "max"), f=("s", "first"),
+        lst=("s", "collect_list"), st=("s", "collect_set"),
+    )
+    assert list(t["lo"]) == ["p", "q", None]  # all-null group -> null
+    assert list(t["hi"]) == ["r", "q", None]
+    assert list(t["f"]) == ["p", "q", None]
+    assert list(t["lst"]) == [["p", "r"], ["q", "q"], []]
+    assert list(t["st"]) == [["p", "r"], ["q"], []]
+
+
+def test_agg_integer_sum_stays_integer():
+    t = Table(g=np.array([0, 0, 1]), v=np.array([1, 2, 3]))
+    out = t.group_by("g").agg({"v": "sum"})
+    assert out["sum(v)"].dtype == np.int64
+    assert list(out["sum(v)"]) == [3, 3]
+    mn = t.group_by("g").agg({"v": "min"})
+    assert list(mn["min(v)"]) == [1, 3]
+
+
+def test_grouped_shortcuts_default_to_numeric_columns():
+    t = Table(
+        g=np.array(["a", "a", "b"], dtype=object),
+        v=np.array([1.0, 2.0, 3.0]),
+        s=np.array(["x", "y", "z"], dtype=object),
+    )
+    out = t.group_by("g").sum()
+    assert out.columns == ["g", "sum(v)"]
+    assert list(out["sum(v)"]) == [3.0, 3.0]
+    assert list(t.group_by("g").mean("v")["mean(v)"]) == [1.5, 3.0]
+
+
+def test_global_agg_and_empty_table():
+    t = Table(v=np.array([1.0, 2.0, 3.0]))
+    out = t.agg({"v": "sum"}, n=("*", "count"))
+    assert len(out) == 1
+    assert out["sum(v)"][0] == 6.0 and out["n"][0] == 3
+    empty = Table(v=np.array([], dtype=np.float64))
+    e = empty.agg(n=("*", "count"), s=("v", "sum"), m=("v", "min"))
+    assert e["n"][0] == 0
+    assert np.isnan(e["s"][0]) and np.isnan(e["m"][0])
+
+
+def test_group_agg_random_oracle():
+    rng = np.random.default_rng(1)
+    g = rng.integers(0, 5, 200)
+    v = rng.normal(size=200)
+    t = Table(g=g, v=v)
+    out = t.group_by("g").agg({"v": "sum"}, m=("v", "mean"),
+                              lo=("v", "min"), hi=("v", "max"))
+    for i in range(len(out)):
+        key = out["g"][i]
+        vals = v[g == key]
+        assert out["sum(v)"][i] == pytest.approx(vals.sum())
+        assert out["m"][i] == pytest.approx(vals.mean())
+        assert out["lo"][i] == pytest.approx(vals.min())
+        assert out["hi"][i] == pytest.approx(vals.max())
+
+
+def test_agg_errors():
+    t = Table(g=np.array([1]), s=np.array(["x"], dtype=object))
+    with pytest.raises(TypeError):
+        t.group_by("g").agg({"s": "sum"})
+    with pytest.raises(ValueError):
+        t.group_by("g").agg({"s": "median"})
+    with pytest.raises(ValueError):
+        t.group_by("g").agg(g=("s", "first"))  # collides with key column
+
+
+# -- drop / dropna / fillna --------------------------------------------------
+
+
+def test_drop_dropna_fillna():
+    t = grouped_src()
+    assert t.drop("v", "missing").columns == ["g", "s"]
+    d = t.dropna()
+    assert len(d) == 3  # rows 0, 1, 4
+    assert list(d["v"]) == [3.0, 1.0, 2.0]
+    assert len(t.dropna(subset=["g"])) == 5
+    f = t.fillna("??", subset=["s"])
+    assert list(f["s"]) == ["p", "q", "r", "??", "q", "??"]
+    assert np.isnan(f["v"][2])  # numeric column untouched by string fill
+    f2 = t.fillna(0.0)
+    assert f2["v"][2] == 0.0
+    assert f2["s"][3] is None  # string column untouched by numeric fill
+
+
+def test_int64_sum_min_max_exact_above_2_53():
+    big = 2**62 + 1
+    t = Table(k=np.array(["a", "a"], dtype=object), v=np.array([big, 1], dtype=np.int64))
+    out = t.group_by("k").agg({"v": "sum"}, hi=("v", "max"), lo=("v", "min"))
+    assert out["sum(v)"][0] == big + 1
+    assert out["hi"][0] == big and out["hi"].dtype == np.int64
+    assert out["lo"][0] == 1
+
+
+def test_join_coerces_mixed_int_float_keys():
+    l = Table(k=np.array([1, 2, 3], dtype=np.int64), v=np.array([1, 2, 3]))
+    r = Table(k=np.array([1.0, 2.0]), w=np.array([10, 20]))
+    j = l.join(r, on="k", how="inner")
+    assert sorted(zip(j["v"], j["w"])) == [(1, 10), (2, 20)]
+
+
+def test_spark_join_alias_names():
+    assert len(left().join(right(), on="k", how="fullouter")) == 9
+    assert len(left().join(right(), on="k", how="leftsemi")) == 2
+    assert len(left().join(right(), on="k", how="anti")) == 3
+
+
+def test_grouped_count_key_collision_fails_loudly():
+    t = Table({"count": np.array(["x", "x", "y"], dtype=object)})
+    with pytest.raises(ValueError):
+        t.group_by("count").count()
+
+
+def test_drop_all_columns_keeps_row_count():
+    t = Table(a=np.array([1, 2, 3]))
+    assert t.drop("a").count() == 3
+
+
+def test_spark_camelcase_aliases():
+    t = Table(g=np.array([1, 1, 2]), v=np.array([1.0, 2.0, 3.0]))
+    assert list(t.groupBy("g").count()["count"]) == [2, 1]
