@@ -8,7 +8,12 @@ user the same shaped object over the TPU-native engine:
 ==============================  =======================================
 GraphFrames                     graphmine_tpu.frames.GraphFrame
 ==============================  =======================================
-``GraphFrame(v_df, e_df)``      ``GraphFrame(edges=(src, dst), vertices=...)``
+``GraphFrame(v_df, e_df)``      ``GraphFrame(v_table, e_table)`` — works
+                                verbatim: an ``id`` vertex column plus
+                                string/int ``src``/``dst`` endpoints are
+                                factorized to dense indices on the spot
+                                (string endpoints also work without a
+                                vertex table)
 ``g.vertices / g.edges``        ``g.vertices / g.edges`` (dict of columns)
 ``g.degrees/inDegrees/...``     ``g.degrees()/in_degrees()/out_degrees()``
 ``g.labelPropagation(5)``       ``g.label_propagation(max_iter=5)``
@@ -48,8 +53,48 @@ import numpy as np
 
 from graphmine_tpu.graph.container import Graph, build_graph
 from graphmine_tpu.io.edges import EdgeTable
+from graphmine_tpu.table import Table
 
 _MaskLike = Any  # bool array [N], int index array, or fn(columns) -> mask
+
+
+def _endpoint_lookup(ids: np.ndarray):
+    """id value → dense vertex index, vectorized via one sort; raises on
+    duplicate ids or endpoints absent from ``ids``."""
+    ids = np.asarray(ids)
+    order = np.argsort(ids, kind="stable")
+    sorted_ids = ids[order]
+    if len(sorted_ids) > 1 and (sorted_ids[1:] == sorted_ids[:-1]).any():
+        dup = sorted_ids[:-1][sorted_ids[1:] == sorted_ids[:-1]][:5]
+        raise ValueError(f"duplicate vertex ids: {list(dup)!r}")
+
+    def lookup(col: np.ndarray) -> np.ndarray:
+        col = np.asarray(col)
+        pos = np.clip(np.searchsorted(sorted_ids, col), 0, max(len(sorted_ids) - 1, 0))
+        ok = sorted_ids[pos] == col if len(sorted_ids) else np.zeros(len(col), bool)
+        if not np.all(ok):
+            missing = col[~ok][:5]
+            raise ValueError(
+                f"edge endpoints not found in the vertex 'id' column: {list(missing)!r}"
+            )
+        return order[pos].astype(np.int32)
+
+    return lookup
+
+
+def _factorize_by_id(vertex_cols: Mapping, edge_cols: Mapping):
+    """GraphFrames-style (vertices_df, edges_df) → dense-index columns.
+
+    Vertex row ``i`` becomes vertex index ``i``; src/dst are re-written by
+    looking endpoints up in the ``id`` column (string or int — replaces the
+    reference's sha1 ``NodeHash`` join, ``Graphframes.py:57-74``). The
+    ``id`` column is kept as a vertex attribute so results map back."""
+    v = {k: np.asarray(c) for k, c in vertex_cols.items()}
+    e = {k: np.asarray(c) for k, c in edge_cols.items()}
+    look = _endpoint_lookup(v["id"])
+    e["src"] = look(e["src"])
+    e["dst"] = look(e["dst"])
+    return e, v
 
 
 class GraphFrame:
@@ -66,6 +111,18 @@ class GraphFrame:
 
     def __init__(self, edges, vertices: Mapping[str, np.ndarray] | None = None,
                  num_vertices: int | None = None):
+        if isinstance(edges, Table):
+            edges = edges.to_dict()
+        if isinstance(vertices, Table):
+            vertices = vertices.to_dict()
+        # GraphFrames positional shape — ``GraphFrame(vertices_df, edges_df)``
+        # with an "id" vertex column and (possibly string) src/dst endpoints:
+        # the reference's literal call site (``Graphframes.py:78``).
+        if (
+            isinstance(edges, Mapping) and "id" in edges and "src" not in edges
+            and isinstance(vertices, Mapping) and "src" in vertices and "dst" in vertices
+        ):
+            edges, vertices = _factorize_by_id(vertex_cols=edges, edge_cols=vertices)
         if isinstance(edges, EdgeTable):
             if vertices is None:
                 vertices = {"name": edges.names}
@@ -77,6 +134,15 @@ class GraphFrame:
         else:
             src, dst = edges
             cols = {"src": np.asarray(src), "dst": np.asarray(dst)}
+        if cols["src"].dtype.kind in "OUS":  # string endpoints, no vertex df:
+            if vertices is not None and "id" in vertices:
+                edges2, vertices = _factorize_by_id(vertex_cols=vertices, edge_cols=cols)
+                cols = {k: np.asarray(v) for k, v in edges2.items()}
+            else:  # factorize the union of endpoints into dense ids
+                uniq = np.unique(np.concatenate([cols["src"], cols["dst"]]))
+                look = _endpoint_lookup(uniq)
+                cols = dict(cols, src=look(cols["src"]), dst=look(cols["dst"]))
+                vertices = dict(vertices or {}, id=uniq)
         cols["src"] = cols["src"].astype(np.int32)
         cols["dst"] = cols["dst"].astype(np.int32)
         if len(cols["src"]) != len(cols["dst"]):

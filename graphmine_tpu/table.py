@@ -246,6 +246,19 @@ def _isnull(col: np.ndarray) -> np.ndarray:
     return np.zeros(len(col), dtype=bool)
 
 
+def _object_as_float(col: np.ndarray, null: np.ndarray) -> np.ndarray | None:
+    """float64 view of an object column whose non-null values are all
+    numeric — the nullable-int representation joins produce — with NaN at
+    nulls; ``None`` if any non-null value is non-numeric."""
+    is_num = np.frompyfunc(
+        lambda v: isinstance(v, (int, float, np.integer, np.floating))
+        and not isinstance(v, bool), 1, 1,
+    )(col).astype(bool)
+    if not (is_num | null).all():
+        return None
+    return np.where(null, np.nan, np.where(is_num, col, 0.0)).astype(np.float64)
+
+
 def _like(col: np.ndarray, pattern: str) -> np.ndarray:
     rx = re.compile(
         "^"
@@ -513,12 +526,16 @@ class Table:
             null = _isnull(col)
             if not null.any():
                 continue
+            numeric_value = isinstance(value, (int, float)) and not isinstance(value, bool)
             if col.dtype == object and isinstance(value, str):
-                out[name] = np.where(null, value, col)
-            elif np.issubdtype(col.dtype, np.floating) and isinstance(
-                value, (int, float)
-            ) and not isinstance(value, bool):
+                if _object_as_float(col, null) is None:  # genuinely a string col
+                    out[name] = np.where(null, value, col)
+            elif np.issubdtype(col.dtype, np.floating) and numeric_value:
                 out[name] = np.where(null, col.dtype.type(value), col)
+            elif (col.dtype == object and numeric_value
+                  and _object_as_float(col, null) is not None):
+                # nullable-int columns (object-promoted by joins)
+                out[name] = np.where(null, value, col)
         return self._replace(out)
 
     def join(self, other: "Table", on, how: str = "inner",
@@ -560,10 +577,14 @@ class Table:
         lkeys = _row_keys(lk_cols) if on else None
         rkeys = _row_keys(rk_cols) if on else None
 
-        if how == "cross" or not on:
+        if how == "cross":
+            if on:
+                raise ValueError("cross join takes no key columns")
             li = np.repeat(np.arange(self._n), other._n)
             ri = np.tile(np.arange(other._n), self._n)
             return self._join_emit(other, on, li, ri, suffix)
+        if not on:
+            raise ValueError("equi-join requires key columns; use how='cross'")
 
         r_order = np.argsort(rkeys, kind="stable")
         r_valid = r_order[~rnull[r_order]]  # null keys never match
@@ -582,7 +603,7 @@ class Table:
         keep_unmatched_left = how in ("left", "full")
         cnt2 = np.maximum(cnt, 1) if keep_unmatched_left else cnt
         total = int(cnt2.sum())
-        starts = np.concatenate([[0], np.cumsum(cnt2)[:-1]]).astype(np.int64)
+        starts = (np.cumsum(cnt2) - cnt2).astype(np.int64)  # exclusive cumsum
         li = np.repeat(np.arange(self._n), cnt2)
         ri = np.full(total, -1, dtype=np.int64)
         has = np.repeat(cnt > 0, cnt2)
@@ -793,7 +814,11 @@ class GroupedTable:
             return np.bincount(g[m][idx], minlength=n).astype(np.int64)
         if fn in ("sum", "mean", "avg"):
             if col.dtype == object:
-                raise TypeError(f"{fn} on non-numeric column {col_name!r}")
+                # nullable-int columns (object-promoted by joins) still sum
+                num = _object_as_float(col, null)
+                if num is None:
+                    raise TypeError(f"{fn} on non-numeric column {col_name!r}")
+                col = num
             empty = nonnull_per_group == 0
             if fn == "sum" and np.issubdtype(col.dtype, np.integer) and not empty.any():
                 s_int = np.zeros(n, dtype=np.int64)  # exact above 2**53
@@ -828,10 +853,11 @@ def _take_nullable(col: np.ndarray, idx: np.ndarray) -> np.ndarray:
     """Gather ``col[idx]`` where ``idx == -1`` yields SQL null (int/bool
     columns are promoted to object to hold ``None``)."""
     miss = idx < 0
-    out = col[np.where(miss, 0, idx)] if len(col) else None
-    if out is None:  # gather from an empty side: all rows are null-padded
-        out = np.zeros(len(idx), dtype=col.dtype if col.dtype == object else object)
-        miss = np.ones(len(idx), dtype=bool)
+    if len(col) == 0:  # gather from an empty side: every row is null
+        if np.issubdtype(col.dtype, np.floating):
+            return np.full(len(idx), np.nan, dtype=col.dtype)
+        return np.full(len(idx), None, dtype=object)
+    out = col[np.where(miss, 0, idx)]
     if not miss.any():
         return out
     if col.dtype == object:
@@ -857,7 +883,13 @@ def _segment_extreme(col: np.ndarray, null: np.ndarray, gid: np.ndarray,
             return np.full(n, None, dtype=object)
         return np.full(n, np.nan, dtype=np.float64)
     if col.dtype == object:
-        vals = np.where(null, "", col).astype(str)
+        # nullable-int columns (object-promoted by joins) compare
+        # numerically; genuine string columns compare lexicographically
+        num = _object_as_float(col, null)
+        if num is not None:
+            vals = np.where(null, 0.0, num)
+        else:
+            vals = np.where(null, "", col).astype(str)
     else:
         vals = np.where(null, col[~null][0] if (~null).any() else 0, col)
     null_key = ~null if largest else null  # nulls first for max, last for min
@@ -899,6 +931,8 @@ def _row_keys(cols: list[np.ndarray]) -> np.ndarray:
 
     Values are escaped before joining so the delimiter (and the null
     sentinel) can never collide with data content."""
+    if not cols or len(cols[0]) == 0:
+        return np.empty(0, dtype="U1")
     parts = []
     for c in cols:
         s = np.char.replace(c.astype(str).astype("U"), "\\", "\\\\")

@@ -230,3 +230,64 @@ def test_frame_lpa_unweighted_by_default_for_graphx_parity():
                       "weight": np.array([1.0, 100.0], np.float32)})
     assert np.asarray(gf2.label_propagation(max_iter=1))[2] == 0
     assert np.asarray(gf2.label_propagation(max_iter=1, weighted=True))[2] == 1
+
+
+def test_graphframes_positional_construction_string_ids():
+    """The reference's literal call shape (Graphframes.py:78):
+    GraphFrame(vertices_df, edges_df) with string ids."""
+    import numpy as np
+
+    from graphmine_tpu.frames import GraphFrame
+    from graphmine_tpu.table import Table
+
+    v = Table(
+        id=np.array(["aa", "bb", "cc", "dd"], dtype=object),
+        name=np.array(["a.com", "b.com", "c.com", "d.com"], dtype=object),
+    )
+    e = Table(
+        src=np.array(["aa", "bb", "cc"], dtype=object),
+        dst=np.array(["bb", "cc", "aa"], dtype=object),
+    )
+    gf = GraphFrame(v, e)
+    assert gf.num_vertices == 4 and gf.num_edges == 3
+    # vertex row i == vertex index i; id kept as an attribute
+    assert list(gf.vertices["id"]) == ["aa", "bb", "cc", "dd"]
+    assert list(gf.edges["src"]) == [0, 1, 2]
+    assert list(gf.edges["dst"]) == [1, 2, 0]
+    labels = np.asarray(gf.label_propagation(max_iter=5))
+    # triangle converges to one community; dd is isolated
+    assert len(set(labels[:3])) == 1
+    cc = np.asarray(gf.connected_components())
+    assert len(np.unique(cc)) == 2
+
+
+def test_string_edges_without_vertex_table_factorize():
+    import numpy as np
+
+    from graphmine_tpu.frames import GraphFrame
+
+    gf = GraphFrame(
+        {"src": np.array(["x", "y"], dtype=object),
+         "dst": np.array(["y", "z"], dtype=object)}
+    )
+    assert gf.num_vertices == 3
+    assert list(gf.vertices["id"]) == ["x", "y", "z"]  # sorted union
+    assert list(gf.edges["src"]) == [0, 1]
+    assert list(gf.edges["dst"]) == [1, 2]
+
+
+def test_graphframes_construction_errors():
+    import numpy as np
+    import pytest
+
+    from graphmine_tpu.frames import GraphFrame
+    from graphmine_tpu.table import Table
+
+    v = Table(id=np.array(["a", "a"], dtype=object))
+    e = Table(src=np.array(["a"], dtype=object), dst=np.array(["a"], dtype=object))
+    with pytest.raises(ValueError, match="duplicate vertex ids"):
+        GraphFrame(v, e)
+    v2 = Table(id=np.array(["a", "b"], dtype=object))
+    e2 = Table(src=np.array(["a"], dtype=object), dst=np.array(["zz"], dtype=object))
+    with pytest.raises(ValueError, match="not found in the vertex"):
+        GraphFrame(v2, e2)

@@ -277,6 +277,45 @@ def test_drop_all_columns_keeps_row_count():
     assert t.drop("a").count() == 3
 
 
+def test_nullable_int_columns_after_join_behave_numerically():
+    # Joins promote int columns with nulls to object; aggregation, min/max,
+    # and fillna must still treat them as numbers, not strings.
+    t = Table(
+        g=np.array(["a", "a", "b"], dtype=object),
+        v=np.array([2, 10, None], dtype=object),
+    )
+    out = t.group_by("g").agg(lo=("v", "min"), hi=("v", "max"),
+                              s=("v", "sum"), m=("v", "mean"))
+    assert out["lo"][0] == 2 and out["hi"][0] == 10  # numeric, not "10" < "2"
+    assert out["s"][0] == 12.0 and out["m"][0] == 6.0
+    assert np.isnan(out["s"][1])
+    f = t.fillna(0)
+    assert f["v"][2] == 0  # numeric fill reaches the promoted column
+    assert list(t.fillna("x")["g"]) == ["a", "a", "b"]  # string fill skips it
+
+
+def test_empty_tables_through_join_group_distinct():
+    l = Table(k=np.array(["a", "b"], dtype=object), v=np.array([1, 2]))
+    empty = Table(k=np.array([], dtype=object), w=np.array([], dtype=np.float64))
+    assert len(l.join(empty, on="k", how="left_anti")) == 2
+    assert len(l.join(empty, on="k", how="inner")) == 0
+    j = l.join(empty, on="k", how="left")
+    assert len(j) == 2 and np.isnan(j["w"]).all()  # float nulls stay float NaN
+    assert len(empty.join(l, on="k", how="right")) == 2
+    assert len(empty.group_by("k").count()) == 0
+    assert len(empty.distinct()) == 0
+
+
+def test_empty_key_list_rejected_and_cross_rejects_keys():
+    l = Table(x=np.array([1, 2]))
+    r = Table(y=np.array([10, 20]))
+    with pytest.raises(ValueError, match="cross"):
+        l.join(r, on=[], how="inner")
+    r2 = Table(x=np.array([1]))
+    with pytest.raises(ValueError, match="no key"):
+        l.join(r2, on="x", how="cross")
+
+
 def test_spark_camelcase_aliases():
     t = Table(g=np.array([1, 1, 2]), v=np.array([1.0, 2.0, 3.0]))
     assert list(t.groupBy("g").count()["count"]) == [2, 1]
