@@ -252,6 +252,7 @@ class GraphFrame:
         'weight' column exists (their labelPropagation ignores weights).
         ``weighted=True`` opts into weight-sum LPA (sort path)."""
         from graphmine_tpu.ops.lpa import label_propagation
+        max_iter = kw.pop("maxIter", max_iter)  # GraphFrames kwarg spelling
         return label_propagation(
             self.graph(weighted=weighted), max_iter=max_iter, **kw
         )
@@ -265,12 +266,20 @@ class GraphFrame:
         return strongly_connected_components(self.graph(symmetric=False))
 
     def pagerank(self, alpha: float = 0.85, max_iter: int = 100, tol: float = 1e-6,
-                 reset=None, weights=None):
+                 reset=None, weights=None, **kw):
         """``weights``: optional [E] non-negative edge weights aligned with
         the edge table order (rank splits across out-edges by weight);
         defaults to the numeric ``"weight"`` edge column when present.
-        Note parallelPersonalizedPageRank is unweighted."""
+        Note parallelPersonalizedPageRank is unweighted.
+
+        GraphFrames kwarg spellings accepted: ``maxIter``,
+        ``resetProbability`` (damping ``alpha = 1 - resetProbability``)."""
         from graphmine_tpu.ops.pagerank import pagerank
+        max_iter = kw.pop("maxIter", max_iter)
+        if "resetProbability" in kw:
+            alpha = 1.0 - kw.pop("resetProbability")
+        if kw:
+            raise TypeError(f"unknown pagerank arguments: {sorted(kw)}")
         if weights is None:
             weights = self.edge_weights()
         return pagerank(self.graph(symmetric=False), alpha=alpha, max_iter=max_iter,

@@ -1,0 +1,190 @@
+"""The pyspark/graphframes shim (graphmine_tpu.compat): the reference script
+must run VERBATIM on the TPU-native engine — every call site from
+``Graphframes.py:1-120`` (parquet read, DataFrame preprocessing, the RDD
+vertex idiom, UDFs, GraphFrame + labelPropagation, census loops)."""
+
+import os
+import runpy
+import sys
+
+import numpy as np
+import pytest
+
+from graphmine_tpu import compat
+
+REFERENCE_SCRIPT = "/root/reference/CommunityDetection/Graphframes.py"
+
+
+def write_tiny_outlinks(tmp_path):
+    """CommonCrawl-shaped parquet: _c0..(parent URL, parent domain, child
+    domain, child URL), one null-domain row (the reference filters it)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    edges = [
+        ("a.com", "b.com"), ("a.com", "b.com"), ("a.com", "c.com"),
+        ("b.com", "c.com"), ("c.com", "a.com"),
+        ("x.org", "y.org"), ("y.org", "x.org"),
+        ("z.org", "z2.org"),
+    ]
+    pd_, cd_ = zip(*edges)
+    table = pa.table(
+        {
+            "_c0": [f"http://{p}/page" for p in pd_] + ["http://nul/"],
+            "_c1": list(pd_) + [None],
+            "_c2": list(cd_) + ["q.com"],
+            "_c3": [f"http://{c}/page" for c in cd_] + ["http://q.com/"],
+        }
+    )
+    d = tmp_path / "data" / "outlinks_pq"
+    d.mkdir(parents=True)
+    pq.write_table(table, d / "part-00000.snappy.parquet", compression="snappy")
+    return len(edges)
+
+
+@pytest.fixture
+def shim():
+    mods = compat.install()
+    yield mods
+    for name in mods:
+        sys.modules.pop(name, None)
+
+
+@pytest.mark.skipif(
+    not os.path.exists(REFERENCE_SCRIPT), reason="reference tree not mounted"
+)
+def test_reference_script_runs_verbatim(tmp_path, capsys, monkeypatch, shim):
+    n_edges = write_tiny_outlinks(tmp_path)
+    monkeypatch.chdir(tmp_path)
+    globs = runpy.run_path(REFERENCE_SCRIPT, run_name="__main__")
+    out = capsys.readouterr().out
+
+    # the script's own printed census (Graphframes.py:18, :54, :85, :120)
+    assert out.splitlines()[0].strip() == str(n_edges + 1)  # raw row count
+    assert "Communities in the Dataset." in out
+    assert "Vertices in" in out
+
+    # its computed state, reachable because runpy returns the globals
+    df = globs["df"]
+    assert df.count() == n_edges  # null-domain row filtered
+    assert globs["ParentChild_id"].count() == 7  # distinct domains
+    communities = globs["Community_Graphs"]
+    labels = [r["label"] for r in communities.collect()]
+    names = [r["name"] for r in communities.collect()]
+    by_name = dict(zip(names, labels))
+    # Synchronous LPA oscillates on tiny bipartite pieces (GraphX-parity
+    # behavior), so assert at the component level: the a/b/c cluster and
+    # the x/y pair never share labels.
+    abc = {by_name["a.com"], by_name["b.com"], by_name["c.com"]}
+    xy = {by_name["x.org"], by_name["y.org"]}
+    assert not (abc & xy)
+    assert by_name["b.com"] == by_name["c.com"]
+
+
+def test_row_tuple_and_field_access(shim):
+    r = compat.Row._make(("v1", 7), ["id", "n"])
+    assert r[0] == "v1" and r["n"] == 7 and r.n == 7
+    assert tuple(r) == ("v1", 7)
+    assert r.asDict() == {"id": "v1", "n": 7}
+    with pytest.raises(AttributeError):
+        r.missing
+    # pyspark constructor conventions
+    named = compat.Row(id="a", n=1)
+    assert named["id"] == "a" and tuple(named) == ("a", 1)
+    bare = compat.Row("a", 1)
+    assert bare[1] == 1
+    with pytest.raises(KeyError):
+        bare["id"]
+
+
+def test_rdd_vertex_idiom(shim):
+    from graphmine_tpu.table import Table
+
+    t = Table(a=np.array(["p", "q", "p"], dtype=object),
+              b=np.array(["q", "r", "r"], dtype=object))
+    rdd = compat.DataFrame(t).rdd.flatMap(lambda x: x).distinct()
+    assert rdd.count() == 3
+    df = rdd.map(lambda x: (x.upper(), x)).toDF(["id", "name"])
+    assert df.columns == ["id", "name"]
+    assert [r["id"] for r in df.collect()] == ["P", "Q", "R"]
+
+
+def test_udf_and_monotonic_id(shim):
+    from pyspark.sql.functions import monotonically_increasing_id, udf
+
+    from graphmine_tpu.table import Table
+
+    up = udf(lambda s: s.upper())
+    df = compat.DataFrame(Table(x=np.array(["a", None, "c"], dtype=object)))
+    out = df.withColumn("up", up("x"))
+    assert list(out._t["up"]) == ["A", None, "C"]
+    ids = df.withColumn("rid", monotonically_increasing_id())
+    assert list(ids._t["rid"]) == [0, 1, 2]
+
+
+def test_session_plumbing_and_create_dataframe(shim):
+    import pyspark
+    from pyspark.sql import SQLContext, SparkSession
+
+    sc = pyspark.SparkContext("local[*]")
+    session = SparkSession.builder.appName("t").getOrCreate()
+    sql = SQLContext(sc)
+    df = sql.createDataFrame([("a", 1), ("b", 2)], ["k", "v"])
+    assert df.count() == 2 and df.columns == ["k", "v"]
+    assert session.createDataFrame([("z", 9)], ["k", "v"]).collect()[0]["k"] == "z"
+    assert sc.parallelize([1, 2, 3]).map(lambda x: x * 2).collect() == [2, 4, 6]
+
+
+def test_graphframe_facade_algorithms(shim):
+    from graphframes import GraphFrame
+
+    from graphmine_tpu.table import Table
+
+    v = compat.DataFrame(Table(id=np.array(["a", "b", "c", "d"], dtype=object)))
+    e = compat.DataFrame(Table(
+        src=np.array(["a", "b", "c"], dtype=object),
+        dst=np.array(["b", "c", "d"], dtype=object),
+    ))
+    g = GraphFrame(v, e)
+    cc = g.connectedComponents()
+    assert cc.select("component").distinct().count() == 1
+    # pageRank returns a GraphFrame: results ride .vertices / .edges
+    pr = g.pageRank(resetProbability=0.15, maxIter=10)
+    assert pr.vertices.count() == 4 and "pagerank" in pr.vertices.columns
+    assert "weight" in pr.edges.columns
+    assert pr.edges.collect()[0]["weight"] == 1.0  # outdeg(a) == 1
+    deg = g.degrees  # property, as in GraphFrames
+    assert {r["id"]: r["degree"] for r in deg.collect()}["b"] == 2
+    assert {r["id"]: r["inDegree"] for r in g.inDegrees.collect()}["d"] == 1
+    # distance FROM each vertex TO the landmark, following edge direction
+    sp = g.shortestPaths(landmarks=["d"])
+    dists = {r["id"]: r["distances"] for r in sp.collect()}
+    assert dists["a"] == {"d": 3} and dists["d"] == {"d": 0}
+    assert g.shortestPaths(landmarks=["a"]).collect()[3]["distances"] == {}
+
+
+def test_dropna_modes_head_first(shim):
+    from graphmine_tpu.table import Table
+
+    df = compat.DataFrame(Table(
+        a=np.array(["x", None, None], dtype=object),
+        b=np.array(["y", "z", None], dtype=object),
+    ))
+    assert df.dropna().count() == 1            # how='any'
+    assert df.dropna(how="all").count() == 2   # only the all-null row drops
+    assert df.dropna(thresh=1).count() == 2
+    assert df.head() == ("x", "y")
+    assert df.head(1) == [("x", "y")]          # head(n) is always a list
+    empty = df.filter(np.zeros(3, dtype=bool))
+    assert empty.first() is None and empty.head(2) == []
+
+
+def test_install_refuses_real_pyspark(shim, monkeypatch):
+    import types
+
+    fake_real = types.ModuleType("pyspark")
+    fake_real.__doc__ = "Apache Spark Python API"
+    monkeypatch.setitem(sys.modules, "pyspark", fake_real)
+    with pytest.raises(RuntimeError, match="real pyspark"):
+        compat.install()
+    compat.install(force=True)  # explicit override allowed
