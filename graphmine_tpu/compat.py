@@ -284,7 +284,7 @@ class DataFrame:
             names = self._t.columns
             cols = [self._t[c] for c in names]
             self._rows = [Row._make(vals, names) for vals in zip(*cols)]
-        return self._rows
+        return list(self._rows)  # fresh list per call, as in pyspark
 
     def head(self, n: int | None = None):
         """pyspark semantics: ``head()`` → first Row or None; ``head(n)`` →
@@ -659,7 +659,9 @@ def main(argv: Sequence[str] | None = None) -> None:
     )
     args = p.parse_args(argv)
     path = os.path.abspath(args.script)
-    install()
+    # Invoking this runner IS the request to use the shim, so shadow any
+    # real pyspark for this process.
+    install(force=True)
     os.chdir(args.cwd or os.path.dirname(path) or ".")
     runpy.run_path(path, run_name="__main__")
 

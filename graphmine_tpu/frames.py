@@ -377,6 +377,17 @@ class GraphFrame:
         from graphmine_tpu.ops.kcore import core_numbers
         return core_numbers(self.graph(), **kw)
 
+    def hits(self, **kw):
+        """HITS (hubs, authorities) on the directed edges — NetworkX parity."""
+        from graphmine_tpu.ops.centrality import hits
+        return hits(self.graph(symmetric=False), **kw)
+
+    def closeness_centrality(self, vertices=None, **kw):
+        """Undirected closeness centrality (NetworkX parity); pass a
+        landmark sample as ``vertices`` on large graphs."""
+        from graphmine_tpu.ops.centrality import closeness_centrality
+        return closeness_centrality(self.graph(), vertices=vertices, **kw)
+
     def clustering_coefficient(self):
         from graphmine_tpu.ops.triangles import clustering_coefficient
         return clustering_coefficient(self.graph(), _cached=self._triangle_cache())

@@ -15,5 +15,6 @@ from graphmine_tpu.ops.degrees import degrees, in_degrees, out_degrees
 from graphmine_tpu.ops.paths import bfs_distances, shortest_paths
 from graphmine_tpu.ops.triangles import triangle_count, clustering_coefficient
 from graphmine_tpu.ops.kcore import core_numbers
+from graphmine_tpu.ops.centrality import closeness_centrality, hits
 
-__all__ = ["segment_mode", "BucketedModePlan", "bucketed_mode", "lpa_superstep_bucketed", "aggregate_messages", "pregel", "find", "parse_pattern", "StreamingLOF", "fit_lof", "score_lof", "label_propagation", "lpa_superstep", "connected_components", "strongly_connected_components", "louvain", "modularity", "pagerank", "parallel_personalized_pagerank", "svd_plus_plus", "svdpp_predict", "SVDPlusPlusModel", "degrees", "in_degrees", "out_degrees", "bfs", "bfs_parents", "bfs_distances", "shortest_paths", "triangle_count", "clustering_coefficient", "core_numbers"]
+__all__ = ["hits", "closeness_centrality","segment_mode", "BucketedModePlan", "bucketed_mode", "lpa_superstep_bucketed", "aggregate_messages", "pregel", "find", "parse_pattern", "StreamingLOF", "fit_lof", "score_lof", "label_propagation", "lpa_superstep", "connected_components", "strongly_connected_components", "louvain", "modularity", "pagerank", "parallel_personalized_pagerank", "svd_plus_plus", "svdpp_predict", "SVDPlusPlusModel", "degrees", "in_degrees", "out_degrees", "bfs", "bfs_parents", "bfs_distances", "shortest_paths", "triangle_count", "clustering_coefficient", "core_numbers"]

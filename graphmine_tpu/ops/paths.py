@@ -72,29 +72,55 @@ def bfs_distances(
     return dist
 
 
-def shortest_paths(graph: Graph, landmarks, direction: str = "out") -> jax.Array:
+def shortest_paths(graph: Graph, landmarks, direction: str = "out",
+                   landmark_batch: int = 16) -> jax.Array:
     """Distance to each landmark, shape ``[V, L]`` (GraphFrames
     ``shortestPaths`` semantics: distance FROM each vertex TO the landmark
     following edge direction).
 
-    Landmarks are processed with one compiled single-landmark BFS
-    (reversed edges, so "to the landmark" becomes "from it") mapped over
-    the landmark axis.
+    Landmarks run ``landmark_batch`` at a time in one vectorized
+    Bellman-Ford (every relaxation handles the whole lane block —
+    per-superstep message buffer is ``[M, B]`` int32, so lower ``B`` on
+    huge graphs); tiles are processed sequentially via ``lax.map``.
     """
     landmarks = jnp.atleast_1d(jnp.asarray(landmarks, jnp.int32))
+    num = int(landmarks.shape[0])
+    b = max(1, min(landmark_batch, num))
     # distance v -> landmark along src->dst == distance landmark -> v along
     # reversed edges; for "both" the graph is symmetric already.
     if direction == "out":
-        rev = Graph(
-            src=graph.dst, dst=graph.src,
-            msg_recv=graph.msg_recv, msg_send=graph.msg_send,
-            msg_ptr=graph.msg_ptr, num_vertices=graph.num_vertices,
-            symmetric=graph.symmetric,
-        )
-        per = lambda lm: bfs_distances(rev, lm[None], direction="out")
+        send, recv = graph.dst, graph.src
     else:
-        per = lambda lm: bfs_distances(graph, lm[None], direction="both")
-    return lax.map(per, landmarks).T
+        send, recv = _edges(graph, direction)
+    pad = (-num) % b
+    tiles = jnp.concatenate(
+        [landmarks, jnp.zeros(pad, jnp.int32)]
+    ).reshape(-1, b)
+    per = partial(_bfs_tile, send=send, recv=recv, v=graph.num_vertices)
+    out = lax.map(per, tiles)  # [T, V, B]
+    return jnp.moveaxis(out, 0, 1).reshape(graph.num_vertices, -1)[:, :num]
+
+
+def _bfs_tile(sources: jax.Array, *, send, recv, v: int) -> jax.Array:
+    """Per-source BFS distances for one lane block: ``[V, B]``."""
+    b = sources.shape[0]
+    dist0 = jnp.full((v, b), UNREACHABLE, jnp.int32)
+    dist0 = dist0.at[sources, jnp.arange(b)].min(0)
+
+    def step(state):
+        dist, _, it = state
+        msg = jnp.where(dist[send] == UNREACHABLE, UNREACHABLE, dist[send] + 1)
+        relaxed = jax.ops.segment_min(msg, recv, num_segments=v)
+        new = jnp.minimum(dist, relaxed)
+        changed = jnp.sum(new != dist, dtype=jnp.int32)
+        return new, changed, it + 1
+
+    def cond(state):
+        _, changed, it = state
+        return (changed > 0) & (it < v + 1)
+
+    dist, _, _ = lax.while_loop(cond, step, (dist0, jnp.int32(1), jnp.int32(0)))
+    return dist
 
 
 @partial(jax.jit, static_argnames=("direction", "max_depth"))

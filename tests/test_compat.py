@@ -163,6 +163,16 @@ def test_graphframe_facade_algorithms(shim):
     assert g.shortestPaths(landmarks=["a"]).collect()[3]["distances"] == {}
 
 
+def test_collect_returns_fresh_list(shim):
+    from graphmine_tpu.table import Table
+
+    df = compat.DataFrame(Table(a=np.array([3, 1, 2])))
+    rows = df.collect()
+    rows.sort()
+    rows.append("junk")
+    assert [r["a"] for r in df.collect()] == [3, 1, 2]
+
+
 def test_dropna_modes_head_first(shim):
     from graphmine_tpu.table import Table
 
