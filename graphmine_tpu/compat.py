@@ -480,7 +480,7 @@ class GraphFrame:
         return self._e
 
     def _with_result(self, name: str, values: np.ndarray) -> DataFrame:
-        cols = dict(self._gf.vertices)
+        cols = _visible_vertex_cols(self._gf)
         cols[name] = np.asarray(values)
         return DataFrame(Table(cols))
 
@@ -555,6 +555,91 @@ class GraphFrame:
             }
         return self._with_result("distances", dcol)
 
+    # -- expression-driven surfaces (GraphFrames SQL strings) --------------
+
+    def _ids(self) -> np.ndarray:
+        ids = self._gf.vertices.get("id")
+        return np.arange(self._gf.num_vertices) if ids is None else np.asarray(ids)
+
+    def _vertex_sql_mask(self, expr) -> np.ndarray:
+        return _sql_mask(expr, self._gf.vertices, self._gf.num_vertices)
+
+    def bfs(self, fromExpr, toExpr, edgeFilter=None,
+            maxPathLength: int = 10) -> DataFrame:
+        """GraphFrames ``bfs``: SQL expression strings (or boolean masks)
+        select the endpoint sets; returns the paths DataFrame with columns
+        ``from, e0, v1, e1, ..., to`` — vertex cells hold the vertex id,
+        edge cells ``(src_id, dst_id)`` pairs."""
+        if edgeFilter is not None:
+            raise NotImplementedError("bfs edgeFilter is not supported")
+        from graphmine_tpu.ops.paths import bfs as _bfs
+
+        src_ids = np.flatnonzero(self._vertex_sql_mask(fromExpr))
+        dst_ids = np.flatnonzero(self._vertex_sql_mask(toExpr))
+        ids = self._ids()
+        if maxPathLength <= 0:  # GraphFrames: no traversal, zero-hop only
+            paths = [np.array([v], np.int32)
+                     for v in np.intersect1d(src_ids, dst_ids)]
+        else:
+            paths = _bfs(self._gf.graph(symmetric=False), src_ids, dst_ids,
+                         max_path_length=maxPathLength)
+        if not paths:
+            return DataFrame(Table({"from": np.empty(0, object),
+                                    "to": np.empty(0, object)}))
+        hops = len(paths[0]) - 1
+        names = ["from"] + [
+            x for i in range(1, hops) for x in (f"e{i-1}", f"v{i}")
+        ] + ([f"e{hops-1}"] if hops else []) + ["to"]
+        rows = []
+        for p in paths:
+            cells = [ids[p[0]]]
+            for i in range(hops):
+                cells.append((ids[p[i]], ids[p[i + 1]]))
+                cells.append(ids[p[i + 1]])
+            rows.append(cells if hops else [ids[p[0]], ids[p[0]]])
+        cols = {}
+        for j, name in enumerate(names):  # object columns: cells may be tuples
+            col = np.empty(len(rows), dtype=object)
+            for i, r in enumerate(rows):
+                col[i] = r[j]
+            cols[name] = col
+        return DataFrame(Table(cols))
+
+    def find(self, pattern: str) -> DataFrame:
+        """GraphFrames motif ``find``: one row per match; named vertices
+        are id columns, named edges ``(src_id, dst_id)`` pairs."""
+        res = self._gf.find(pattern)
+        ids = self._ids()
+        cols: dict = {}
+        for name, vals in res.vertices.items():
+            cols[name] = ids[np.asarray(vals)]
+        e_src = np.asarray(self._gf.edges["src"])
+        e_dst = np.asarray(self._gf.edges["dst"])
+        for name, rows_ in res.edges.items():
+            idx = np.asarray(rows_, dtype=np.int64)
+            pair_src, pair_dst = ids[e_src[idx]], ids[e_dst[idx]]
+            cols[name] = np.fromiter(
+                zip(pair_src, pair_dst), dtype=object, count=len(idx)
+            )
+        return DataFrame(Table(cols))
+
+    def filterVertices(self, condition) -> "GraphFrame":
+        sub = self._gf.filter_vertices(self._vertex_sql_mask(condition))
+        return _wrap_engine(sub)
+
+    def filterEdges(self, condition) -> "GraphFrame":
+        # Predicates see id-valued src/dst (GraphFrames semantics), not the
+        # engine's dense indices.
+        ids = self._ids()
+        view = dict(self._gf.edges)
+        view["src"] = ids[np.asarray(view["src"])]
+        view["dst"] = ids[np.asarray(view["dst"])]
+        mask = _sql_mask(condition, view, self._gf.num_edges)
+        return _wrap_engine(self._gf.filter_edges(mask))
+
+    def dropIsolatedVertices(self) -> "GraphFrame":
+        return _wrap_engine(self._gf.drop_isolated_vertices())
+
     def _vertex_index(self, vid) -> int:
         ids = self._gf.vertices.get("id")
         if ids is None:
@@ -571,6 +656,43 @@ class GraphFrame:
 
     def __repr__(self) -> str:
         return repr(self._gf)
+
+
+def _sql_mask(expr, columns, n: int) -> np.ndarray:
+    """SQL predicate string (GraphFrames expression surface) or boolean
+    mask/callable → boolean mask over ``columns``."""
+    if isinstance(expr, str):
+        from graphmine_tpu.table import _PredicateParser, _tokenize
+
+        return _PredicateParser(_tokenize(expr), columns, n).parse()
+    if callable(expr) and not isinstance(expr, np.ndarray):
+        return np.asarray(expr(columns), dtype=bool)
+    return np.asarray(expr, dtype=bool)
+
+
+def _visible_vertex_cols(gf: "_frames.GraphFrame") -> dict:
+    """Vertex columns a GraphFrames user should see: engine bookkeeping
+    (the ``orig`` root-frame index threaded through filters) stays hidden."""
+    cols = {k: v for k, v in gf.vertices.items() if k != "orig"}
+    return cols or {"id": np.arange(gf.num_vertices, dtype=np.int64)}
+
+
+def _wrap_engine(gf: "_frames.GraphFrame") -> "GraphFrame":
+    """Wrap an engine GraphFrame (e.g. a filtered subgraph) without
+    re-running id factorization. Edges are shown with id-valued src/dst
+    (the GraphFrames convention), not the engine's dense indices."""
+    g = object.__new__(GraphFrame)
+    g._gf = gf
+    vcols = _visible_vertex_cols(gf)
+    g._v = DataFrame(Table(vcols))
+    ids = vcols.get("id")
+    ecols = dict(gf.edges)
+    if ids is not None:
+        ids = np.asarray(ids)
+        ecols["src"] = ids[np.asarray(ecols["src"])]
+        ecols["dst"] = ids[np.asarray(ecols["dst"])]
+    g._e = DataFrame(Table(ecols))
+    return g
 
 
 # ---------------------------------------------------------------------------

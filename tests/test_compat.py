@@ -189,6 +189,74 @@ def test_dropna_modes_head_first(shim):
     assert empty.first() is None and empty.head(2) == []
 
 
+def graph_with_attrs(shim):
+    from graphframes import GraphFrame
+
+    from graphmine_tpu.table import Table
+
+    v = compat.DataFrame(Table(
+        id=np.array(["a", "b", "c", "d", "e"], dtype=object),
+        age=np.array([30, 40, 50, 60, 70]),
+    ))
+    e = compat.DataFrame(Table(
+        src=np.array(["a", "b", "c", "a"], dtype=object),
+        dst=np.array(["b", "c", "d", "e"], dtype=object),
+        rel=np.array(["f", "f", "g", "g"], dtype=object),
+    ))
+    return GraphFrame(v, e)
+
+
+def test_bfs_sql_expressions_paths_dataframe(shim):
+    g = graph_with_attrs(shim)
+    paths = g.bfs("age = 30", "age = 60")
+    assert paths.columns == ["from", "e0", "v1", "e1", "v2", "e2", "to"]
+    row = paths.collect()[0]
+    assert row["from"] == "a" and row["to"] == "d"
+    assert row["e0"] == ("a", "b") and row["v1"] == "b"
+    # unreachable target set -> empty frame
+    assert g.bfs("age = 60", "age = 30").count() == 0
+    # from == to -> zero-hop path
+    z = g.bfs("id = 'c'", "age > 45")
+    assert z.collect()[0]["from"] == "c" and z.collect()[0]["to"] == "c"
+
+
+def test_find_motifs_dataframe(shim):
+    g = graph_with_attrs(shim)
+    m = g.find("(x)-[e]->(y); (y)-[]->(z)")
+    assert set(m.columns) == {"x", "e", "y", "z"}
+    rows = {(r["x"], r["y"], r["z"]) for r in m.collect()}
+    assert rows == {("a", "b", "c"), ("b", "c", "d")}
+    first = m.collect()[0]
+    assert first["e"] == (first["x"], first["y"])  # edge cells are id pairs
+
+
+def test_filter_vertices_edges_sql(shim):
+    g = graph_with_attrs(shim)
+    sub = g.filterVertices("age < 55")
+    assert sub.vertices.count() == 3
+    assert sub.edges.count() == 2  # a->b, b->c survive
+    # filtered frames speak vertex ids, never engine indices or bookkeeping
+    assert "orig" not in sub.vertices.columns
+    assert {(r["src"], r["dst"]) for r in sub.edges.collect()} == {
+        ("a", "b"), ("b", "c")
+    }
+    sub2 = g.filterEdges("rel = 'g'")
+    assert sub2.edges.count() == 2
+    # src/dst in edge predicates are id-valued (GraphFrames semantics)
+    assert g.filterEdges("src = 'a'").edges.count() == 2
+    iso = sub2.dropIsolatedVertices()
+    assert iso.vertices.count() == 4  # b drops (only 'f' edges touched it)
+    lp = sub.labelPropagation(maxIter=2)
+    assert "orig" not in lp.columns
+
+
+def test_bfs_max_path_length_zero_means_no_traversal(shim):
+    g = graph_with_attrs(shim)
+    assert g.bfs("id = 'a'", "id = 'd'", maxPathLength=0).count() == 0
+    z = g.bfs("age > 25", "age < 45", maxPathLength=0)
+    assert {r["from"] for r in z.collect()} == {"a", "b"}  # zero-hop overlap
+
+
 def test_install_refuses_real_pyspark(shim, monkeypatch):
     import types
 
