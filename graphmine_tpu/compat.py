@@ -43,9 +43,11 @@ from graphmine_tpu import frames as _frames
 from graphmine_tpu.table import Table, _isnull
 
 __all__ = [
-    "DataFrame", "GraphFrame", "RDD", "Row", "SQLContext", "SparkConf",
-    "SparkContext", "SparkSession", "install", "main",
-    "monotonically_increasing_id", "udf",
+    "Column", "DataFrame", "GraphFrame", "RDD", "Row", "SQLContext",
+    "SparkConf", "SparkContext", "SparkSession", "asc", "col", "collect_list",
+    "collect_set", "column", "count", "countDistinct", "desc", "first",
+    "install", "lit", "main", "mean", "monotonically_increasing_id", "udf",
+    "when",
 ]
 
 
@@ -103,18 +105,290 @@ class Row(tuple):
 
 
 # ---------------------------------------------------------------------------
-# Column expressions (just enough for the script's call sites)
+# Column expressions — pyspark.sql.Column / functions surface
 # ---------------------------------------------------------------------------
 
 
-class _UDFCol:
+class Column:
+    """Lazy column expression: evaluated against a :class:`Table` at use
+    time (``df.filter(F.col("age") > 30)``, ``df.withColumn("y", ...)``).
+
+    Comparisons follow SQL three-valued logic collapsed to ``False`` for
+    null operands (matching ``Table.filter``'s predicate strings)."""
+
+    def __init__(self, eval_fn, name: str = "col"):
+        self._eval = eval_fn
+        self._name = name
+
+    # construction helpers --------------------------------------------------
+
+    @staticmethod
+    def _coerce(other) -> "Column":
+        if isinstance(other, Column):
+            return other
+        return lit(other)
+
+    def _binop(self, other, fn, name) -> "Column":
+        other = Column._coerce(other)
+        return Column(lambda t: fn(self._eval(t), other._eval(t)),
+                      f"({self._name} {name} {other._name})")
+
+    def _cmp(self, other, op) -> "Column":
+        from graphmine_tpu.table import _compare
+
+        other = Column._coerce(other)
+        return Column(
+            lambda t: _compare(_as_arr(self._eval(t)), op, _as_arr(other._eval(t))),
+            f"({self._name} {op} {other._name})",
+        )
+
+    # comparisons (SQL null semantics) --------------------------------------
+
+    def __eq__(self, other):  # noqa: D105
+        return self._cmp(other, "=")
+
+    def __ne__(self, other):  # noqa: D105
+        return self._cmp(other, "!=")
+
+    def __lt__(self, other):
+        return self._cmp(other, "<")
+
+    def __le__(self, other):
+        return self._cmp(other, "<=")
+
+    def __gt__(self, other):
+        return self._cmp(other, ">")
+
+    def __ge__(self, other):
+        return self._cmp(other, ">=")
+
+    __hash__ = None  # mirrors pyspark: Column is unhashable
+
+    # boolean algebra over masks --------------------------------------------
+
+    def __and__(self, other):
+        return self._binop(other, lambda a, b: _as_bool(a) & _as_bool(b), "AND")
+
+    def __or__(self, other):
+        return self._binop(other, lambda a, b: _as_bool(a) | _as_bool(b), "OR")
+
+    def __invert__(self):
+        return Column(lambda t: ~_as_bool(self._eval(t)), f"(NOT {self._name})")
+
+    # arithmetic -------------------------------------------------------------
+
+    def __add__(self, other):
+        return self._binop(other, lambda a, b: a + b, "+")
+
+    def __radd__(self, other):
+        return Column._coerce(other).__add__(self)
+
+    def __sub__(self, other):
+        return self._binop(other, lambda a, b: a - b, "-")
+
+    def __rsub__(self, other):
+        return Column._coerce(other).__sub__(self)
+
+    def __mul__(self, other):
+        return self._binop(other, lambda a, b: a * b, "*")
+
+    def __rmul__(self, other):
+        return Column._coerce(other).__mul__(self)
+
+    def __truediv__(self, other):
+        return self._binop(other, lambda a, b: a / b, "/")
+
+    def __neg__(self):
+        return Column(lambda t: -self._eval(t), f"(- {self._name})")
+
+    # pyspark Column methods -------------------------------------------------
+
+    def isNull(self) -> "Column":
+        return Column(lambda t: _isnull(_as_arr(self._eval(t))),
+                      f"({self._name} IS NULL)")
+
+    def isNotNull(self) -> "Column":
+        return Column(lambda t: ~_isnull(_as_arr(self._eval(t))),
+                      f"({self._name} IS NOT NULL)")
+
+    def isin(self, *values) -> "Column":
+        vals = list(values[0]) if len(values) == 1 and isinstance(
+            values[0], (list, tuple, set)) else list(values)
+
+        def ev(t):
+            a = _as_arr(self._eval(t))
+            return np.isin(a, np.asarray(vals, dtype=a.dtype if a.dtype != object
+                                         else object)) & ~_isnull(a)
+
+        return Column(ev, f"({self._name} IN ...)")
+
+    def like(self, pattern: str) -> "Column":
+        from graphmine_tpu.table import _like
+
+        return Column(lambda t: _like(_as_arr(self._eval(t)), pattern),
+                      f"({self._name} LIKE {pattern!r})")
+
+    def contains(self, sub: str) -> "Column":
+        return self.like(f"%{sub}%")
+
+    def startswith(self, prefix: str) -> "Column":
+        return self.like(f"{prefix}%")
+
+    def endswith(self, suffix: str) -> "Column":
+        return self.like(f"%{suffix}")
+
+    def alias(self, name: str) -> "Column":
+        c = Column(self._eval, name)
+        return c
+
+    def cast(self, dtype) -> "Column":
+        np_t = {"int": np.int64, "long": np.int64, "bigint": np.int64,
+                "float": np.float32, "double": np.float64,
+                "string": object}.get(dtype, dtype)
+
+        def ev(t):
+            a = _as_arr(self._eval(t))
+            if np_t is object:
+                return np.frompyfunc(
+                    lambda v: None if v is None else str(v), 1, 1)(a).astype(object)
+            return a.astype(np_t)
+
+        return Column(ev, self._name)
+
+    def asc(self) -> "_SortKey":
+        return _SortKey(self._name, ascending=True)
+
+    def desc(self) -> "_SortKey":
+        return _SortKey(self._name, ascending=False)
+
+    def otherwise(self, value) -> "Column":
+        raise TypeError("otherwise() follows when(); use F.when(cond, v).otherwise(...)")
+
+
+class _SortKey:
+    def __init__(self, name: str, ascending: bool):
+        self.name, self.ascending = name, ascending
+
+
+class _WhenColumn(Column):
+    """``F.when(cond, value)`` chain; closes with ``.otherwise(value)``."""
+
+    def __init__(self, branches):
+        self._branches = branches  # list of (cond Column, value Column)
+        super().__init__(self._evaluate, "CASE WHEN")
+
+    def when(self, cond: Column, value) -> "_WhenColumn":
+        return _WhenColumn(self._branches + [(cond, Column._coerce(value))])
+
+    def otherwise(self, value) -> Column:
+        other = Column._coerce(value)
+
+        def ev(t):
+            out = _as_arr(other._eval(t))
+            return self._fold(t, out)
+
+        return Column(ev, "CASE WHEN")
+
+    def _evaluate(self, t):
+        # un-terminated when(): missing branches are null (pyspark semantics)
+        first = _as_arr(self._branches[0][1]._eval(t))
+        base = (np.full(len(t), np.nan)
+                if first.dtype != object else np.full(len(t), None, object))
+        return self._fold(t, base)
+
+    def _fold(self, t, out):
+        for cond, val in reversed(self._branches):
+            out = np.where(_as_bool(cond._eval(t)), _as_arr(val._eval(t)), out)
+        return out
+
+
+def _as_arr(v) -> np.ndarray:
+    a = np.asarray(v)
+    if a.dtype.kind in ("U", "S"):
+        a = a.astype(object)
+    return a
+
+
+def _as_bool(v) -> np.ndarray:
+    a = np.asarray(v)
+    if a.dtype == object:
+        return np.frompyfunc(lambda x: bool(x) if x is not None else False,
+                             1, 1)(a).astype(bool)
+    return a.astype(bool)
+
+
+def col(name: str) -> Column:
+    return Column(lambda t: t[name], name)
+
+
+column = col
+
+
+def lit(value) -> Column:
+    return Column(
+        lambda t: np.full(len(t), None, object) if value is None
+        else np.full(len(t), value), repr(value)
+    )
+
+
+def when(cond: Column, value) -> _WhenColumn:
+    return _WhenColumn([(cond, Column._coerce(value))])
+
+
+def desc(name: str) -> _SortKey:
+    return _SortKey(name, ascending=False)
+
+
+def asc(name: str) -> _SortKey:
+    return _SortKey(name, ascending=True)
+
+
+class _AggColumn:
+    """Marker from aggregate functions, consumed by ``GroupedData.agg``."""
+
+    def __init__(self, fn: str, col_name: str, out: str):
+        self.fn, self.col_name, self.out = fn, col_name, out
+
+    def alias(self, name: str) -> "_AggColumn":
+        return _AggColumn(self.fn, self.col_name, name)
+
+
+def _agg_fn(fn: str):
+    def make(col_name="*") -> _AggColumn:
+        name = col_name if isinstance(col_name, str) else getattr(
+            col_name, "_name", "col")
+        return _AggColumn(fn, name, f"{fn}({name})")
+
+    make.__name__ = fn
+    return make
+
+
+count = _agg_fn("count")
+spark_sum = _agg_fn("sum")
+spark_min = _agg_fn("min")
+spark_max = _agg_fn("max")
+avg = _agg_fn("mean")
+mean = avg
+first = _agg_fn("first")
+countDistinct = _agg_fn("count_distinct")
+collect_list = _agg_fn("collect_list")
+collect_set = _agg_fn("collect_set")
+
+
+class _UDFCol(Column):
     """Pending ``udf(...)(column)`` application (``Graphframes.py:71-72``)."""
 
     def __init__(self, fn, col):
         self.fn, self.col = fn, col
+        super().__init__(self.evaluate, "udf")
 
     def evaluate(self, table: Table) -> np.ndarray:
-        vals = table[self.col] if isinstance(self.col, str) else np.asarray(self.col)
+        if isinstance(self.col, Column):
+            vals = _as_arr(self.col._eval(table))
+        elif isinstance(self.col, str):
+            vals = table[self.col]
+        else:
+            vals = np.asarray(self.col)
         out = np.frompyfunc(
             lambda v: None if v is None else self.fn(v), 1, 1
         )(vals)
@@ -201,19 +475,42 @@ class DataFrame:
         return DataFrame(self._t.with_column_renamed(a, b))
 
     def filter(self, cond) -> "DataFrame":
+        if isinstance(cond, Column):
+            cond = _as_bool(cond._eval(self._t))
         return DataFrame(self._t.filter(cond))
 
     where = filter
 
-    def select(self, *names) -> "DataFrame":
-        return DataFrame(self._t.select(*names))
+    def select(self, *exprs) -> "DataFrame":
+        if not any(isinstance(e, Column) for e in exprs):
+            return DataFrame(self._t.select(*exprs))
+        cols: dict = {}
+        for e in exprs:
+            if isinstance(e, Column):
+                cols[e._name] = _as_arr(e._eval(self._t))
+            else:
+                for name in [e] if isinstance(e, str) else e:
+                    cols[name] = self._t[name]
+        return DataFrame(Table(cols))
 
     def withColumn(self, name: str, value) -> "DataFrame":
         if isinstance(value, _MonotonicId):
             return DataFrame(self._t.with_row_ids(name))
-        if isinstance(value, _UDFCol):
-            value = value.evaluate
+        if isinstance(value, Column):
+            value = _as_arr(value._eval(self._t))
         return DataFrame(self._t.with_column(name, value))
+
+    def __getitem__(self, name: str) -> Column:
+        if name not in self._t.columns:
+            raise KeyError(name)
+        return col(name)
+
+    def __getattr__(self, name: str) -> Column:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in self._t.columns:
+            return col(name)
+        raise AttributeError(name)
 
     def distinct(self) -> "DataFrame":
         return DataFrame(self._t.distinct())
@@ -239,8 +536,29 @@ class DataFrame:
     def fillna(self, value, subset=None) -> "DataFrame":
         return DataFrame(self._t.fillna(value, subset))
 
-    def sort(self, *by, ascending: bool = True) -> "DataFrame":
-        return DataFrame(self._t.sort(*by, ascending=ascending))
+    def sort(self, *by, ascending=True) -> "DataFrame":
+        """pyspark forms: names, Columns, F.desc/F.asc keys, or
+        ``ascending=[bool, ...]`` (one per key)."""
+        if isinstance(ascending, (list, tuple)):
+            defaults = [bool(a) for a in ascending]
+            if len(defaults) != len(by):
+                raise ValueError(
+                    f"ascending has {len(defaults)} entries for {len(by)} keys"
+                )
+        else:
+            defaults = [bool(ascending)] * len(by)
+        names, flags = [], []
+        for b, d in zip(by, defaults):
+            if isinstance(b, _SortKey):
+                names.append(b.name)
+                flags.append(b.ascending)
+            elif isinstance(b, Column):
+                names.append(b._name)
+                flags.append(d)
+            else:
+                names.append(b)
+                flags.append(d)
+        return DataFrame(self._t.sort(*names, ascending=flags))
 
     orderBy = sort
 
@@ -265,7 +583,13 @@ class DataFrame:
     groupby = groupBy
 
     def agg(self, *specs, **named) -> "DataFrame":
-        return DataFrame(self._t.agg(*specs, **named))
+        plain = []
+        for s in specs:  # pyspark: df.agg(F.sum("v"), ...) markers
+            if isinstance(s, _AggColumn):
+                named[s.out] = (s.col_name, s.fn)
+            else:
+                plain.append(s)
+        return DataFrame(self._t.agg(*plain, **named))
 
     def show(self, n: int = 20, truncate=True) -> None:
         width = 20 if truncate is True else (0 if truncate is False else int(truncate))
@@ -327,7 +651,13 @@ class _GroupedData:
         return DataFrame(self._g.count())
 
     def agg(self, *specs, **named) -> DataFrame:
-        return DataFrame(self._g.agg(*specs, **named))
+        plain = []
+        for s in specs:  # F.sum("v").alias("total") markers → kwargs form
+            if isinstance(s, _AggColumn):
+                named[s.out] = (s.col_name, s.fn)
+            else:
+                plain.append(s)
+        return DataFrame(self._g.agg(*plain, **named))
 
     def sum(self, *cols) -> DataFrame:
         return DataFrame(self._g.sum(*cols))
@@ -518,7 +848,7 @@ class GraphFrame:
     def _result_frame(self, vname, vvalues, ename=None, evalues=None) -> "GraphFrame":
         g = object.__new__(GraphFrame)
         g._gf = self._gf
-        vcols = dict(self._gf.vertices)
+        vcols = _visible_vertex_cols(self._gf)
         vcols[vname] = vvalues
         g._v = DataFrame(Table(vcols))
         ecols = dict(self._e._t.to_dict())
@@ -716,12 +1046,34 @@ def _build_modules() -> dict:
     sql.SQLContext = SQLContext
     sql.DataFrame = DataFrame
     sql.Row = Row
+    sql.Column = Column
     sql.functions = functions
-    sql.__all__ = ["SparkSession", "SQLContext", "DataFrame", "Row", "functions"]
+    sql.__all__ = ["SparkSession", "SQLContext", "DataFrame", "Row", "Column",
+                   "functions"]
 
     functions.udf = udf
     functions.monotonically_increasing_id = monotonically_increasing_id
-    functions.__all__ = ["udf", "monotonically_increasing_id"]
+    functions.col = col
+    functions.column = column
+    functions.lit = lit
+    functions.when = when
+    functions.desc = desc
+    functions.asc = asc
+    functions.count = count
+    functions.sum = spark_sum
+    functions.min = spark_min
+    functions.max = spark_max
+    functions.avg = avg
+    functions.mean = mean
+    functions.first = first
+    functions.countDistinct = countDistinct
+    functions.collect_list = collect_list
+    functions.collect_set = collect_set
+    functions.__all__ = [
+        "udf", "monotonically_increasing_id", "col", "column", "lit", "when",
+        "desc", "asc", "count", "sum", "min", "max", "avg", "mean", "first",
+        "countDistinct", "collect_list", "collect_set",
+    ]
 
     graphframes.GraphFrame = GraphFrame
     graphframes.__all__ = ["GraphFrame"]

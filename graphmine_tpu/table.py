@@ -460,11 +460,24 @@ class Table:
 
     monotonically_increasing_id = with_row_ids
 
-    def sort(self, *by: str, ascending: bool = True) -> "Table":
-        """Stable multi-column sort. Nulls order first ascending / last
-        descending (Spark's asc_nulls_first / desc_nulls_last defaults)."""
+    def sort(self, *by: str, ascending: "bool | Sequence[bool]" = True) -> "Table":
+        """Stable multi-column sort; ``ascending`` may be one bool or one
+        per key (Spark's list form). Nulls order first ascending / last
+        descending (asc_nulls_first / desc_nulls_last defaults).
+
+        Descending keys are implemented by inverting a dense rank rather
+        than reversing the sorted order, so every key direction is stable
+        (reversal would flip tie order)."""
+        if isinstance(ascending, (list, tuple)):
+            flags = [bool(a) for a in ascending]
+            if len(flags) != len(by):
+                raise ValueError(
+                    f"ascending has {len(flags)} entries for {len(by)} sort keys"
+                )
+        else:
+            flags = [bool(ascending)] * len(by)
         keys = []
-        for c in reversed(by):
+        for c, asc_ in zip(reversed(by), reversed(flags)):
             col = self._cols[c]
             null = _isnull(col)
             if col.dtype == object:
@@ -473,11 +486,14 @@ class Table:
                 vals = np.where(null, 0.0, col)
             else:
                 vals = col
-            keys.append(vals)
-            keys.append(~null)  # more significant than the value: nulls first
+            if asc_:
+                keys.append(vals)
+                keys.append(~null)  # more significant than the value: nulls first
+            else:
+                _, inv = np.unique(vals, return_inverse=True)
+                keys.append(-inv)  # inverted dense rank = descending, any dtype
+                keys.append(null)  # nulls last
         order = np.lexsort(tuple(keys))
-        if not ascending:
-            order = order[::-1]
         return self._replace({k: v[order] for k, v in self._cols.items()})
 
     orderBy = None  # assigned below

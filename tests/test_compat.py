@@ -257,6 +257,74 @@ def test_bfs_max_path_length_zero_means_no_traversal(shim):
     assert {r["from"] for r in z.collect()} == {"a", "b"}  # zero-hop overlap
 
 
+def test_column_expressions(shim):
+    from pyspark.sql import functions as F
+
+    from graphmine_tpu.table import Table
+
+    df = compat.DataFrame(Table(
+        name=np.array(["ann", "bob", None, "dan"], dtype=object),
+        age=np.array([30.0, 40.0, 50.0, np.nan]),
+        city=np.array(["x", "y", "x", "y"], dtype=object),
+    ))
+    # comparisons, boolean algebra, null semantics
+    assert df.filter(F.col("age") > 35).count() == 2
+    assert df.filter((F.col("age") > 35) & (F.col("city") == "y")).count() == 1
+    assert df.filter(F.col("name").isNull()).count() == 1
+    assert df.filter(F.col("age").isNotNull() & ~(F.col("city") == "x")).count() == 1
+    assert df.filter(df.name.startswith("a")).count() == 1  # attribute access
+    assert df.filter(F.col("name").isin("ann", "dan")).count() == 2
+    # arithmetic + withColumn + alias/select
+    out = df.withColumn("next_age", F.col("age") + 1)
+    assert out.collect()[0]["next_age"] == 31.0
+    sel = df.select(F.col("age").alias("years"), "city")
+    assert sel.columns == ["years", "city"]
+    # when/otherwise
+    flagged = df.withColumn(
+        "grp", F.when(F.col("age") < 35, "young").otherwise("old"))
+    assert [r["grp"] for r in flagged.collect()] == ["young", "old", "old", "old"]
+    # lit + cast
+    casted = df.select(F.col("age").cast("string").alias("s"))
+    assert casted.collect()[0]["s"] == "30.0"
+
+
+def test_column_aggregates_and_sort_desc(shim):
+    from pyspark.sql import functions as F
+
+    from graphmine_tpu.table import Table
+
+    df = compat.DataFrame(Table(
+        g=np.array(["a", "a", "b"], dtype=object),
+        v=np.array([1.0, 3.0, 5.0]),
+    ))
+    agg = df.groupBy("g").agg(F.sum("v").alias("total"), F.count("*"),
+                              F.max("v"))
+    row = {r["g"]: (r["total"], r["count(*)"], r["max(v)"]) for r in agg.collect()}
+    assert row["a"] == (4.0, 2, 3.0) and row["b"] == (5.0, 1, 5.0)
+    top = df.sort(F.desc("v")).collect()[0]
+    assert top["v"] == 5.0
+    mixed = df.sort(F.asc("g"), F.desc("v")).collect()
+    assert [r["v"] for r in mixed] == [3.0, 1.0, 5.0]
+    # global agg with Column markers (df.agg, no groupBy)
+    tot = df.agg(F.sum("v").alias("total"), F.count("*"))
+    assert tot.collect()[0]["total"] == 9.0 and tot.collect()[0]["count(*)"] == 3
+    # ascending list form
+    lst = df.sort("g", "v", ascending=[True, False]).collect()
+    assert [r["v"] for r in lst] == [3.0, 1.0, 5.0]
+    # desc-major with asc-minor stays stable per key
+    t2 = compat.DataFrame(Table(
+        a=np.array([1, 1, 2]), b=np.array([2.0, 1.0, 0.0])))
+    out = t2.sort(F.desc("a"), F.asc("b")).collect()
+    assert [(r["a"], r["b"]) for r in out] == [(2, 0.0), (1, 1.0), (1, 2.0)]
+
+
+def test_pagerank_on_filtered_frame_hides_bookkeeping(shim):
+    g = graph_with_attrs(shim)
+    pr = g.filterVertices("age < 55").pageRank(maxIter=5)
+    assert "orig" not in pr.vertices.columns
+    assert "pagerank" in pr.vertices.columns
+
+
 def test_install_refuses_real_pyspark(shim, monkeypatch):
     import types
 
