@@ -130,8 +130,10 @@ class Column:
 
     def _binop(self, other, fn, name) -> "Column":
         other = Column._coerce(other)
-        return Column(lambda t: fn(self._eval(t), other._eval(t)),
-                      f"({self._name} {name} {other._name})")
+        return Column(
+            lambda t: fn(_numeric_view(self._eval(t)), _numeric_view(other._eval(t))),
+            f"({self._name} {name} {other._name})",
+        )
 
     def _cmp(self, other, op) -> "Column":
         from graphmine_tpu.table import _compare
@@ -217,8 +219,14 @@ class Column:
 
         def ev(t):
             a = _as_arr(self._eval(t))
-            return np.isin(a, np.asarray(vals, dtype=a.dtype if a.dtype != object
-                                         else object)) & ~_isnull(a)
+            try:
+                arr = np.asarray(vals, dtype=object if a.dtype == object
+                                 else a.dtype)
+                m = np.isin(a, arr)
+            except (ValueError, TypeError):  # incomparable types: SQL false
+                sv = set(vals)
+                m = np.frompyfunc(lambda x: x in sv, 1, 1)(a).astype(bool)
+            return m & ~_isnull(a)
 
         return Column(ev, f"({self._name} IN ...)")
 
@@ -248,10 +256,21 @@ class Column:
 
         def ev(t):
             a = _as_arr(self._eval(t))
-            if np_t is object:
-                return np.frompyfunc(
-                    lambda v: None if v is None else str(v), 1, 1)(a).astype(object)
-            return a.astype(np_t)
+            null = _isnull(a)
+            if np_t is object:  # nulls stay null, never the string 'nan'/'None'
+                out = np.frompyfunc(lambda v: str(v), 1, 1)(a).astype(object)
+                out[null] = None
+                return out
+            base = np.where(null, 0, a).astype(np_t)
+            if not null.any():
+                return base
+            if np.issubdtype(np_t, np.floating):
+                out = base.copy()
+                out[null] = np.nan
+                return out
+            out = base.astype(object)  # nullable-int convention
+            out[null] = None
+            return out
 
         return Column(ev, self._name)
 
@@ -294,11 +313,13 @@ class _WhenColumn(Column):
         first = _as_arr(self._branches[0][1]._eval(t))
         base = (np.full(len(t), np.nan)
                 if first.dtype != object else np.full(len(t), None, object))
-        return self._fold(t, base)
+        return self._fold(t, base, first_arr=first)
 
-    def _fold(self, t, out):
-        for cond, val in reversed(self._branches):
-            out = np.where(_as_bool(cond._eval(t)), _as_arr(val._eval(t)), out)
+    def _fold(self, t, out, first_arr=None):
+        for i, (cond, val) in reversed(list(enumerate(self._branches))):
+            arr = first_arr if (i == 0 and first_arr is not None) else _as_arr(
+                val._eval(t))
+            out = np.where(_as_bool(cond._eval(t)), arr, out)
         return out
 
 
@@ -306,6 +327,20 @@ def _as_arr(v) -> np.ndarray:
     a = np.asarray(v)
     if a.dtype.kind in ("U", "S"):
         a = a.astype(object)
+    return a
+
+
+def _numeric_view(v) -> np.ndarray:
+    """Arithmetic view of a column: object-promoted nullable-int columns
+    (None for null) become float64 with NaN so null propagates through
+    +,-,*,/ as in Spark; non-numeric object columns pass through."""
+    from graphmine_tpu.table import _object_as_float
+
+    a = _as_arr(v)
+    if a.dtype == object:
+        num = _object_as_float(a, _isnull(a))
+        if num is not None:
+            return num
     return a
 
 
@@ -485,12 +520,20 @@ class DataFrame:
         if not any(isinstance(e, Column) for e in exprs):
             return DataFrame(self._t.select(*exprs))
         cols: dict = {}
+
+        def put(name, values):
+            if name in cols:  # a dict cannot hold Spark's duplicate columns
+                raise ValueError(
+                    f"duplicate output column {name!r} in select; alias() one"
+                )
+            cols[name] = values
+
         for e in exprs:
             if isinstance(e, Column):
-                cols[e._name] = _as_arr(e._eval(self._t))
+                put(e._name, _as_arr(e._eval(self._t)))
             else:
                 for name in [e] if isinstance(e, str) else e:
-                    cols[name] = self._t[name]
+                    put(name, self._t[name])
         return DataFrame(Table(cols))
 
     def withColumn(self, name: str, value) -> "DataFrame":
@@ -634,6 +677,10 @@ class DataFrame:
         return RDD(self.collect())
 
     @property
+    def write(self) -> "_DataFrameWriter":
+        return _DataFrameWriter(self)
+
+    @property
     def schema(self):
         return self._t.schema
 
@@ -723,6 +770,44 @@ class _DataFrameReader:
         for t in tables[1:]:
             out = out.union(t)
         return DataFrame(out)
+
+    def csv(self, path: str, header: bool = False, sep: str = ",",
+            inferSchema: bool = False) -> DataFrame:
+        # Spark default: all-string columns unless inferSchema=True
+        return DataFrame(Table.read_csv(path, header=header, sep=sep,
+                                        infer_schema=inferSchema))
+
+
+class _DataFrameWriter:
+    """``df.write.mode("overwrite").parquet(path)`` — Spark's writer chain.
+
+    Default mode is ``error`` (refuse to clobber an existing path), as in
+    Spark; the target is a single file, not a part-file directory."""
+
+    def __init__(self, df: DataFrame, mode: str = "error"):
+        self._df = df
+        self._mode = mode
+
+    def mode(self, m: str) -> "_DataFrameWriter":
+        if m not in ("error", "errorifexists", "overwrite", "ignore"):
+            raise ValueError(f"unsupported write mode {m!r}")
+        return _DataFrameWriter(self._df, m)
+
+    def _check(self, path: str) -> bool:
+        if os.path.exists(path):
+            if self._mode in ("error", "errorifexists"):
+                raise FileExistsError(f"path already exists: {path!r}")
+            if self._mode == "ignore":
+                return False
+        return True
+
+    def parquet(self, path: str, compression: str = "snappy") -> None:
+        if self._check(path):
+            self._df._t.write_parquet(path, compression=compression)
+
+    def csv(self, path: str, header: bool = False) -> None:
+        if self._check(path):
+            self._df._t.write_csv(path, header=header)
 
 
 class _SessionBuilder:

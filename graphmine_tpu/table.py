@@ -718,6 +718,63 @@ class Table:
         data = list(zip(*rows)) or [[] for _ in names]
         return cls({n: np.asarray(list(v)) for n, v in zip(names, data)})
 
+    def write_parquet(self, path: str, compression: str = "snappy") -> None:
+        """Write one parquet file; ``None`` stays a parquet null (so the
+        reference's null-domain rows round-trip, ``Graphframes.py:30``)."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        pq.write_table(pa.table(self._to_arrow_cols()), path,
+                       compression=compression)
+
+    def write_csv(self, path: str, header: bool = True) -> None:
+        import pyarrow as pa
+        import pyarrow.csv as pacsv
+
+        pacsv.write_csv(
+            pa.table(self._to_arrow_cols()), path,
+            write_options=pacsv.WriteOptions(include_header=header),
+        )
+
+    def _to_arrow_cols(self) -> dict:
+        import pyarrow as pa
+
+        out = {}
+        for name, col in self._cols.items():
+            if col.dtype == object:
+                out[name] = pa.array(col.tolist())  # None -> null
+            else:
+                out[name] = pa.array(col)
+        return out
+
+    @classmethod
+    def read_csv(cls, path: str, header: bool = True, sep: str = ",",
+                 infer_schema: bool = True) -> "Table":
+        """CSV read (``spark.read.csv``); without a header row, columns are
+        named ``_c0..`` as Spark does. ``infer_schema=False`` keeps every
+        column as strings (Spark's CSV default)."""
+        import pyarrow as pa
+        import pyarrow.csv as pacsv
+
+        opts = pacsv.ReadOptions(autogenerate_column_names=not header)
+        table = pacsv.read_csv(
+            path, read_options=opts,
+            parse_options=pacsv.ParseOptions(delimiter=sep),
+        )
+        if not header:
+            table = table.rename_columns(
+                [f"_c{i}" for i in range(table.num_columns)]
+            )
+        if not infer_schema:
+            table = pa.table({
+                name: table.column(name).cast(pa.string())
+                for name in table.column_names
+            })
+        return cls({
+            name: table.column(name).to_numpy(zero_copy_only=False)
+            for name in table.column_names
+        })
+
 
 # Spark join-type names (and their no-underscore forms) → canonical type.
 _JOIN_ALIASES = {

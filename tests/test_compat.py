@@ -318,11 +318,65 @@ def test_column_aggregates_and_sort_desc(shim):
     assert [(r["a"], r["b"]) for r in out] == [(2, 0.0), (1, 1.0), (1, 2.0)]
 
 
+def test_column_null_propagation_and_casts(shim):
+    from pyspark.sql import functions as F
+
+    from graphmine_tpu.table import Table
+
+    df = compat.DataFrame(Table(
+        x=np.array([1, None, 3], dtype=object),       # post-join nullable int
+        age=np.array([30.0, np.nan]).repeat([2, 1]),  # [30, 30, nan]
+    ))
+    y = df.withColumn("y", F.col("x") + 1).collect()
+    assert y[0]["y"] == 2.0 and np.isnan(y[1]["y"]) and y[2]["y"] == 4.0
+    s = df.select(F.col("age").cast("string").alias("s")).collect()
+    assert s[2]["s"] is None  # null never becomes the string 'nan'
+    i = df.select(F.col("age").cast("int").alias("i")).collect()
+    assert i[0]["i"] == 30 and i[2]["i"] is None
+    # isin with incomparable value types is SQL-false, not a crash
+    assert df.filter(F.col("age").isin("a", "b")).count() == 0
+    with pytest.raises(ValueError, match="duplicate"):
+        df.select("x", F.col("x"))
+
+
+def test_csv_reader_spark_string_default(shim, tmp_path):
+    from graphmine_tpu.table import Table
+    from pyspark.sql import SparkSession
+
+    p = str(tmp_path / "d.csv")
+    compat.DataFrame(Table(v=np.array([1, 2]))).write.csv(p, header=True)
+    session = SparkSession.builder.getOrCreate()
+    assert session.read.csv(p, header=True)._t.schema["v"] == np.dtype(object)
+    assert session.read.csv(p, header=True, inferSchema=True)._t.schema[
+        "v"] == np.dtype(np.int64)
+
+
 def test_pagerank_on_filtered_frame_hides_bookkeeping(shim):
     g = graph_with_attrs(shim)
     pr = g.filterVertices("age < 55").pageRank(maxIter=5)
     assert "orig" not in pr.vertices.columns
     assert "pagerank" in pr.vertices.columns
+
+
+def test_write_modes_and_reader_csv(shim, tmp_path):
+    from graphmine_tpu.table import Table
+
+    df = compat.DataFrame(Table(k=np.array(["a", "b"], dtype=object),
+                                v=np.array([1, 2])))
+    p = str(tmp_path / "out.parquet")
+    df.write.parquet(p)
+    with pytest.raises(FileExistsError):
+        df.write.parquet(p)  # Spark default mode: error
+    df.write.mode("overwrite").parquet(p)
+    df.write.mode("ignore").parquet(p)  # silently keeps existing
+    from pyspark.sql import SparkSession
+
+    back = SparkSession.builder.getOrCreate().read.parquet(p)
+    assert back.count() == 2 and back.columns == ["k", "v"]
+    c = str(tmp_path / "out.csv")
+    df.write.csv(c, header=True)
+    csv_back = SparkSession.builder.getOrCreate().read.csv(c, header=True)
+    assert [r["k"] for r in csv_back.collect()] == ["a", "b"]
 
 
 def test_install_refuses_real_pyspark(shim, monkeypatch):

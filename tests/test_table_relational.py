@@ -316,6 +316,28 @@ def test_empty_key_list_rejected_and_cross_rejects_keys():
         l.join(r2, on="x", how="cross")
 
 
+def test_parquet_and_csv_roundtrip(tmp_path):
+    t = Table(
+        s=np.array(["a", None, "c"], dtype=object),
+        x=np.array([1.5, np.nan, 3.0]),
+        n=np.array([1, 2, 3]),
+    )
+    pq_path = str(tmp_path / "t.parquet")
+    t.write_parquet(pq_path)
+    back = Table.read_parquet(pq_path)
+    assert back["s"][1] is None and np.isnan(back["x"][1])
+    assert list(back["n"]) == [1, 2, 3]
+
+    csv_path = str(tmp_path / "t.csv")
+    t.select("n").write_csv(csv_path)
+    again = Table.read_csv(csv_path)
+    assert list(again["n"]) == [1, 2, 3]
+    headless = str(tmp_path / "h.csv")
+    t.select("n", "x").write_csv(headless, header=False)
+    cols = Table.read_csv(headless, header=False)
+    assert cols.columns == ["_c0", "_c1"]  # Spark's autogenerated names
+
+
 def test_spark_camelcase_aliases():
     t = Table(g=np.array([1, 1, 2]), v=np.array([1.0, 2.0, 3.0]))
     assert list(t.groupBy("g").count()["count"]) == [2, 1]
