@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -63,6 +64,19 @@ def _extend_widths(max_deg: int) -> np.ndarray:
     while ws[-1] < max_deg:
         ws.append(ws[-1] + ws[-1] // 2)
     return np.asarray(ws, dtype=np.int64)
+
+
+@partial(jax.jit, static_argnames=("w", "fill"))
+def _gather_rows_device(send, starts, degs, w: int, fill: int):
+    """Device-side [n, w] bucket-matrix construction — same output as
+    :func:`_class_rows` with ``values=send``, but the big gather runs on
+    the accelerator against the already-resident ``[M]`` sender array, so
+    the host never materializes (or transfers) the padded matrices."""
+    offs = jnp.arange(w, dtype=jnp.int32)[None, :]
+    idx = starts[:, None] + offs
+    valid = offs < degs[:, None]
+    safe = jnp.minimum(idx, send.shape[0] - 1)
+    return jnp.where(valid, send[safe].astype(jnp.int32), fill)
 
 
 def _class_rows(ptr, deg, eligible, classes, c, w, values, fill, num_values):
@@ -141,8 +155,15 @@ class BucketedModePlan:
 
     @classmethod
     def from_ptr(
-        cls, ptr: np.ndarray, num_vertices: int, send_sorted: np.ndarray | None = None
+        cls, ptr: np.ndarray, num_vertices: int,
+        send_sorted: np.ndarray | None = None,
+        send_device: "jax.Array | None" = None,
     ) -> "BucketedModePlan":
+        """``send_device``: the device-resident ``[M]`` sender array (e.g.
+        ``graph.msg_send``). When given, bucket matrices and hub histogram
+        inputs are built on the accelerator — only ``[n_b]`` row starts and
+        degrees cross the host boundary instead of the ~2.5E padded plan
+        entries. Bit-identical to the host path."""
         ptr = np.asarray(ptr).astype(np.int64)
         deg = ptr[1:] - ptr[:-1]
         m = int(ptr[-1])
@@ -167,22 +188,41 @@ class BucketedModePlan:
         for c in np.unique(classes[bucketed]):
             # Fused plans carry only sender-id matrices — msg_idx would
             # double plan HBM and never be read.
-            ids, mat = _class_rows(
-                ptr, deg, bucketed, classes, c, int(widths[c]),
-                send_sorted, num_vertices if send_sorted is not None else m, m,
-            )
+            if send_device is not None and send_sorted is not None:
+                rows = np.nonzero((classes == c) & bucketed)[0]
+                mat = _gather_rows_device(
+                    send_device,
+                    jnp.asarray(ptr[rows].astype(np.int32)),
+                    jnp.asarray(deg[rows].astype(np.int32)),
+                    int(widths[c]), num_vertices,
+                )
+                ids = rows
+            else:
+                ids, mat = _class_rows(
+                    ptr, deg, bucketed, classes, c, int(widths[c]),
+                    send_sorted, num_vertices if send_sorted is not None else m, m,
+                )
+                mat = jnp.asarray(mat)
             vertex_ids.append(jnp.asarray(ids.astype(np.int32)))
-            (msg_idx if send_sorted is None else send_idx).append(jnp.asarray(mat))
+            (msg_idx if send_sorted is None else send_idx).append(mat)
 
         hist_vertex_ids = hist_send = hist_row_offset = None
         if hist_mask.any():
             hubs = np.nonzero(hist_mask)[0]
-            spans = [np.arange(ptr[v], ptr[v + 1], dtype=np.int64) for v in hubs]
-            pos = np.concatenate(spans)
             rows = np.repeat(np.arange(len(hubs), dtype=np.int64), deg[hubs])
             assert len(hubs) * num_vertices < np.iinfo(np.int32).max
             hist_vertex_ids = jnp.asarray(hubs.astype(np.int32))
-            hist_send = jnp.asarray(send_sorted[pos].astype(np.int32))
+            if send_device is not None:
+                # Hub messages are contiguous CSR spans — device slices, no
+                # host gather or transfer of the hub message payload.
+                hist_send = jnp.concatenate(
+                    [send_device[int(ptr[h]):int(ptr[h + 1])] for h in hubs]
+                ).astype(jnp.int32)
+            else:
+                pos = np.concatenate(
+                    [np.arange(ptr[h], ptr[h + 1], dtype=np.int64) for h in hubs]
+                )
+                hist_send = jnp.asarray(send_sorted[pos].astype(np.int32))
             hist_row_offset = jnp.asarray((rows * num_vertices).astype(np.int32))
 
         return cls(
@@ -215,6 +255,12 @@ def build_graph_and_plan(
     src, dst, num_vertices = _prepare_edges(src, dst, num_vertices)
     ptr, recv, send, _ = _message_csr(src, dst, num_vertices, symmetric, use_native)
     graph = _graph_from_csr(src, dst, ptr, recv, send, num_vertices, symmetric)
+    # Host plan build by default. A device-side variant exists
+    # (from_ptr(send_device=graph.msg_send)) that avoids shipping the
+    # ~2.5E padded plan entries over the host boundary, but it costs one
+    # XLA compile per width class whose shapes change with every graph —
+    # measured a wash warm and far slower cold on the current setup; see
+    # docs/DESIGN.md ("Plan construction placement").
     return graph, BucketedModePlan.from_ptr(ptr, num_vertices, send)
 
 

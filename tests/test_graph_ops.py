@@ -178,3 +178,47 @@ def test_build_graph_and_plan_shares_csr():
         np.asarray(jax.jit(lpa_superstep)(labels, g)),
         np.asarray(jax.jit(lpa_superstep_bucketed)(labels, g, plan)),
     )
+
+
+def test_device_plan_matches_host_plan():
+    """from_ptr(send_device=...) must be bit-identical to the host path —
+    including hub-histogram spans — since the fused superstep consumes
+    either interchangeably."""
+    import jax.numpy as jnp
+
+    import importlib
+
+    # the ops package re-exports a *function* named bucketed_mode, which
+    # shadows the submodule under plain `import ... as`
+    bm = importlib.import_module("graphmine_tpu.ops.bucketed_mode")
+    from graphmine_tpu.graph.container import _message_csr, _prepare_edges
+
+    rng = np.random.default_rng(5)
+    v, e = 512, 20_000  # hub degrees exceed a lowered histogram threshold
+    src = np.minimum(rng.geometric(0.02, e) - 1, v - 1).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    src, dst, v = _prepare_edges(src, dst, v)
+    ptr, recv, send, _ = _message_csr(src, dst, v, True, True)
+
+    old = bm._HIST_MIN_DEG
+    bm._HIST_MIN_DEG = 64
+    try:
+        host = bm.BucketedModePlan.from_ptr(ptr, v, send)
+        dev = bm.BucketedModePlan.from_ptr(ptr, v, send,
+                                           send_device=jnp.asarray(send))
+    finally:
+        bm._HIST_MIN_DEG = old
+
+    assert len(host.send_idx) == len(dev.send_idx)
+    for a, b in zip(host.send_idx, dev.send_idx):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(host.vertex_ids, dev.vertex_ids):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert (host.hist_send is None) == (dev.hist_send is None)
+    if host.hist_send is not None:
+        np.testing.assert_array_equal(np.asarray(host.hist_send),
+                                      np.asarray(dev.hist_send))
+        np.testing.assert_array_equal(np.asarray(host.hist_row_offset),
+                                      np.asarray(dev.hist_row_offset))
+        np.testing.assert_array_equal(np.asarray(host.hist_vertex_ids),
+                                      np.asarray(dev.hist_vertex_ids))
