@@ -406,7 +406,9 @@ class GraphFrame:
         from graphmine_tpu.ops.lof import lof_scores
         if labels is None:
             labels = self.label_propagation()
-        feats = standardize(vertex_features(self.graph(), labels))
+        feats = standardize(vertex_features(
+            self.graph(), labels, triangles_cache=self._triangle_cache()
+        ))
         return lof_scores(feats, k=k, **kw)
 
     def triplets(self):
