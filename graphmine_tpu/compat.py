@@ -970,6 +970,62 @@ class GraphFrame:
             }
         return self._with_result("distances", dcol)
 
+    def aggregateMessages(self, *aggs, sendToSrc=None, sendToDst=None) -> DataFrame:
+        """GraphFrames ``aggregateMessages``: message expressions over the
+        triplet namespace (``AM.src["attr"]``, ``AM.dst["attr"]``,
+        ``AM.edge["attr"]``), aggregated per receiving vertex with
+        ``F.<fn>(AM.msg)`` markers. Returns ``[id, <agg columns...>]`` for
+        vertices that received at least one message (GraphFrames drops the
+        rest)."""
+        if sendToSrc is None and sendToDst is None:
+            raise ValueError("provide sendToSrc and/or sendToDst")
+        if not aggs:
+            raise ValueError("provide at least one aggregate (e.g. F.sum(AM.msg))")
+        for expr in (sendToSrc, sendToDst):
+            if expr is not None and not isinstance(expr, Column):
+                raise TypeError(
+                    "sendToSrc/sendToDst must be Columns over the AM "
+                    "namespace (AM.src['attr'], AM.dst['attr'], ...), got "
+                    f"{expr!r}"
+                )
+        ids = self._ids()
+        e_src = np.asarray(self._gf.edges["src"])
+        e_dst = np.asarray(self._gf.edges["dst"])
+        tcols: dict = {}
+        for name, col in _visible_vertex_cols(self._gf).items():
+            arr = np.asarray(col)
+            tcols[f"src_{name}"] = arr[e_src]
+            tcols[f"dst_{name}"] = arr[e_dst]
+        for name, col in self._gf.edges.items():
+            if name not in ("src", "dst"):
+                tcols[f"edge_{name}"] = np.asarray(col)
+        triplets = Table(tcols)
+
+        recv_parts, msg_parts = [], []
+        if sendToDst is not None:
+            msg_parts.append(_as_arr(sendToDst._eval(triplets)))
+            recv_parts.append(ids[e_dst])
+        if sendToSrc is not None:
+            msg_parts.append(_as_arr(sendToSrc._eval(triplets)))
+            recv_parts.append(ids[e_src])
+        msg_table = Table({
+            "id": np.concatenate(recv_parts),
+            "msg": np.concatenate(msg_parts),
+        })
+        named = {}
+        for a in aggs:
+            if not isinstance(a, _AggColumn):
+                raise TypeError(
+                    f"aggregates must be F.<fn>(AM.msg) markers, got {a!r}"
+                )
+            if a.col_name != "msg":
+                raise TypeError(
+                    "aggregateMessages aggregates operate on AM.msg, got "
+                    f"a reference to {a.col_name!r}"
+                )
+            named[a.out] = ("msg", a.fn)
+        return DataFrame(msg_table.group_by("id").agg(**named))
+
     # -- expression-driven surfaces (GraphFrames SQL strings) --------------
 
     def _ids(self) -> np.ndarray:
@@ -1073,6 +1129,60 @@ class GraphFrame:
         return repr(self._gf)
 
 
+class _AMSide:
+    """``AM.src`` / ``AM.dst`` / ``AM.edge``: attribute access yields a
+    Column over the triplet namespace of :meth:`GraphFrame.aggregateMessages`."""
+
+    def __init__(self, side: str):
+        self._side = side
+
+    def __getitem__(self, attr: str) -> Column:
+        side = self._side
+        return Column(lambda tr: tr[f"{side}_{attr}"], f"{side}[{attr!r}]")
+
+    def __getattr__(self, attr: str) -> Column:
+        if attr.startswith("_"):
+            raise AttributeError(attr)
+        return self[attr]
+
+
+class AggregateMessages:
+    """``graphframes.lib.AggregateMessages`` — the triplet column namespace."""
+
+    src = _AMSide("src")
+    dst = _AMSide("dst")
+    edge = _AMSide("edge")
+    msg = Column(lambda tr: tr["msg"], "msg")
+
+
+def _friends_graph() -> "GraphFrame":
+    """``graphframes.examples.Graphs.friends()`` — the canonical GraphFrames
+    docs graph (7 people, 8 relationship edges)."""
+    v = Table(
+        id=np.array(list("abcdefg"), dtype=object),
+        name=np.array(["Alice", "Bob", "Charlie", "David", "Esther",
+                       "Fanny", "Gabby"], dtype=object),
+        age=np.array([34, 36, 30, 29, 32, 36, 60]),
+    )
+    e = Table(
+        src=np.array(list("abcfeeda"), dtype=object),
+        dst=np.array(list("bcbcfdae"), dtype=object),
+        relationship=np.array(["friend", "follow", "follow", "follow",
+                               "follow", "friend", "friend", "friend"],
+                              dtype=object),
+    )
+    return GraphFrame(DataFrame(v), DataFrame(e))
+
+
+class _Graphs:
+    def __init__(self, *a):  # GraphFrames: Graphs(spark).friends()
+        pass
+
+    @staticmethod
+    def friends() -> "GraphFrame":
+        return _friends_graph()
+
+
 def _sql_mask(expr, columns, n: int) -> np.ndarray:
     """SQL predicate string (GraphFrames expression surface) or boolean
     mask/callable → boolean mask over ``columns``."""
@@ -1162,12 +1272,22 @@ def _build_modules() -> dict:
 
     graphframes.GraphFrame = GraphFrame
     graphframes.__all__ = ["GraphFrame"]
+    gf_lib = types.ModuleType("graphframes.lib")
+    gf_lib.AggregateMessages = AggregateMessages
+    gf_lib.__all__ = ["AggregateMessages"]
+    graphframes.lib = gf_lib
+    gf_examples = types.ModuleType("graphframes.examples")
+    gf_examples.Graphs = _Graphs
+    gf_examples.__all__ = ["Graphs"]
+    graphframes.examples = gf_examples
 
     return {
         "pyspark": pyspark,
         "pyspark.sql": sql,
         "pyspark.sql.functions": functions,
         "graphframes": graphframes,
+        "graphframes.lib": gf_lib,
+        "graphframes.examples": gf_examples,
     }
 
 
