@@ -31,7 +31,14 @@ from graphmine_tpu.ops.louvain import louvain
 from graphmine_tpu.ops.modularity import modularity
 from graphmine_tpu.ops.pagerank import pagerank, parallel_personalized_pagerank
 from graphmine_tpu.ops.degrees import degrees, in_degrees, out_degrees
-from graphmine_tpu.ops.paths import bfs, bfs_distances, bfs_parents, shortest_paths
+from graphmine_tpu.ops.paths import (
+    bfs,
+    bfs_distances,
+    bfs_parents,
+    shortest_paths,
+    weighted_shortest_paths,
+)
+from graphmine_tpu.ops.cluster_metrics import adjusted_rand_index, normalized_mutual_info
 from graphmine_tpu.ops.scc import strongly_connected_components
 from graphmine_tpu.ops.aggregate import aggregate_messages, pregel
 from graphmine_tpu.ops.motifs import find as find_motifs
@@ -65,6 +72,9 @@ __all__ = [
     "bfs_distances",
     "bfs_parents",
     "shortest_paths",
+    "weighted_shortest_paths",
+    "adjusted_rand_index",
+    "normalized_mutual_info",
     "strongly_connected_components",
     "aggregate_messages",
     "pregel",

@@ -20,6 +20,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from graphmine_tpu.graph.container import Graph
@@ -118,6 +119,74 @@ def _bfs_tile(sources: jax.Array, *, send, recv, v: int) -> jax.Array:
     def cond(state):
         _, changed, it = state
         return (changed > 0) & (it < v + 1)
+
+    dist, _, _ = lax.while_loop(cond, step, (dist0, jnp.int32(1), jnp.int32(0)))
+    return dist
+
+
+def weighted_shortest_paths(
+    graph: Graph,
+    sources: jax.Array,
+    weights: jax.Array,
+    direction: str = "out",
+    max_iter: int = 0,
+) -> jax.Array:
+    """Weighted distance from the nearest of ``sources`` to every vertex —
+    Bellman-Ford over the same gather + ``segment_min`` superstep as BFS
+    (no priority queue: data-parallel relaxation converges in
+    longest-shortest-path-hops iterations, the TPU-friendly trade).
+
+    ``weights``: non-negative float ``[E]`` aligned with ``graph.src`` /
+    ``graph.dst`` (for ``direction="both"`` each edge's weight applies in
+    both directions). Returns float32 ``[V]`` with ``inf`` for unreachable
+    vertices. Negative weights converge too (bounded by ``max_iter``,
+    default V), but negative *cycles* are not detected.
+    """
+    # NaN weights would poison distances AND defeat the convergence check
+    # (NaN != NaN keeps `changed` nonzero for the full V iterations) — same
+    # host-side guard build_graph(edge_weights=...) applies; skipped only
+    # when tracing (weights produced inside a caller's jit).
+    if not isinstance(weights, jax.core.Tracer):
+        w_host = np.asarray(weights)
+        if np.isnan(w_host).any():
+            raise ValueError("weights must not contain NaN")
+    return _weighted_shortest_paths_jit(graph, sources, weights, direction,
+                                        max_iter)
+
+
+@partial(jax.jit, static_argnames=("direction", "max_iter"))
+def _weighted_shortest_paths_jit(
+    graph: Graph,
+    sources: jax.Array,
+    weights: jax.Array,
+    direction: str = "out",
+    max_iter: int = 0,
+) -> jax.Array:
+    v = graph.num_vertices
+    w = jnp.asarray(weights, jnp.float32)
+    if direction == "out":
+        send, recv = graph.src, graph.dst
+    elif direction == "both":
+        # weights align with the edge list, not the sorted message CSR, so
+        # build the two directions straight from src/dst
+        send = jnp.concatenate([graph.src, graph.dst])
+        recv = jnp.concatenate([graph.dst, graph.src])
+        w = jnp.concatenate([w, w])
+    else:
+        raise ValueError(f"direction must be 'out' or 'both', got {direction!r}")
+    dist0 = jnp.full((v,), jnp.inf, jnp.float32).at[sources].set(0.0)
+    limit = max_iter if max_iter > 0 else v
+
+    def step(state):
+        dist, _, it = state
+        relaxed = jax.ops.segment_min(dist[send] + w, recv, num_segments=v)
+        new = jnp.minimum(dist, relaxed)
+        changed = jnp.sum(new != dist, dtype=jnp.int32)
+        return new, changed, it + 1
+
+    def cond(state):
+        _, changed, it = state
+        return (changed > 0) & (it < limit)
 
     dist, _, _ = lax.while_loop(cond, step, (dist0, jnp.int32(1), jnp.int32(0)))
     return dist

@@ -1,0 +1,134 @@
+"""SBM generator, ARI/NMI metrics (sklearn oracle), weighted shortest
+paths (NetworkX oracle), and community-recovery accuracy — the evaluation
+axis the reference names (Overview:9) but never measures."""
+
+import numpy as np
+import pytest
+
+from graphmine_tpu.datasets import sbm
+from graphmine_tpu.graph.container import build_graph
+from graphmine_tpu.ops.cluster_metrics import (
+    adjusted_rand_index,
+    normalized_mutual_info,
+)
+
+
+def test_ari_nmi_match_sklearn_oracle():
+    sk = pytest.importorskip("sklearn.metrics")
+    rng = np.random.default_rng(0)
+    for trial in range(6):
+        a = rng.integers(0, rng.integers(2, 9), 300)
+        b = rng.integers(0, rng.integers(2, 9), 300)
+        assert adjusted_rand_index(a, b) == pytest.approx(
+            sk.adjusted_rand_score(a, b), abs=1e-10)
+        assert normalized_mutual_info(a, b) == pytest.approx(
+            sk.normalized_mutual_info_score(a, b), abs=1e-10)
+    # permutation invariance + perfect/degenerate cases
+    a = rng.integers(0, 5, 200)
+    perm = rng.permutation(5)
+    assert adjusted_rand_index(a, perm[a]) == 1.0
+    assert normalized_mutual_info(a, perm[a]) == pytest.approx(1.0)
+    assert adjusted_rand_index(np.zeros(10), np.zeros(10)) == 1.0
+    assert normalized_mutual_info(np.zeros(10), np.arange(10)) == pytest.approx(
+        sk.normalized_mutual_info_score(np.zeros(10), np.arange(10)))
+
+
+def test_sbm_shape_and_structure():
+    src, dst, blocks = sbm([100, 100, 100], p_in=0.2, p_out=0.005, seed=3)
+    assert blocks.shape == (300,) and set(blocks) == {0, 1, 2}
+    assert (src != dst).all()  # no self-loops
+    intra = (blocks[src] == blocks[dst]).mean()
+    assert intra > 0.8  # planted structure dominates
+    # deduplicated directed pairs
+    assert len(np.unique(src.astype(np.int64) * 300 + dst)) == len(src)
+
+
+def test_lpa_and_louvain_recover_planted_blocks():
+    from graphmine_tpu.ops.louvain import louvain
+    from graphmine_tpu.ops.lpa import label_propagation
+
+    src, dst, blocks = sbm([150, 150, 150], p_in=0.15, p_out=0.002, seed=5)
+    g = build_graph(src, dst, num_vertices=len(blocks))
+    lpa = np.asarray(label_propagation(g, max_iter=10))
+    assert adjusted_rand_index(lpa, blocks) > 0.85
+    lv, q = louvain(g)
+    lv = np.asarray(lv)
+    assert adjusted_rand_index(lv, blocks) > 0.85
+    assert normalized_mutual_info(lv, blocks) > 0.85
+    assert q > 0.5  # strong community structure
+
+
+def test_sbm_equal_probabilities_mean_no_structure():
+    # p_in == p_out must give a structureless Erdos-Renyi graph: intra and
+    # inter unordered-pair densities agree (regression: the diagonal used
+    # to double-count orientations, planting phantom communities)
+    src, dst, blocks = sbm([200, 200], p_in=0.05, p_out=0.05, seed=9)
+    intra_edges = (blocks[src] == blocks[dst]).sum()
+    inter_edges = len(src) - intra_edges
+    intra_pairs = 2 * (200 * 199 // 2)
+    inter_pairs = 200 * 200
+    ratio = (intra_edges / intra_pairs) / (inter_edges / inter_pairs)
+    assert 0.85 < ratio < 1.15
+
+
+def test_metrics_scale_to_fine_partitions():
+    # ~n-cluster vs ~n-cluster comparison must not materialize a ka*kb
+    # table (sparse contingency): 50k x 50k would be ~20 GB dense
+    n = 50_000
+    rng = np.random.default_rng(4)
+    a = np.arange(n) // 2           # 25k clusters
+    b = rng.permutation(n) // 2     # 25k clusters, unrelated
+    assert abs(adjusted_rand_index(a, b)) < 0.01
+    assert normalized_mutual_info(a, a) == pytest.approx(1.0)
+
+
+def test_weighted_shortest_paths_rejects_nan():
+    from graphmine_tpu.ops.paths import weighted_shortest_paths
+
+    g = build_graph(np.array([0], np.int32), np.array([1], np.int32),
+                    num_vertices=2)
+    with pytest.raises(ValueError, match="NaN"):
+        weighted_shortest_paths(g, np.array([0], np.int32),
+                                np.array([np.nan], np.float32))
+
+
+def test_weighted_shortest_paths_vs_networkx():
+    nx = pytest.importorskip("networkx")
+
+    from graphmine_tpu.ops.paths import weighted_shortest_paths
+
+    rng = np.random.default_rng(2)
+    v, e = 60, 240
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    w = rng.uniform(0.1, 5.0, e).astype(np.float32)
+    g = build_graph(src, dst, num_vertices=v, symmetric=False)
+    dist = np.asarray(weighted_shortest_paths(g, np.array([0], np.int32), w))
+
+    G = nx.DiGraph()
+    G.add_nodes_from(range(v))
+    for s, d, ww in zip(src, dst, w):  # parallel edges: keep the lightest
+        if G.has_edge(int(s), int(d)):
+            G[int(s)][int(d)]["weight"] = min(G[int(s)][int(d)]["weight"], float(ww))
+        else:
+            G.add_edge(int(s), int(d), weight=float(ww))
+    oracle = nx.single_source_dijkstra_path_length(G, 0)
+    for u in range(v):
+        if u in oracle:
+            assert dist[u] == pytest.approx(oracle[u], rel=1e-5)
+        else:
+            assert np.isinf(dist[u])
+
+
+def test_weighted_shortest_paths_both_directions():
+    from graphmine_tpu.ops.paths import weighted_shortest_paths
+
+    # path 0 -1.0- 1 -2.0- 2, directed 0->1->2; "both" makes 2 reach 0
+    g = build_graph(np.array([0, 1], np.int32), np.array([1, 2], np.int32),
+                    num_vertices=3)
+    w = np.array([1.0, 2.0], np.float32)
+    d_out = np.asarray(weighted_shortest_paths(g, np.array([2], np.int32), w))
+    assert np.isinf(d_out[0]) and d_out[2] == 0
+    d_both = np.asarray(weighted_shortest_paths(g, np.array([2], np.int32), w,
+                                                direction="both"))
+    assert d_both[0] == pytest.approx(3.0) and d_both[1] == pytest.approx(2.0)
