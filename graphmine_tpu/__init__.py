@@ -45,7 +45,11 @@ from graphmine_tpu.ops.motifs import find as find_motifs
 from graphmine_tpu.ops.streaming_lof import StreamingLOF, fit_lof, score_lof
 from graphmine_tpu.ops.triangles import triangle_count, clustering_coefficient
 from graphmine_tpu.ops.kcore import core_numbers
-from graphmine_tpu.ops.centrality import closeness_centrality, hits
+from graphmine_tpu.ops.centrality import (
+    betweenness_centrality,
+    closeness_centrality,
+    hits,
+)
 from graphmine_tpu import datasets
 from graphmine_tpu.table import Table, read_parquet
 from graphmine_tpu.ops.svdpp import svd_plus_plus, svdpp_predict
@@ -87,6 +91,7 @@ __all__ = [
     "core_numbers",
     "hits",
     "closeness_centrality",
+    "betweenness_centrality",
     "datasets",
     "Table",
     "read_parquet",

@@ -388,6 +388,12 @@ class GraphFrame:
         from graphmine_tpu.ops.centrality import closeness_centrality
         return closeness_centrality(self.graph(), vertices=vertices, **kw)
 
+    def betweenness_centrality(self, sources=None, **kw):
+        """Brandes betweenness (NetworkX parity); pass a source sample on
+        large graphs for the standard approximation."""
+        from graphmine_tpu.ops.centrality import betweenness_centrality
+        return betweenness_centrality(self.graph(), sources=sources, **kw)
+
     def clustering_coefficient(self):
         from graphmine_tpu.ops.triangles import clustering_coefficient
         return clustering_coefficient(self.graph(), _cached=self._triangle_cache())

@@ -63,6 +63,131 @@ def hits(
     return h, a
 
 
+def betweenness_centrality(
+    graph: Graph,
+    sources=None,
+    normalized: bool = True,
+    directed: bool | None = None,
+    source_batch: int = 8,
+) -> jax.Array:
+    """Betweenness centrality ``[V]`` (float32) via Brandes' algorithm as
+    data-parallel level sweeps — no priority queues or per-node stacks:
+    one BFS forward pass accumulates shortest-path counts per level, one
+    backward pass accumulates pair dependencies per level, both as
+    gather + ``segment_sum`` supersteps batched ``source_batch`` sources
+    at a time (the same lane-block recipe as ``shortest_paths``).
+
+    ``sources=None`` runs every vertex (exact, NetworkX-oracle tested);
+    an id array runs the standard sampled estimator scaled by ``V/k``.
+    Parallel edges count as distinct shortest paths (multigraph
+    semantics, the engine's multiplicity convention — dedupe the edge
+    list first for simple-graph parity).
+    ``directed`` defaults to ``not graph.symmetric``; undirected scores
+    are halved (each unordered pair is counted from both endpoints) and
+    ``normalized`` applies NetworkX's ``1/((V-1)(V-2))`` (×2 undirected).
+    """
+    v = graph.num_vertices
+    if directed is None:
+        directed = not graph.symmetric
+    if directed:
+        send, recv = graph.src, graph.dst
+    else:
+        send = jnp.concatenate([graph.src, graph.dst])
+        recv = jnp.concatenate([graph.dst, graph.src])
+    if sources is None:
+        src_ids = jnp.arange(v, dtype=jnp.int32)
+    else:
+        src_ids = jnp.atleast_1d(jnp.asarray(sources, jnp.int32))
+    k = int(src_ids.shape[0])
+    b = max(1, min(source_batch, k))
+    pad = (-k) % b
+    tiles = jnp.concatenate([src_ids, jnp.zeros(pad, jnp.int32)]).reshape(-1, b)
+    # padded lanes recompute source 0; mask their contribution out
+    lane_valid = (jnp.arange(k + pad) < k).reshape(-1, b)
+
+    def tile(acc, args):
+        srcs, valid = args
+        # scan with a running [V] sum — a stacked [tiles, V] result would
+        # be O(V^2 / b) for exact betweenness
+        return acc + _brandes_tile(srcs, valid, send=send, recv=recv, v=v), None
+
+    bc, _ = lax.scan(tile, jnp.zeros(v, jnp.float32), (tiles, lane_valid))
+    if not directed:
+        bc = bc / 2.0
+    if sources is not None and k and k < v:
+        bc = bc * (v / k)  # sampled-source estimator rescale
+    if normalized and v > 2:
+        scale = 1.0 / ((v - 1) * (v - 2))
+        if not directed:
+            scale *= 2.0
+        bc = bc * scale
+    return bc
+
+
+def _brandes_tile(srcs, valid, *, send, recv, v: int) -> jax.Array:
+    """Dependency accumulation for one lane block of sources: ``[V]``.
+
+    Both segment sums flatten the lane axis into the segment ids
+    (``vertex * b + lane``) instead of segment-summing a ``[M, b]``
+    operand over its leading axis — the 2-D form chained across
+    supersteps miscompiles to zeros on the TPU backend this was built
+    against (single steps are fine; verified minimal repro), and the
+    flat form is equivalent.
+    """
+    b = srcs.shape[0]
+    lanes = jnp.arange(b, dtype=jnp.int32)
+    unreach = jnp.int32(v + 1)
+    seg_recv = (recv[:, None] * b + lanes[None, :]).ravel()
+    seg_send = (send[:, None] * b + lanes[None, :]).ravel()
+    dist = jnp.full((v, b), unreach, jnp.int32)
+    dist = dist.at[srcs, lanes].min(0)
+    sigma = jnp.zeros((v, b), jnp.float32).at[srcs, lanes].add(1.0)
+
+    def fwd(state):
+        dist, sigma, it, _ = state
+        on_level = dist[send] == it
+        msg = jnp.where(on_level, sigma[send], 0.0)
+        contrib = jax.ops.segment_sum(
+            msg.ravel(), seg_recv, num_segments=v * b
+        ).reshape(v, b)
+        newly = (dist == unreach) & (contrib > 0)
+        dist = jnp.where(newly, it + 1, dist)
+        sigma = jnp.where(newly, contrib, sigma)
+        return dist, sigma, it + 1, jnp.sum(newly, dtype=jnp.int32)
+
+    def fwd_cond(state):
+        _, _, it, progressed = state
+        return (progressed > 0) & (it < v)
+
+    dist, sigma, depth, _ = lax.while_loop(
+        fwd_cond, fwd, (dist, sigma, jnp.int32(0), jnp.int32(1))
+    )
+
+    def bwd(state):
+        delta, it = state
+        # edges u->w on shortest paths with dist[w] == it+1 push
+        # sigma[u]/sigma[w] * (1 + delta[w]) back to u at level it
+        on_sp = (dist[send] == it) & (dist[recv] == it + 1)
+        ratio = sigma[send] / jnp.maximum(sigma[recv], 1.0)
+        msg = jnp.where(on_sp, ratio * (1.0 + delta[recv]), 0.0)
+        back = jax.ops.segment_sum(
+            msg.ravel(), seg_send, num_segments=v * b
+        ).reshape(v, b)
+        delta = jnp.where(dist == it, back, delta)
+        return delta, it - 1
+
+    def bwd_cond(state):
+        _, it = state
+        return it >= 0
+
+    delta, _ = lax.while_loop(
+        bwd_cond, bwd, (jnp.zeros((v, b), jnp.float32), depth - 1)
+    )
+    # sources don't count their own dependency; padded lanes contribute 0
+    delta = delta.at[srcs, lanes].set(0.0)
+    return jnp.where(valid[None, :], delta, 0.0).sum(axis=1)
+
+
 def closeness_centrality(
     graph: Graph, vertices=None, wf_improved: bool = True
 ) -> jax.Array:

@@ -96,6 +96,72 @@ def test_shortest_paths_batched_tiles_match_per_landmark():
     np.testing.assert_array_equal(batched, ones)
 
 
+def dedup(src, dst):
+    pairs = np.unique(np.stack([src, dst], 1), axis=0)
+    return pairs[:, 0], pairs[:, 1]
+
+
+def test_betweenness_exact_matches_networkx_undirected():
+    from graphmine_tpu.ops.centrality import betweenness_centrality
+
+    src, dst, v = random_digraph(seed=13)
+    # canonicalize to simple undirected pairs: reciprocal directed edges
+    # would otherwise act as parallel edges and inflate path counts
+    # (multigraph semantics — the engine's multiplicity convention)
+    src, dst = dedup(np.minimum(src, dst), np.maximum(src, dst))
+    g = build_graph(src, dst, num_vertices=v, symmetric=True)
+    # v=40, batch 7 -> pad=2: exercises the padded-lane masking too
+    bc = np.asarray(betweenness_centrality(g, source_batch=7))
+    np.testing.assert_allclose(
+        bc, np.asarray(betweenness_centrality(g, source_batch=8)), rtol=1e-5)
+    G = nx.Graph()
+    G.add_nodes_from(range(v))
+    G.add_edges_from(zip(src.tolist(), dst.tolist()))
+    expected = nx.betweenness_centrality(G, normalized=True)
+    np.testing.assert_allclose(bc, [expected[i] for i in range(v)],
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_betweenness_exact_matches_networkx_directed():
+    from graphmine_tpu.ops.centrality import betweenness_centrality
+
+    src, dst, v = random_digraph(seed=17, e=120)
+    src, dst = dedup(src, dst)
+    g = build_graph(src, dst, num_vertices=v, symmetric=False)
+    bc = np.asarray(betweenness_centrality(g))
+    G = nx.DiGraph()
+    G.add_nodes_from(range(v))
+    G.add_edges_from(zip(src.tolist(), dst.tolist()))
+    expected = nx.betweenness_centrality(G, normalized=True)
+    np.testing.assert_allclose(bc, [expected[i] for i in range(v)],
+                               rtol=1e-4, atol=1e-6)
+    # unnormalized too
+    bc_raw = np.asarray(betweenness_centrality(g, normalized=False))
+    raw = nx.betweenness_centrality(G, normalized=False)
+    np.testing.assert_allclose(bc_raw, [raw[i] for i in range(v)],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_betweenness_path_graph_and_sampling():
+    from graphmine_tpu.ops.centrality import betweenness_centrality
+
+    # path 0-1-2-3-4: middle vertex carries the most pairs
+    g = build_graph(np.arange(4, dtype=np.int32),
+                    np.arange(1, 5, dtype=np.int32), num_vertices=5)
+    bc = np.asarray(betweenness_centrality(g, normalized=False))
+    assert list(bc) == [0.0, 3.0, 4.0, 3.0, 0.0]
+    # sampled estimator: unbiased here because all sources are sampled
+    bs = np.asarray(betweenness_centrality(
+        g, sources=np.arange(5, dtype=np.int32), normalized=False))
+    np.testing.assert_allclose(bs, bc)
+    # a source sample is a noisy estimator: interior vertices score
+    # positive, endpoints zero, scaled by V/k
+    half = np.asarray(betweenness_centrality(
+        g, sources=np.array([0, 2, 4], np.int32), normalized=False))
+    assert half[0] == half[4] == 0.0
+    assert (half[1:4] > 0).all()
+
+
 def test_frame_methods():
     from graphmine_tpu.frames import GraphFrame
 
