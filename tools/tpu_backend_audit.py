@@ -1,0 +1,93 @@
+"""Cross-backend numerical audit: default (TPU) vs CPU, same inputs.
+
+CI forces 8 virtual CPU devices (tests/conftest.py), so a TPU-only
+miscompile passes the suite silently — exactly what happened to the first
+betweenness kernel: a ``[M, b]`` segment_sum chained across supersteps
+compiled to zeros on the TPU backend while every test stayed green (see
+``ops/centrality.py:_brandes_tile`` and docs/DESIGN.md). Run this on a
+machine with the real accelerator after touching any lane-batched or
+iterated segment-op kernel:
+
+    python tools/tpu_backend_audit.py
+
+Exits nonzero on any mismatch.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # `python tools/...` puts tools/ on the path, not the repo
+    sys.path.insert(0, _REPO)
+
+REF_PATH = "/tmp/graphmine_cpu_ref.npz"
+
+_COMPUTE = """
+import numpy as np
+import graphmine_tpu as gm
+
+def compute():
+    rng = np.random.default_rng(0)
+    v, e = 300, 1500
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    g = gm.build_graph(src, dst, num_vertices=v)
+    gd = gm.build_graph(src, dst, num_vertices=v, symmetric=False)
+    w = rng.uniform(0.1, 2.0, e).astype(np.float32)
+    labels = gm.label_propagation(g, max_iter=5)
+    h, a = gm.hits(gd)
+    return {
+        "lpa": np.asarray(labels),
+        "cc": np.asarray(gm.connected_components(g)),
+        "sp": np.asarray(gm.shortest_paths(
+            g, np.arange(16, dtype=np.int32), direction="both",
+            landmark_batch=5)),
+        "wsp": np.asarray(gm.weighted_shortest_paths(
+            g, np.arange(4, dtype=np.int32), w, direction="both")),
+        "ppr": np.asarray(gm.parallel_personalized_pagerank(
+            gd, np.arange(6, dtype=np.int32))),
+        "closeness": np.asarray(gm.closeness_centrality(
+            g, vertices=np.arange(12, dtype=np.int32))),
+        "bc": np.asarray(gm.betweenness_centrality(
+            g, sources=np.arange(20, dtype=np.int32), source_batch=7)),
+        "hits_h": np.asarray(h),
+        "hits_a": np.asarray(a),
+        "pagerank": np.asarray(gm.pagerank(gd, max_iter=50)),
+    }
+"""
+
+
+def main() -> int:
+    # CPU reference in a subprocess (JAX_PLATFORMS must be set pre-import)
+    code = _COMPUTE + f"""
+np.savez({REF_PATH!r}, **compute())
+print("cpu reference written")
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    subprocess.run([sys.executable, "-c", code], check=True, env=env)
+
+    ns: dict = {}
+    exec(_COMPUTE, ns)  # default backend (the accelerator) in this process
+    got = ns["compute"]()
+    ref = np.load(REF_PATH)
+    bad = []
+    for k, dev_val in got.items():
+        ok = np.allclose(dev_val, ref[k], rtol=1e-4, atol=1e-5)
+        print(f"{k:10s} TPU==CPU: {ok}")
+        if not ok:
+            diff = np.max(np.abs(dev_val.astype(np.float64) - ref[k].astype(np.float64)))
+            print(f"           max abs diff: {diff}")
+            bad.append(k)
+    if bad:
+        print(f"MISMATCH on: {bad}", file=sys.stderr)
+        return 1
+    print("all backends agree")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
