@@ -48,7 +48,9 @@ from graphmine_tpu.ops.kcore import core_numbers
 from graphmine_tpu.ops.centrality import (
     betweenness_centrality,
     closeness_centrality,
+    eigenvector_centrality,
     hits,
+    katz_centrality,
 )
 from graphmine_tpu import datasets
 from graphmine_tpu.table import Table, read_parquet
@@ -92,6 +94,8 @@ __all__ = [
     "hits",
     "closeness_centrality",
     "betweenness_centrality",
+    "eigenvector_centrality",
+    "katz_centrality",
     "datasets",
     "Table",
     "read_parquet",

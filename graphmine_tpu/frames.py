@@ -394,6 +394,14 @@ class GraphFrame:
         from graphmine_tpu.ops.centrality import betweenness_centrality
         return betweenness_centrality(self.graph(), sources=sources, **kw)
 
+    def eigenvector_centrality(self, **kw):
+        from graphmine_tpu.ops.centrality import eigenvector_centrality
+        return eigenvector_centrality(self.graph(), **kw)
+
+    def katz_centrality(self, alpha: float = 0.1, **kw):
+        from graphmine_tpu.ops.centrality import katz_centrality
+        return katz_centrality(self.graph(), alpha=alpha, **kw)
+
     def clustering_coefficient(self):
         from graphmine_tpu.ops.triangles import clustering_coefficient
         return clustering_coefficient(self.graph(), _cached=self._triangle_cache())

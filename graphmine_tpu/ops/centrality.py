@@ -17,6 +17,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from graphmine_tpu.graph.container import Graph
@@ -63,12 +64,79 @@ def hits(
     return h, a
 
 
+@partial(jax.jit, static_argnames=("max_iter",))
+def eigenvector_centrality(
+    graph: Graph, max_iter: int = 100, tol: float = 1e-6
+) -> jax.Array:
+    """Eigenvector centrality ``[V]`` — power iteration on ``Aᵀx`` (each
+    vertex accumulates its in-neighbors' scores), L2-normalized with an
+    L1 convergence test scaled by V, matching ``nx.eigenvector_centrality``.
+    Use a symmetric graph for the undirected notion."""
+    v = graph.num_vertices
+    src, dst = (
+        (graph.msg_send, graph.msg_recv) if graph.symmetric
+        else (graph.src, graph.dst)
+    )
+    x0 = jnp.full(v, 1.0 / v, jnp.float32)
+
+    def step(state):
+        x, _, it = state
+        nxt = x + jax.ops.segment_sum(x[src], dst, num_segments=v)
+        norm = jnp.sqrt(jnp.sum(nxt * nxt))
+        nxt = nxt / jnp.maximum(norm, 1e-30)
+        err = jnp.abs(nxt - x).sum()
+        return nxt, err, it + 1
+
+    def cond(state):
+        _, err, it = state
+        return (err >= v * tol) & (it < max_iter)
+
+    x, _, _ = lax.while_loop(cond, step, (x0, jnp.inf, jnp.array(0)))
+    return x
+
+
+@partial(jax.jit, static_argnames=("max_iter", "normalized"))
+def katz_centrality(
+    graph: Graph,
+    alpha: float = 0.1,
+    beta: float = 1.0,
+    max_iter: int = 1000,
+    tol: float = 1e-6,
+    normalized: bool = True,
+) -> jax.Array:
+    """Katz centrality ``[V]``: fixpoint of ``x = alpha·Aᵀx + beta``
+    (NetworkX semantics, including the final L2 normalization). ``alpha``
+    must be below ``1/λ_max`` to converge."""
+    v = graph.num_vertices
+    src, dst = (
+        (graph.msg_send, graph.msg_recv) if graph.symmetric
+        else (graph.src, graph.dst)
+    )
+    x0 = jnp.zeros(v, jnp.float32)
+
+    def step(state):
+        x, _, it = state
+        nxt = alpha * jax.ops.segment_sum(x[src], dst, num_segments=v) + beta
+        err = jnp.abs(nxt - x).sum()
+        return nxt, err, it + 1
+
+    def cond(state):
+        _, err, it = state
+        return (err >= v * tol) & (it < max_iter)
+
+    x, _, _ = lax.while_loop(cond, step, (x0, jnp.inf, jnp.array(0)))
+    if normalized:
+        x = x / jnp.maximum(jnp.sqrt(jnp.sum(x * x)), 1e-30)
+    return x
+
+
 def betweenness_centrality(
     graph: Graph,
     sources=None,
     normalized: bool = True,
     directed: bool | None = None,
     source_batch: int = 8,
+    mesh=None,
 ) -> jax.Array:
     """Betweenness centrality ``[V]`` (float32) via Brandes' algorithm as
     data-parallel level sweeps — no priority queues or per-node stacks:
@@ -82,6 +150,11 @@ def betweenness_centrality(
     Parallel edges count as distinct shortest paths (multigraph
     semantics, the engine's multiplicity convention — dedupe the edge
     list first for simple-graph parity).
+
+    ``mesh``: optional ``jax.sharding.Mesh`` — sources are sharded across
+    the mesh (graph replicated per device) and partial accumulators meet
+    in one ``psum``; equivalent to the single-device result up to float32
+    summation order (per-device partials reduce in a different order).
     ``directed`` defaults to ``not graph.symmetric``; undirected scores
     are halved (each unordered pair is counted from both endpoints) and
     ``normalized`` applies NetworkX's ``1/((V-1)(V-2))`` (×2 undirected).
@@ -100,18 +173,43 @@ def betweenness_centrality(
         src_ids = jnp.atleast_1d(jnp.asarray(sources, jnp.int32))
     k = int(src_ids.shape[0])
     b = max(1, min(source_batch, k))
-    pad = (-k) % b
+    n_dev = 1 if mesh is None else int(np.prod(mesh.devices.shape))
+    pad = (-k) % (b * n_dev)  # every device gets whole tiles
     tiles = jnp.concatenate([src_ids, jnp.zeros(pad, jnp.int32)]).reshape(-1, b)
     # padded lanes recompute source 0; mask their contribution out
     lane_valid = (jnp.arange(k + pad) < k).reshape(-1, b)
 
-    def tile(acc, args):
-        srcs, valid = args
-        # scan with a running [V] sum — a stacked [tiles, V] result would
-        # be O(V^2 / b) for exact betweenness
-        return acc + _brandes_tile(srcs, valid, send=send, recv=recv, v=v), None
+    def tile_scan(tiles_, valid_):
+        def tile(acc, args):
+            srcs, valid = args
+            # scan with a running [V] sum — a stacked [tiles, V] result
+            # would be O(V^2 / b) for exact betweenness
+            return acc + _brandes_tile(srcs, valid, send=send, recv=recv, v=v), None
 
-    bc, _ = lax.scan(tile, jnp.zeros(v, jnp.float32), (tiles, lane_valid))
+        acc, _ = lax.scan(tile, jnp.zeros(v, jnp.float32), (tiles_, valid_))
+        return acc
+
+    if mesh is None:
+        bc = tile_scan(tiles, lane_valid)
+    else:
+        # Source-parallel: the graph is replicated, the source tiles are
+        # sharded across every mesh axis, partial accumulators meet in one
+        # psum over ICI — embarrassingly parallel Brandes.
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        axes = tuple(mesh.axis_names)
+
+        def per_device(tiles_, valid_):
+            return jax.lax.psum(tile_scan(tiles_, valid_), axis_name=axes)
+
+        bc = shard_map(
+            per_device, mesh=mesh,
+            in_specs=(P(axes), P(axes)), out_specs=P(),
+            # while_loop carries mix sharded-derived and replicated values;
+            # varying-axis checking can't track that through the fixpoint
+            check_vma=False,
+        )(tiles, lane_valid)
     if not directed:
         bc = bc / 2.0
     if sources is not None and k and k < v:
