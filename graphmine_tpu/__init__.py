@@ -45,6 +45,7 @@ from graphmine_tpu.ops.motifs import find as find_motifs
 from graphmine_tpu.ops.streaming_lof import StreamingLOF, fit_lof, score_lof
 from graphmine_tpu.ops.triangles import triangle_count, clustering_coefficient
 from graphmine_tpu.ops.kcore import core_numbers
+from graphmine_tpu.ops.mis import greedy_color, maximal_independent_set
 from graphmine_tpu.ops.centrality import (
     betweenness_centrality,
     closeness_centrality,
@@ -91,6 +92,8 @@ __all__ = [
     "triangle_count",
     "clustering_coefficient",
     "core_numbers",
+    "maximal_independent_set",
+    "greedy_color",
     "hits",
     "closeness_centrality",
     "betweenness_centrality",

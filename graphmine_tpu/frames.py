@@ -402,6 +402,14 @@ class GraphFrame:
         from graphmine_tpu.ops.centrality import katz_centrality
         return katz_centrality(self.graph(), alpha=alpha, **kw)
 
+    def maximal_independent_set(self, **kw):
+        from graphmine_tpu.ops.mis import maximal_independent_set
+        return maximal_independent_set(self.graph(), **kw)
+
+    def greedy_color(self, **kw):
+        from graphmine_tpu.ops.mis import greedy_color
+        return greedy_color(self.graph(), **kw)
+
     def clustering_coefficient(self):
         from graphmine_tpu.ops.triangles import clustering_coefficient
         return clustering_coefficient(self.graph(), _cached=self._triangle_cache())

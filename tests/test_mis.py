@@ -1,0 +1,99 @@
+"""Luby MIS and repeated-MIS coloring: property-tested (independence,
+maximality, proper coloring) — the correctness criteria are exact even
+though the algorithms are randomized."""
+
+import numpy as np
+import pytest
+
+from graphmine_tpu.graph.container import build_graph
+from graphmine_tpu.ops.mis import greedy_color, maximal_independent_set
+
+
+def random_graph(v=80, e=400, seed=0):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    keep = src != dst
+    return src[keep], dst[keep], v
+
+
+def undirected_pairs(src, dst):
+    return set(map(tuple, np.stack([np.minimum(src, dst),
+                                    np.maximum(src, dst)], 1).tolist()))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_mis_is_independent_and_maximal(seed):
+    src, dst, v = random_graph(seed=seed)
+    g = build_graph(src, dst, num_vertices=v)
+    mis = np.asarray(maximal_independent_set(g, seed=seed))
+    # independence: no edge inside the set
+    assert not (mis[src] & mis[dst]).any()
+    # maximality: every outsider has a member neighbor
+    nbr_in = np.zeros(v, dtype=bool)
+    np.logical_or.at(nbr_in, src, mis[dst])
+    np.logical_or.at(nbr_in, dst, mis[src])
+    assert (mis | nbr_in).all()
+
+
+def test_mis_deterministic_and_isolated_vertices_join():
+    src, dst, v = random_graph(seed=4)
+    g = build_graph(src, dst, num_vertices=v + 5)  # 5 isolated vertices
+    a = np.asarray(maximal_independent_set(g, seed=7))
+    b = np.asarray(maximal_independent_set(g, seed=7))
+    np.testing.assert_array_equal(a, b)
+    assert a[v:].all()  # isolated vertices always belong
+    assert np.asarray(maximal_independent_set(g, seed=8)).shape == a.shape
+
+
+def test_greedy_color_is_proper_and_complete():
+    src, dst, v = random_graph(v=120, e=700, seed=5)
+    g = build_graph(src, dst, num_vertices=v)
+    colors = np.asarray(greedy_color(g, seed=5))
+    assert (colors >= 0).all()
+    assert not (colors[src] == colors[dst]).any()  # proper
+    # color count is sane: at most max-degree + 1
+    deg = np.bincount(np.concatenate([src, dst]), minlength=v)
+    assert colors.max() <= deg.max()
+
+
+def test_self_loops_ignored():
+    # triangle plus a self-loop on vertex 0: MIS stays maximal, coloring
+    # stays complete and proper on the non-loop edges
+    src = np.array([0, 1, 2, 0], np.int32)
+    dst = np.array([1, 2, 0, 0], np.int32)
+    g = build_graph(src, dst, num_vertices=3)
+    mis = np.asarray(maximal_independent_set(g, seed=0))
+    assert mis.sum() == 1  # triangle: exactly one member
+    colors = np.asarray(greedy_color(g, seed=0))
+    assert (colors >= 0).all()
+    real = src != dst
+    assert not (colors[src[real]] == colors[dst[real]]).any()
+
+
+def test_greedy_color_cap_leaves_sentinel():
+    # triangle needs 3 colors; cap at 2 -> one vertex keeps the documented
+    # -1 sentinel
+    g = build_graph(np.array([0, 1, 2], np.int32), np.array([1, 2, 0], np.int32),
+                    num_vertices=3)
+    colors = np.asarray(greedy_color(g, seed=0, max_colors=2))
+    assert (colors == -1).sum() == 1
+
+
+def test_mis_requires_symmetric():
+    src, dst, v = random_graph()
+    g = build_graph(src, dst, num_vertices=v, symmetric=False)
+    with pytest.raises(ValueError, match="symmetric"):
+        maximal_independent_set(g)
+    with pytest.raises(ValueError, match="symmetric"):
+        greedy_color(g)
+
+
+def test_frame_methods():
+    from graphmine_tpu.frames import GraphFrame
+
+    src, dst, v = random_graph(seed=6)
+    gf = GraphFrame((src, dst))
+    mis = np.asarray(gf.maximal_independent_set())
+    colors = np.asarray(gf.greedy_color())
+    assert mis.dtype == bool and colors.min() >= 0
