@@ -41,16 +41,11 @@ def main(data_dir: str) -> None:
     )
     print(f"{df.count()} edges after the null filter")
 
-    # vertex table from the distinct domains; edges keep duplicates
-    # (LPA multiplicity parity with the reference)
-    import numpy as np
-
-    from graphmine_tpu.table import Table
-
-    domains = np.unique(np.concatenate(
-        [df.select("ParentDomain")._t["ParentDomain"],
-         df.select("ChildDomain")._t["ChildDomain"]]))
-    vertices = compat.DataFrame(Table(id=domains, name=domains))
+    # vertex table from the distinct domains (the reference's RDD idiom,
+    # Graphframes.py:53); edges keep duplicates (LPA multiplicity parity)
+    domain_rdd = (df.select("ParentDomain", "ChildDomain")
+                    .rdd.flatMap(lambda row: row).distinct())
+    vertices = domain_rdd.map(lambda d: (d, d)).toDF(["id", "name"])
     edges = df.select(F.col("ParentDomain").alias("src"),
                       F.col("ChildDomain").alias("dst"))
 
@@ -65,8 +60,11 @@ def main(data_dir: str) -> None:
 
     # community sizes -> bottom-decile outlier threshold (the capability
     # the reference specified in its dead code, Graphframes.py:121-137)
+    import numpy as np
+
     sizes = communities.groupBy("label").count()
-    decile = np.quantile(np.asarray(sizes._t["count"], dtype=np.float64), 0.1)
+    counts = np.array([row["count"] for row in sizes.collect()], dtype=np.float64)
+    decile = np.quantile(counts, 0.1)
     outliers = sizes.filter(F.col("count") <= decile)
     print(f"{outliers.count()} communities at or below the bottom decile "
           f"(size <= {decile:.0f})")

@@ -46,6 +46,7 @@ from graphmine_tpu.ops.streaming_lof import StreamingLOF, fit_lof, score_lof
 from graphmine_tpu.ops.triangles import triangle_count, clustering_coefficient
 from graphmine_tpu.ops.kcore import core_numbers
 from graphmine_tpu.ops.mis import greedy_color, maximal_independent_set
+from graphmine_tpu.ops.linkpred import link_prediction
 from graphmine_tpu.ops.centrality import (
     betweenness_centrality,
     closeness_centrality,
@@ -94,6 +95,7 @@ __all__ = [
     "core_numbers",
     "maximal_independent_set",
     "greedy_color",
+    "link_prediction",
     "hits",
     "closeness_centrality",
     "betweenness_centrality",

@@ -410,6 +410,10 @@ class GraphFrame:
         from graphmine_tpu.ops.mis import greedy_color
         return greedy_color(self.graph(), **kw)
 
+    def link_prediction(self, pairs, method: str = "jaccard"):
+        from graphmine_tpu.ops.linkpred import link_prediction
+        return link_prediction(self.graph(), pairs, method=method)
+
     def clustering_coefficient(self):
         from graphmine_tpu.ops.triangles import clustering_coefficient
         return clustering_coefficient(self.graph(), _cached=self._triangle_cache())
