@@ -47,6 +47,7 @@ from graphmine_tpu.ops.triangles import triangle_count, clustering_coefficient
 from graphmine_tpu.ops.kcore import core_numbers
 from graphmine_tpu.ops.mis import greedy_color, maximal_independent_set
 from graphmine_tpu.ops.linkpred import link_prediction
+from graphmine_tpu.ops.ktruss import k_truss
 from graphmine_tpu.ops.centrality import (
     betweenness_centrality,
     closeness_centrality,
@@ -96,6 +97,7 @@ __all__ = [
     "maximal_independent_set",
     "greedy_color",
     "link_prediction",
+    "k_truss",
     "hits",
     "closeness_centrality",
     "betweenness_centrality",

@@ -414,6 +414,10 @@ class GraphFrame:
         from graphmine_tpu.ops.linkpred import link_prediction
         return link_prediction(self.graph(), pairs, method=method)
 
+    def k_truss(self, k: int):
+        from graphmine_tpu.ops.ktruss import k_truss
+        return k_truss(self.graph(), k)
+
     def clustering_coefficient(self):
         from graphmine_tpu.ops.triangles import clustering_coefficient
         return clustering_coefficient(self.graph(), _cached=self._triangle_cache())

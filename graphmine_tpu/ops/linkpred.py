@@ -55,6 +55,8 @@ def link_prediction(
     v = graph.num_vertices
     if (u < 0).any() or (u >= v).any() or (w < 0).any() or (w >= v).any():
         raise ValueError("pair endpoints out of range")
+    if (u == w).any():
+        raise ValueError("self-pairs are not valid link-prediction candidates")
     indptr, nbrs, encoded, deg = _adjacency(graph)
 
     if method == "preferential_attachment":

@@ -1,0 +1,58 @@
+"""k-truss vs the NetworkX oracle."""
+
+import numpy as np
+import pytest
+
+from graphmine_tpu.graph.container import build_graph
+from graphmine_tpu.ops.ktruss import k_truss
+
+nx = pytest.importorskip("networkx")
+
+
+def edge_set(a, b):
+    return set(zip(a.tolist(), b.tolist()))
+
+
+def oracle_edges(G, k):
+    T = nx.k_truss(G, k)
+    return {(min(u, v), max(u, v)) for u, v in T.edges()}
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize("k", [2, 3, 4, 5])
+def test_k_truss_matches_networkx(seed, k):
+    rng = np.random.default_rng(seed)
+    v, e = 60, 420
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    g = build_graph(src, dst, num_vertices=v)
+    a, b = k_truss(g, k)
+    G = nx.Graph()
+    G.add_nodes_from(range(v))
+    G.add_edges_from((int(x), int(y)) for x, y in zip(src, dst) if x != y)
+    assert edge_set(a, b) == oracle_edges(G, k)
+
+
+def test_k_truss_hand_built():
+    # K4 plus a dangling path: the 4-truss is exactly the K4
+    k4 = [(0, 1), (0, 2), (0, 3), (1, 2), (1, 3), (2, 3)]
+    path = [(3, 4), (4, 5)]
+    src, dst = map(np.array, zip(*(k4 + path)))
+    g = build_graph(src.astype(np.int32), dst.astype(np.int32), num_vertices=6)
+    a, b = k_truss(g, 4)
+    assert edge_set(a, b) == set(k4)
+    a2, b2 = k_truss(g, 2)  # 2-truss keeps every edge
+    assert len(a2) == 8
+    a5, b5 = k_truss(g, 5)  # nothing is 5-truss here
+    assert len(a5) == 0
+
+
+def test_k_truss_validation_and_triangle_free():
+    g = build_graph(np.array([0, 1], np.int32), np.array([1, 2], np.int32),
+                    num_vertices=3)
+    with pytest.raises(ValueError, match="k must be"):
+        k_truss(g, 1)
+    a, b = k_truss(g, 2)  # triangle-free: 2-truss is the whole graph
+    assert len(a) == 2
+    a3, _ = k_truss(g, 3)
+    assert len(a3) == 0

@@ -60,5 +60,7 @@ def test_validation_and_shapes():
         link_prediction(g, [(0, 1)], method="sorcery")
     with pytest.raises(ValueError, match="out of range"):
         link_prediction(g, [(0, 10_000)])
+    with pytest.raises(ValueError, match="self-pairs"):
+        link_prediction(g, [(3, 3)])
     one = link_prediction(g, (0, 1))
     assert one.shape == (1,)
