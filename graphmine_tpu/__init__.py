@@ -48,6 +48,7 @@ from graphmine_tpu.ops.kcore import core_numbers
 from graphmine_tpu.ops.mis import greedy_color, maximal_independent_set
 from graphmine_tpu.ops.linkpred import link_prediction
 from graphmine_tpu.ops.ktruss import k_truss
+from graphmine_tpu.ops.embedding import spectral_embedding
 from graphmine_tpu.ops.centrality import (
     betweenness_centrality,
     closeness_centrality,
@@ -98,6 +99,7 @@ __all__ = [
     "greedy_color",
     "link_prediction",
     "k_truss",
+    "spectral_embedding",
     "hits",
     "closeness_centrality",
     "betweenness_centrality",

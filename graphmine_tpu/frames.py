@@ -418,6 +418,10 @@ class GraphFrame:
         from graphmine_tpu.ops.ktruss import k_truss
         return k_truss(self.graph(), k)
 
+    def spectral_embedding(self, dim: int = 8, **kw):
+        from graphmine_tpu.ops.embedding import spectral_embedding
+        return spectral_embedding(self.graph(), dim=dim, **kw)
+
     def clustering_coefficient(self):
         from graphmine_tpu.ops.triangles import clustering_coefficient
         return clustering_coefficient(self.graph(), _cached=self._triangle_cache())

@@ -76,24 +76,13 @@ def k_truss(graph: Graph, k: int):
     simplified graph; isolated vertices simply don't appear)."""
     if k < 2:
         raise ValueError("k must be >= 2 (the 2-truss is the whole graph)")
-    ptr, col, wu, wv, ww, _ = _oriented_csr(graph)
+    ptr, col, wu, wv, ww, _, e1, e2 = _oriented_csr(graph)
     num_edges = len(col)
     if num_edges == 0:
         return np.zeros(0, np.int32), np.zeros(0, np.int32)
-    # reconstruct lo/hi per edge index (col order == edge order)
+    # lo endpoint per edge index (col order == edge order)
     lo_of_edge = np.repeat(np.arange(graph.num_vertices, dtype=np.int32),
                            np.diff(ptr).astype(np.int64))
-    d_u = np.diff(ptr).astype(np.int64)[lo_of_edge]
-    # wedge -> edge-index triples (host, vectorized): e1 = generating edge,
-    # e2 = the (u, w) row entry the wedge expanded from
-    e1 = np.repeat(np.arange(num_edges, dtype=np.int64), d_u)
-    starts = np.cumsum(d_u) - d_u
-    offsets = np.arange(int(d_u.sum()), dtype=np.int64) - np.repeat(starts, d_u)
-    e2 = np.repeat(ptr[lo_of_edge].astype(np.int64), d_u) + offsets
-    if len(e1) == 0:
-        if k <= 2:  # no triangles: the 2-truss keeps everything
-            return np.minimum(lo_of_edge, col), np.maximum(lo_of_edge, col)
-        return np.zeros(0, np.int32), np.zeros(0, np.int32)
     max_row = int(np.max(np.diff(ptr), initial=1))
     iters = max(int(np.ceil(np.log2(max(max_row, 2)))) + 1, 1)
     active = np.asarray(_truss_peel(

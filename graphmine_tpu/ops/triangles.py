@@ -37,7 +37,12 @@ from graphmine_tpu.graph.container import Graph, simple_undirected_edges
 def _oriented_csr(graph: Graph):
     """Host-side: simple undirected edges oriented by (degree, id) rank.
 
-    Returns (ptr, col, wedge_u, wedge_v, wedge_w, simple_degree).
+    Returns ``(ptr, col, wedge_u, wedge_v, wedge_w, simple_degree,
+    wedge_e1, wedge_e2)`` — the last two are per-wedge *edge indices*
+    (into the ``col`` order, which IS the edge order): the generating
+    edge ``(u, v)`` and the ``(u, w)`` row entry. Consumers that close a
+    wedge (k-truss) get the third side's index from their binary-search
+    hit, so every triangle knows all three edges from one shared build.
     """
     v = graph.num_vertices
     a, b = simple_undirected_edges(graph)
@@ -63,11 +68,14 @@ def _oriented_csr(graph: Graph):
     total = int(d_u.sum())
     starts = np.cumsum(d_u) - d_u
     offsets = np.arange(total, dtype=np.int64) - np.repeat(starts, d_u)
-    wedge_w = hi[np.repeat(ptr[lo], d_u) + offsets]
+    wedge_e2 = np.repeat(ptr[lo], d_u) + offsets
+    wedge_w = hi[wedge_e2]
+    wedge_e1 = np.repeat(np.arange(len(lo), dtype=np.int64), d_u)
     return (
         ptr.astype(np.int64), hi.astype(np.int32),
         wedge_u.astype(np.int32), wedge_v.astype(np.int32), wedge_w.astype(np.int32),
         deg.astype(np.int32),
+        wedge_e1.astype(np.int32), wedge_e2.astype(np.int32),
     )
 
 
@@ -105,7 +113,7 @@ def _triangles(graph: Graph):
 
     Returns ``(tri [V], total, simple_degree [V])``.
     """
-    ptr, col, wu, wv, ww, deg = _oriented_csr(graph)
+    ptr, col, wu, wv, ww, deg, _, _ = _oriented_csr(graph)
     if len(wu) == 0:
         z = jnp.zeros((graph.num_vertices,), jnp.int32)
         return z, jnp.int32(0), jnp.asarray(deg, jnp.int32)

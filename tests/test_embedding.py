@@ -1,0 +1,108 @@
+"""Spectral embedding: scipy-eigsh subspace oracle + planted-block
+recovery (eigenvectors are sign/rotation-ambiguous, so agreement is
+measured with principal angles, not per-column equality)."""
+
+import numpy as np
+import pytest
+
+from graphmine_tpu.datasets import sbm
+from graphmine_tpu.graph.container import build_graph
+from graphmine_tpu.ops.cluster_metrics import adjusted_rand_index
+from graphmine_tpu.ops.embedding import spectral_embedding
+
+
+def sbm_graph(blocks=4, size=150, seed=1):
+    src, dst, planted = sbm([size] * blocks, p_in=0.08, p_out=0.003, seed=seed)
+    return build_graph(src, dst, num_vertices=len(planted)), src, dst, planted
+
+
+def test_orthonormal_and_deterministic():
+    g, *_ = sbm_graph()
+    x = np.asarray(spectral_embedding(g, dim=3))
+    np.testing.assert_allclose(x.T @ x, np.eye(3), atol=1e-5)
+    y = np.asarray(spectral_embedding(g, dim=3))
+    np.testing.assert_array_equal(x, y)
+
+
+def test_subspace_matches_scipy_eigsh():
+    spla = pytest.importorskip("scipy.sparse.linalg")
+    sp = pytest.importorskip("scipy.sparse")
+
+    g, src, dst, planted = sbm_graph(blocks=4, seed=2)
+    v = len(planted)
+    dim = 3  # 4 blocks -> 3 structural nontrivial eigenvectors
+    x = np.asarray(spectral_embedding(g, dim=dim, num_iters=120))
+
+    a = sp.coo_matrix(
+        (np.ones(2 * len(src)), (np.r_[src, dst], np.r_[dst, src])),
+        shape=(v, v),
+    ).tocsr()
+    deg = np.asarray(a.sum(1)).ravel()
+    dm = sp.diags(1.0 / np.sqrt(np.maximum(deg, 1)))
+    m = dm @ a @ dm
+    w, vecs = spla.eigsh(m, k=dim + 1, which="LA")
+    oracle = vecs[:, np.argsort(-w)][:, 1:]  # drop the trivial direction
+    cosines = np.linalg.svd(x.T @ oracle, compute_uv=False)
+    assert cosines.min() > 0.99
+
+
+def test_embedding_recovers_planted_blocks():
+    g, *_, planted = sbm_graph(blocks=3, seed=3)
+    x = np.asarray(spectral_embedding(g, dim=2))
+
+    def kmeans(pts, k, iters=40, seed=0):
+        rng = np.random.default_rng(seed)
+        centers = pts[rng.choice(len(pts), k, replace=False)]
+        assign = np.zeros(len(pts), np.int64)
+        for _ in range(iters):
+            d = ((pts[:, None, :] - centers[None]) ** 2).sum(-1)
+            assign = d.argmin(1)
+            for j in range(k):
+                if (assign == j).any():
+                    centers[j] = pts[assign == j].mean(0)
+        inertia = ((pts - centers[assign]) ** 2).sum()
+        return assign, inertia
+
+    # best of 5 inits (vanilla k-means is init-sensitive; the embedding
+    # itself is what's under test)
+    assign, _ = min((kmeans(x, 3, seed=s) for s in range(5)),
+                    key=lambda r: r[1])
+    assert adjusted_rand_index(assign, planted) > 0.95
+
+
+def test_bipartite_negative_eigenvalues_do_not_dominate():
+    # Two K_{8,8} blocks joined by one edge: the spectrum mirrors (+1/-1
+    # pairs). Without the (M+I)/2 shift, subspace iteration converges to
+    # largest-|λ| mixtures; the embedding must track the algebraically
+    # largest (which='LA') subspace instead.
+    spla = pytest.importorskip("scipy.sparse.linalg")
+    sp = pytest.importorskip("scipy.sparse")
+
+    edges = ([(a, b) for a in range(8) for b in range(8, 16)]
+             + [(16 + a, 24 + b) for a in range(8) for b in range(8)]
+             + [(0, 16)])
+    src = np.array([e[0] for e in edges], np.int32)
+    dst = np.array([e[1] for e in edges], np.int32)
+    v = 32
+    g = build_graph(src, dst, num_vertices=v)
+    x = np.asarray(spectral_embedding(g, dim=2, num_iters=200))
+
+    a = sp.coo_matrix((np.ones(2 * len(src)),
+                       (np.r_[src, dst], np.r_[dst, src])), shape=(v, v)).tocsr()
+    deg = np.asarray(a.sum(1)).ravel()
+    dm = sp.diags(1.0 / np.sqrt(np.maximum(deg, 1)))
+    w, vecs = spla.eigsh(dm @ a @ dm, k=3, which="LA")
+    oracle = vecs[:, np.argsort(-w)][:, 1:]
+    cosines = np.linalg.svd(x.T @ oracle, compute_uv=False)
+    assert cosines.min() > 0.99
+
+
+def test_isolated_vertices_embed_at_origin_and_validation():
+    g = build_graph(np.array([0, 1], np.int32), np.array([1, 2], np.int32),
+                    num_vertices=5)
+    x = np.asarray(spectral_embedding(g, dim=2, num_iters=30))
+    assert np.abs(x[3:]).max() < 1e-5
+    gd = build_graph(np.array([0], np.int32), np.array([1], np.int32),
+                     num_vertices=2, symmetric=False)
+    with pytest.raises(ValueError, match="symmetric"):
+        spectral_embedding(gd)
