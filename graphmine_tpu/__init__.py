@@ -49,6 +49,7 @@ from graphmine_tpu.ops.mis import greedy_color, maximal_independent_set
 from graphmine_tpu.ops.linkpred import link_prediction
 from graphmine_tpu.ops.ktruss import k_truss
 from graphmine_tpu.ops.embedding import spectral_embedding
+from graphmine_tpu.ops.stats import degree_assortativity, density, diameter, reciprocity
 from graphmine_tpu.ops.centrality import (
     betweenness_centrality,
     closeness_centrality,
@@ -100,6 +101,10 @@ __all__ = [
     "link_prediction",
     "k_truss",
     "spectral_embedding",
+    "degree_assortativity",
+    "density",
+    "diameter",
+    "reciprocity",
     "hits",
     "closeness_centrality",
     "betweenness_centrality",

@@ -20,6 +20,7 @@ from graphmine_tpu.ops.mis import greedy_color, maximal_independent_set
 from graphmine_tpu.ops.linkpred import link_prediction
 from graphmine_tpu.ops.ktruss import k_truss
 from graphmine_tpu.ops.embedding import spectral_embedding
+from graphmine_tpu.ops.stats import degree_assortativity, density, diameter, reciprocity
 from graphmine_tpu.ops.centrality import (
     betweenness_centrality,
     closeness_centrality,
@@ -28,7 +29,7 @@ from graphmine_tpu.ops.centrality import (
     katz_centrality,
 )
 
-__all__ = ["spectral_embedding", "k_truss", "link_prediction", "maximal_independent_set", "greedy_color", "hits", "closeness_centrality", "betweenness_centrality",
+__all__ = ["degree_assortativity", "density", "diameter", "reciprocity", "spectral_embedding", "k_truss", "link_prediction", "maximal_independent_set", "greedy_color", "hits", "closeness_centrality", "betweenness_centrality",
            "eigenvector_centrality", "katz_centrality",
            "weighted_shortest_paths",
            "adjusted_rand_index", "normalized_mutual_info","segment_mode", "BucketedModePlan", "bucketed_mode", "lpa_superstep_bucketed", "aggregate_messages", "pregel", "find", "parse_pattern", "StreamingLOF", "fit_lof", "score_lof", "label_propagation", "lpa_superstep", "connected_components", "strongly_connected_components", "louvain", "modularity", "pagerank", "parallel_personalized_pagerank", "svd_plus_plus", "svdpp_predict", "SVDPlusPlusModel", "degrees", "in_degrees", "out_degrees", "bfs", "bfs_parents", "bfs_distances", "shortest_paths", "triangle_count", "clustering_coefficient", "core_numbers"]

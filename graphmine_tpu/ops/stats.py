@@ -1,0 +1,111 @@
+"""Whole-graph summary statistics: assortativity, reciprocity, density,
+diameter — the one-number descriptors an analyst reaches for first
+(NetworkX parity, oracle-tested).
+
+Host/NumPy for the closed-form statistics (they are O(E) reductions over
+the edge list, not supersteps); the diameter estimate rides the compiled
+BFS machinery.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from graphmine_tpu.graph.container import Graph, simple_undirected_edges
+
+
+def degree_assortativity(graph: Graph) -> float:
+    """Pearson correlation of degrees across undirected edges
+    (``nx.degree_assortativity_coefficient`` on the simple graph).
+    Returns NaN when every vertex has the same degree (zero variance)."""
+    a, b = simple_undirected_edges(graph)
+    if len(a) == 0:
+        return float("nan")
+    v = graph.num_vertices
+    deg = np.bincount(a, minlength=v) + np.bincount(b, minlength=v)
+    x = np.concatenate([deg[a], deg[b]]).astype(np.float64)
+    y = np.concatenate([deg[b], deg[a]]).astype(np.float64)
+    sx = x.std()
+    if sx == 0:
+        return float("nan")
+    return float(((x - x.mean()) * (y - y.mean())).mean() / (sx * y.std()))
+
+
+def _directed_codes(graph: Graph, drop_self_loops: bool) -> np.ndarray:
+    """Distinct directed edges encoded ``src * V + dst`` (int64)."""
+    src = np.asarray(graph.src).astype(np.int64)
+    dst = np.asarray(graph.dst).astype(np.int64)
+    if drop_self_loops:
+        keep = src != dst
+        src, dst = src[keep], dst[keep]
+    return np.unique(src * graph.num_vertices + dst)
+
+
+def reciprocity(graph: Graph) -> float:
+    """Fraction of directed edges whose reverse also exists
+    (``nx.reciprocity``; duplicates collapse, self-loops drop). Raises on
+    symmetric graphs — messages already flow both ways there, so the
+    question is meaningless (NetworkX raises for undirected too)."""
+    if graph.symmetric:
+        raise ValueError(
+            "reciprocity needs a directed graph (build_graph(symmetric=False))"
+        )
+    v = graph.num_vertices
+    codes = _directed_codes(graph, drop_self_loops=True)
+    if len(codes) == 0:
+        return float("nan")
+    rev = (codes % v) * v + codes // v
+    return float(np.isin(codes, rev).mean())
+
+
+def density(graph: Graph, directed: bool | None = None) -> float:
+    """Edge density (``nx.density``: distinct edges — self-loops count —
+    over ``V(V-1)`` ordered or unordered pairs)."""
+    v = graph.num_vertices
+    if v <= 1:
+        return 0.0
+    if directed is None:
+        directed = not graph.symmetric
+    if directed:
+        e = len(_directed_codes(graph, drop_self_loops=False))
+        return e / (v * (v - 1))
+    src = np.asarray(graph.src).astype(np.int64)
+    dst = np.asarray(graph.dst).astype(np.int64)
+    e = len(np.unique(np.minimum(src, dst) * v + np.maximum(src, dst)))
+    return 2.0 * e / (v * (v - 1))
+
+
+def diameter(graph: Graph, exact: bool = False, seed: int = 0) -> int:
+    """Longest shortest path in hops over the symmetric graph, ignoring
+    unreachable pairs (largest finite eccentricity).
+
+    Default: the double-sweep lower bound — BFS from a random vertex of
+    the largest component, then BFS from the farthest vertex found; exact
+    on trees and typically tight on real graphs. ``exact=True`` runs BFS
+    from every vertex through the batched ``shortest_paths`` tiles —
+    ``[V, V]`` distances, so only for validation-scale graphs."""
+    from graphmine_tpu.ops.paths import UNREACHABLE, bfs_distances, shortest_paths
+
+    v = graph.num_vertices
+    if v == 0:
+        return 0
+    if exact:
+        dist = np.asarray(shortest_paths(
+            graph, np.arange(v, dtype=np.int32), direction="both"))
+        finite = dist[dist < int(UNREACHABLE)]
+        return int(finite.max(initial=0))
+    # start inside the largest component, else a sweep from a small or
+    # singleton component reports its tiny eccentricity
+    from graphmine_tpu.ops.cc import connected_components
+
+    comp = np.asarray(connected_components(graph))
+    vals, counts = np.unique(comp, return_counts=True)
+    members = np.flatnonzero(comp == vals[counts.argmax()])
+    rng = np.random.default_rng(seed)
+    start = np.int32(members[rng.integers(0, len(members))])
+    d1 = np.asarray(bfs_distances(graph, np.array([start]), direction="both"))
+    d1 = np.where(d1 < int(UNREACHABLE), d1, -1)
+    far = np.int32(d1.argmax())
+    d2 = np.asarray(bfs_distances(graph, np.array([far]), direction="both"))
+    d2 = np.where(d2 < int(UNREACHABLE), d2, -1)
+    return int(max(d1.max(initial=0), d2.max(initial=0)))

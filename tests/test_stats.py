@@ -1,0 +1,91 @@
+"""Graph statistics vs NetworkX oracles."""
+
+import numpy as np
+import pytest
+
+from graphmine_tpu.graph.container import build_graph
+from graphmine_tpu.ops.stats import (
+    degree_assortativity,
+    density,
+    diameter,
+    reciprocity,
+)
+
+nx = pytest.importorskip("networkx")
+
+
+def random_edges(seed=0, v=50, e=240):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    keep = src != dst
+    return src[keep], dst[keep], v
+
+
+def test_assortativity_matches_networkx():
+    src, dst, v = random_edges()
+    g = build_graph(src, dst, num_vertices=v)
+    G = nx.Graph()
+    G.add_nodes_from(range(v))
+    G.add_edges_from(zip(src.tolist(), dst.tolist()))
+    assert degree_assortativity(g) == pytest.approx(
+        nx.degree_assortativity_coefficient(G), abs=1e-9)
+    # star graph: perfectly disassortative
+    star = build_graph(np.zeros(5, np.int32), np.arange(1, 6, dtype=np.int32),
+                       num_vertices=6)
+    assert degree_assortativity(star) == pytest.approx(-1.0)
+
+
+def test_reciprocity_matches_networkx():
+    src, dst, v = random_edges(seed=1)
+    g = build_graph(src, dst, num_vertices=v, symmetric=False)
+    G = nx.DiGraph()
+    G.add_nodes_from(range(v))
+    G.add_edges_from(zip(src.tolist(), dst.tolist()))
+    assert reciprocity(g) == pytest.approx(nx.reciprocity(G), abs=1e-12)
+    one_way = build_graph(np.array([0], np.int32), np.array([1], np.int32),
+                          num_vertices=2, symmetric=False)
+    assert reciprocity(one_way) == 0.0
+    with pytest.raises(ValueError, match="directed"):
+        reciprocity(build_graph(src, dst, num_vertices=v))  # symmetric
+
+
+def test_density_matches_networkx():
+    src, dst, v = random_edges(seed=2)
+    gu = build_graph(src, dst, num_vertices=v)
+    G = nx.Graph()
+    G.add_nodes_from(range(v))
+    G.add_edges_from(zip(src.tolist(), dst.tolist()))
+    assert density(gu) == pytest.approx(nx.density(G), abs=1e-12)
+    gd = build_graph(src, dst, num_vertices=v, symmetric=False)
+    GD = nx.DiGraph()
+    GD.add_nodes_from(range(v))
+    GD.add_edges_from(zip(src.tolist(), dst.tolist()))
+    assert density(gd) == pytest.approx(nx.density(GD), abs=1e-12)
+    # self-loops count toward m, as in nx
+    sl = build_graph(np.array([0, 1, 1], np.int32), np.array([1, 2, 1], np.int32),
+                     num_vertices=3, symmetric=False)
+    SL = nx.DiGraph([(0, 1), (1, 2), (1, 1)])
+    assert density(sl) == pytest.approx(nx.density(SL), abs=1e-12)
+
+
+def test_diameter_exact_and_double_sweep():
+    src, dst, v = random_edges(seed=3)
+    g = build_graph(src, dst, num_vertices=v)
+    G = nx.Graph()
+    G.add_nodes_from(range(v))
+    G.add_edges_from(zip(src.tolist(), dst.tolist()))
+    comps = [G.subgraph(c) for c in nx.connected_components(G)]
+    oracle = max(nx.diameter(c) for c in comps if len(c) > 1)
+    assert diameter(g, exact=True) == oracle
+    lb = diameter(g)  # double-sweep lower bound
+    assert 0 < lb <= oracle + 0  # a valid lower bound
+    # exact on a path graph even for the sweep
+    path = build_graph(np.arange(9, dtype=np.int32),
+                       np.arange(1, 10, dtype=np.int32), num_vertices=10)
+    assert diameter(path) == 9 and diameter(path, exact=True) == 9
+    # isolated vertices must not swallow the sweep's starting point
+    padded = build_graph(np.arange(9, dtype=np.int32),
+                         np.arange(1, 10, dtype=np.int32), num_vertices=60)
+    for s in range(5):
+        assert diameter(padded, seed=s) == 9
