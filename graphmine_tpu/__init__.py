@@ -27,7 +27,7 @@ from graphmine_tpu.frames import GraphFrame
 from graphmine_tpu.io.edges import load_parquet_edges, load_edge_list
 from graphmine_tpu.ops.lpa import label_propagation
 from graphmine_tpu.ops.cc import connected_components
-from graphmine_tpu.ops.louvain import louvain
+from graphmine_tpu.ops.louvain import leiden, louvain
 from graphmine_tpu.ops.modularity import modularity
 from graphmine_tpu.ops.pagerank import pagerank, parallel_personalized_pagerank
 from graphmine_tpu.ops.degrees import degrees, in_degrees, out_degrees
@@ -71,6 +71,7 @@ __all__ = [
     "label_propagation",
     "connected_components",
     "louvain",
+    "leiden",
     "modularity",
     "pagerank",
     "parallel_personalized_pagerank",

@@ -365,6 +365,12 @@ class GraphFrame:
 
     # -- framework extras --------------------------------------------------
 
+    def leiden(self, **kw):
+        """Leiden-style refinement over Louvain: comparable modularity,
+        guaranteed internally connected communities."""
+        from graphmine_tpu.ops.louvain import leiden
+        return leiden(self.graph(weighted=True), **kw)
+
     def louvain(self, **kw):
         from graphmine_tpu.ops.louvain import louvain
         return louvain(self.graph(weighted=True), **kw)
