@@ -23,7 +23,7 @@ class PipelineConfig:
     backend: str = "jax"  # jax | graphframes
     num_devices: int | None = None  # None = all visible (local[*] parity, :12)
     # community detection
-    community_method: str = "lpa"  # lpa (Graphframes.py:81 parity) | louvain
+    community_method: str = "lpa"  # lpa (Graphframes.py:81 parity) | louvain | leiden
     max_iter: int = 5  # Graphframes.py:81
     gamma: float = 1.0  # louvain resolution
     # outlier detection
@@ -45,7 +45,7 @@ class PipelineConfig:
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.outlier_method not in ("recursive_lpa", "lof", "both", "none"):
             raise ValueError(f"unknown outlier_method {self.outlier_method!r}")
-        if self.community_method not in ("lpa", "louvain"):
+        if self.community_method not in ("lpa", "louvain", "leiden"):
             raise ValueError(f"unknown community_method {self.community_method!r}")
         if self.backend == "graphframes" and self.community_method != "lpa":
             raise ValueError(

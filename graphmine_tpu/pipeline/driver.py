@@ -86,14 +86,15 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
             mode_plan = None
 
     # ---- CS-3 community detection --------------------------------------
-    if config.community_method == "louvain":
-        from graphmine_tpu.ops.louvain import louvain
+    if config.community_method in ("louvain", "leiden"):
+        from graphmine_tpu.ops.louvain import leiden, louvain
 
         if config.checkpoint_dir:
             m.emit("warning", message="checkpoint/resume applies to LPA only; "
-                   "louvain runs are not checkpointed")
-        with m.timed("louvain", gamma=config.gamma):
-            labels, q = louvain(graph, gamma=config.gamma)
+                   f"{config.community_method} runs are not checkpointed")
+        algo = leiden if config.community_method == "leiden" else louvain
+        with m.timed(config.community_method, gamma=config.gamma):
+            labels, q = algo(graph, gamma=config.gamma)
     else:
         labels = _run_lpa(config, table, graph, m, mode_plan, n_dev)
         q = None
