@@ -14,15 +14,36 @@ import sys
 # Tests need 8 virtual CPU devices, so pytest re-execs itself once with the
 # hook disabled (from pytest_configure, after restoring captured fds, so the
 # replacement process inherits the real stdout). Set GRAPHMINE_TEST_TPU=1 to
-# run tests on the real device instead.
+# run tests on the real device instead. The scrub recipe itself is shared
+# with __graft_entry__.dryrun_multichip via graphmine_tpu/_envscrub.py,
+# loaded by file path so the jax-importing package __init__ never runs here.
+
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def _envscrub():
+    # Reuse the single loader in __graft_entry__ (imports only numpy/stdlib,
+    # never jax) so the scrub bootstrap exists in exactly one place.
+    import __graft_entry__
+
+    return __graft_entry__._load_envscrub()
+
+
+# Decided at import time, BEFORE the in-process scrub below blanks
+# PALLAS_AXON_POOL_IPS (the hook already fired at interpreter start, so the
+# scrub can't save *this* process — only a re-exec can).
+_REEXEC_NEEDED = bool(
+    os.environ.get("PALLAS_AXON_POOL_IPS")
+    and os.environ.get("GRAPHMINE_TEST_TPU") != "1"
+    and os.environ.get("_GRAPHMINE_TEST_REEXEC") != "1"
+)
 
 
 def _needs_reexec() -> bool:
-    return bool(
-        os.environ.get("PALLAS_AXON_POOL_IPS")
-        and os.environ.get("GRAPHMINE_TEST_TPU") != "1"
-        and os.environ.get("_GRAPHMINE_TEST_REEXEC") != "1"
-    )
+    return _REEXEC_NEEDED
 
 
 def _invoked_as_pytest_cli() -> bool:
@@ -38,17 +59,16 @@ def pytest_configure(config):
     cap = config.pluginmanager.getplugin("capturemanager")
     if cap is not None:
         cap.stop_global_capturing()
-    env = dict(os.environ)
-    env["PALLAS_AXON_POOL_IPS"] = ""
+    env = _envscrub().virtual_cpu_env(8, override_count=False)
     env["_GRAPHMINE_TEST_REEXEC"] = "1"
     os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], env)
 
 
 if os.environ.get("GRAPHMINE_TEST_TPU") != "1":
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    # Same scrub in-process (covers programmatic pytest.main() runs where
+    # the re-exec path doesn't fire; an existing explicit device-count
+    # flag is respected).
+    os.environ.update(_envscrub().virtual_cpu_env(8, override_count=False))
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
