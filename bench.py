@@ -19,6 +19,8 @@ for.
 import argparse
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -26,10 +28,17 @@ import numpy as np
 BASELINE_EDGES_PER_SEC_PER_CHIP = 100e6 * 5 / (60.0 * 8)
 
 # Default tier, sized for a single chip: ~8.4M directed edges -> 16.8M
-# messages. The northstar tier overrides these.
+# messages. The northstar tier overrides these; the CPU-fallback capture
+# path (see orchestrate()) shrinks them so a degraded run still finishes.
 NUM_VERTICES = 1 << 20
 NUM_EDGES = 1 << 23
 ITERS = 10
+
+_CPU_FALLBACK = os.environ.get("GRAPHMINE_BENCH_CPU_FALLBACK") == "1"
+if _CPU_FALLBACK:
+    NUM_VERTICES = 1 << 17
+    NUM_EDGES = 1 << 20
+    ITERS = 5
 
 
 def powerlaw_edges(v: int, e: int, seed: int = 0):
@@ -75,6 +84,10 @@ def main_northstar() -> None:
     build_graph_and_plan, lpa_superstep_bucketed = _setup_jax_cache()
 
     v, e, iters = 1 << 24, 100_000_000, 5
+    if _CPU_FALLBACK:
+        # Degraded capture: 1/16 scale so the record exists at all; the
+        # capture annotation marks it as not the real north-star run.
+        v, e = 1 << 20, 6_250_000
     t0 = time.perf_counter()
     src, dst = powerlaw_edges(v, e)
     t_gen = time.perf_counter() - t0
@@ -101,13 +114,18 @@ def main_northstar() -> None:
     print(
         json.dumps(
             {
-                "metric": "lpa_100m_maxiter5_seconds",
+                # A degraded 1/16-scale CPU-fallback run must not claim the
+                # 100M-edge metric name or its 60s-target ratio.
+                "metric": (
+                    "lpa_6m_maxiter5_seconds_cpu_fallback"
+                    if _CPU_FALLBACK else "lpa_100m_maxiter5_seconds"
+                ),
                 "value": round(dt, 3),
                 "unit": "s",
                 # target: < 60 s on a v4-8 (8 chips). vs_baseline is the
                 # plain 60s-target ratio; "chips" below records that this
                 # run used a fraction of the budgeted hardware.
-                "vs_baseline": round(60.0 / dt, 3),
+                "vs_baseline": 0.0 if _CPU_FALLBACK else round(60.0 / dt, 3),
                 "detail": {
                     "num_vertices": v,
                     "num_edges": e,
@@ -138,10 +156,12 @@ def main_lof() -> None:
     from graphmine_tpu.ops.lof import auroc, lof_scores
     from graphmine_tpu.ops.lpa import label_propagation
 
-    scale, v = 16, 1 << 16
+    scale, v, anomalies = 16, 1 << 16, 64
+    if _CPU_FALLBACK:
+        scale, v, anomalies = 14, 1 << 14, 16
     src, dst = rmat(scale, edge_factor=16, seed=1)
     src, dst, truth = inject_structural_anomalies(
-        src, dst, v, num_anomalies=64, edges_per_anomaly=60, seed=2
+        src, dst, v, num_anomalies=anomalies, edges_per_anomaly=60, seed=2
     )
     g = build_graph(src, dst, num_vertices=v)
     t0 = time.perf_counter()
@@ -158,7 +178,10 @@ def main_lof() -> None:
     print(
         json.dumps(
             {
-                "metric": "lof_auroc_injected_outliers",
+                "metric": (
+                    "lof_auroc_injected_outliers_cpu_fallback"
+                    if _CPU_FALLBACK else "lof_auroc_injected_outliers"
+                ),
                 "value": round(score, 4),
                 "unit": "auroc",
                 # baseline: 0.5 = chance; the harness target is > 0.8
@@ -166,7 +189,7 @@ def main_lof() -> None:
                 "detail": {
                     "num_vertices": v,
                     "num_edges": int(len(src)),
-                    "num_anomalies": 64,
+                    "num_anomalies": anomalies,
                     # first run includes jit compiles (persistently cached)
                     "seconds_with_compile": round(dt, 2),
                     "device": str(jax.devices()[0]),
@@ -215,7 +238,10 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "lpa_edges_per_sec_per_chip",
+                "metric": (
+                    "lpa_edges_per_sec_cpu_fallback"
+                    if _CPU_FALLBACK else "lpa_edges_per_sec_per_chip"
+                ),
                 "value": round(eps_chip),
                 "unit": "edges/s/chip",
                 "vs_baseline": round(eps_chip / BASELINE_EDGES_PER_SEC_PER_CHIP, 3),
@@ -231,8 +257,211 @@ def main() -> None:
     )
 
 
+# ---------------------------------------------------------------------------
+# Capture orchestration.
+#
+# Round-1 postmortem (VERDICT.md): the driver's bench invocation produced no
+# artifact twice — once rc=1 on a flaky axon init, once a >9-minute silent
+# hang. The measurement itself is fine; the capture path wasn't. So the
+# measurement now always runs in a CHILD process under a watchdog:
+#
+#   probe TPU init (bounded) -> run tier child (bounded) -> retry once
+#   -> else scrubbed-CPU fallback at reduced scale (bounded)
+#   -> else a one-line JSON error record.
+#
+# Every path prints exactly ONE parseable JSON line on stdout.
+# ---------------------------------------------------------------------------
+
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+
+_CHILD_TIMEOUT_S = {
+    "chip": 900.0,
+    "northstar": 2700.0,
+    "lof": 1200.0,
+}
+
+
+def _virtual_cpu_env(n_devices):
+    if _REPO_DIR not in sys.path:
+        sys.path.insert(0, _REPO_DIR)
+    import __graft_entry__
+
+    return __graft_entry__._load_envscrub().virtual_cpu_env(n_devices)
+
+
+def _probe_tpu(timeout_s=None):
+    """Bounded backend-init probe in a throwaway child.
+
+    -> (ok, platform | None, info). ``platform`` is what the default
+    backend actually is ("tpu", "cpu", ...) so the caller can distinguish
+    a healthy accelerator from an accidental CPU-only environment.
+    """
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("GRAPHMINE_BENCH_PROBE_TIMEOUT", "120"))
+    code = (
+        "import jax; d = jax.devices(); "
+        "print(d[0].platform, len(d), str(d[0]))"
+    )
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, None, f"backend init timed out after {timeout_s:.0f}s"
+    if p.returncode != 0:
+        tail = (p.stderr or "").strip().splitlines()[-1:] or ["no stderr"]
+        return False, None, f"backend init rc={p.returncode}: {tail[0][:200]}"
+    info = (p.stdout or "").strip()[:200]
+    platform = info.split()[0] if info else "unknown"
+    return True, platform, info
+
+
+def _run_child(tier, env, timeout_s):
+    """Run one measurement child. -> (record dict | None, error | None)."""
+    env = dict(env, _GRAPHMINE_BENCH_CHILD="1")
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--tier", tier],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=_REPO_DIR,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"measurement timed out after {timeout_s:.0f}s (killed)"
+    # Forward child diagnostics without polluting the one-JSON-line stdout.
+    for line in (p.stderr or "").strip().splitlines()[-15:]:
+        print(f"[child stderr] {line}", file=sys.stderr)
+    record = None
+    for line in (p.stdout or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                cand = json.loads(line)
+            except json.JSONDecodeError:
+                cand = None
+            if isinstance(cand, dict) and "metric" in cand:
+                record = cand
+                continue
+        if line:
+            print(f"[child stdout] {line}", file=sys.stderr)
+    if p.returncode != 0:
+        return None, f"measurement child rc={p.returncode}"
+    if record is None:
+        return None, "child produced no JSON record"
+    return record, None
+
+
+def _run_backend_audit(timeout_s=300.0):
+    """Cross-backend numerical audit (tools/tpu_backend_audit.py): the
+    default backend (real TPU, incl. the Pallas kNN kernel) vs a CPU
+    reference. Returns a short status string for the capture record."""
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.join(_REPO_DIR, "tools", "tpu_backend_audit.py")],
+            capture_output=True, text=True, timeout=timeout_s, cwd=_REPO_DIR,
+        )
+    except subprocess.TimeoutExpired:
+        return f"timeout after {timeout_s:.0f}s"
+    if p.returncode == 0 and "all backends agree" in (p.stdout or ""):
+        return "agree"
+    tail = ((p.stderr or "") + (p.stdout or "")).strip().splitlines()[-1:]
+    return f"rc={p.returncode}: {tail[0][:200] if tail else 'no output'}"
+
+
+def orchestrate(tier):
+    timeout_s = _CHILD_TIMEOUT_S.get(tier, 900.0)
+    # Overall wall-clock budget: the capture must terminate well inside any
+    # external driver deadline even in the worst retry sequence. Defaults
+    # to the tier's own timeout plus room for probes + the CPU fallback
+    # (which always has ~300s reserved at the end).
+    budget_s = float(
+        os.environ.get("GRAPHMINE_BENCH_BUDGET", str(timeout_s + 900.0))
+    )
+    t_start = time.perf_counter()
+
+    def remaining(reserve=300.0):
+        return budget_s - reserve - (time.perf_counter() - t_start)
+
+    reasons = []
+    record = None
+    attempts = 0
+    platform = None
+    tpu_info = None
+    for attempt in (1, 2):
+        if remaining() < 60.0:
+            reasons.append(f"attempt{attempt}: skipped, budget exhausted")
+            break
+        ok, platform, info = _probe_tpu()
+        if not ok:
+            reasons.append(f"probe{attempt}: {info}")
+            continue
+        tpu_info = info
+        attempts = attempt
+        record, err = _run_child(
+            tier, dict(os.environ), min(timeout_s, max(remaining(), 60.0))
+        )
+        if record is not None:
+            break
+        reasons.append(f"run{attempt}: {err}")
+
+    fallback = None
+    if record is None:
+        # Degraded capture on a scrubbed single-device CPU: a smaller but
+        # real measurement with the failure reasons attached beats rc=124
+        # with no artifact (round-1's outcome).
+        env = _virtual_cpu_env(1)
+        env["GRAPHMINE_BENCH_CPU_FALLBACK"] = "1"
+        record, err = _run_child(
+            tier, env, min(timeout_s, max(remaining(reserve=0.0), 120.0))
+        )
+        if record is not None:
+            fallback = "; ".join(reasons) or "tpu unreachable"
+        else:
+            reasons.append(f"cpu-fallback: {err}")
+
+    if record is None:
+        print(json.dumps({
+            "metric": f"bench_{tier}_capture_failed",
+            "value": 0.0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+            "error": "; ".join(reasons)[:800],
+        }))
+        return 1
+
+    capture = {
+        "attempts": attempts,
+        "platform": platform,
+        "tpu_probe": tpu_info,
+        "cpu_fallback": fallback,
+        "failures": reasons or None,
+    }
+    # Cross-backend audit: only on a capture whose default backend really
+    # is the TPU (vs CPU the audit would vacuously compare CPU against
+    # itself) and with wall-clock budget left for its ~300s worst case.
+    if (
+        fallback is None
+        and platform == "tpu"
+        and tier == "chip"
+        and os.environ.get("GRAPHMINE_BENCH_AUDIT", "1") != "0"
+        and remaining(reserve=0.0) > 330.0
+    ):
+        capture["backend_audit"] = _run_backend_audit(
+            timeout_s=min(300.0, remaining(reserve=0.0) - 30.0)
+        )
+    record.setdefault("detail", {})["capture"] = capture
+    print(json.dumps(record))
+    return 0
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
-    ap.add_argument("--tier", choices=["chip", "northstar", "lof"], default="chip")
+    ap.add_argument(
+        "--tier", choices=["chip", "northstar", "lof"], default="chip"
+    )
     args = ap.parse_args()
-    {"chip": main, "northstar": main_northstar, "lof": main_lof}[args.tier]()
+    _TIERS = {"chip": main, "northstar": main_northstar, "lof": main_lof}
+    if os.environ.get("_GRAPHMINE_BENCH_CHILD") == "1":
+        _TIERS[args.tier]()
+    else:
+        sys.exit(orchestrate(args.tier))

@@ -39,6 +39,14 @@ def compute():
     w = rng.uniform(0.1, 2.0, e).astype(np.float32)
     labels = gm.label_propagation(g, max_iter=5)
     h, a = gm.hits(gd)
+    # kNN/LOF: impl="auto" selects the fused Pallas kernel on TPU and the
+    # XLA path on CPU, so this row is a real-hardware Pallas-vs-XLA check
+    # (indices are excluded: near-tie orderings may legitimately differ).
+    from graphmine_tpu.ops.knn import knn
+    from graphmine_tpu.ops.lof import lof_scores
+
+    pts = rng.normal(size=(512, 8)).astype(np.float32)
+    knn_d2, _ = knn(pts, k=16, impl="auto")
     return {
         "lpa": np.asarray(labels),
         "cc": np.asarray(gm.connected_components(g)),
@@ -56,6 +64,8 @@ def compute():
         "hits_h": np.asarray(h),
         "hits_a": np.asarray(a),
         "pagerank": np.asarray(gm.pagerank(gd, max_iter=50)),
+        "knn_d2": np.asarray(knn_d2),
+        "lof": np.asarray(lof_scores(pts, k=16)),
     }
 """
 
@@ -66,7 +76,12 @@ def main() -> int:
 np.savez({REF_PATH!r}, **compute())
 print("cpu reference written")
 """
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # Full scrub, not just JAX_PLATFORMS: the axon sitecustomize hook would
+    # otherwise route the "CPU reference" child to the TPU too, making the
+    # audit vacuously compare TPU against itself.
+    import __graft_entry__
+
+    env = __graft_entry__._load_envscrub().virtual_cpu_env(1)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     subprocess.run([sys.executable, "-c", code], check=True, env=env)
 
