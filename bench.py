@@ -184,14 +184,193 @@ def main_lof() -> None:
                 ),
                 "value": round(score, 4),
                 "unit": "auroc",
-                # baseline: 0.5 = chance; the harness target is > 0.8
-                "vs_baseline": round(score / 0.8, 3),
+                # baseline: 0.5 = chance; the harness target is > 0.8.
+                # Fallback runs at reduced scale: no target ratio claimed.
+                "vs_baseline": 0.0 if _CPU_FALLBACK else round(score / 0.8, 3),
                 "detail": {
                     "num_vertices": v,
                     "num_edges": int(len(src)),
                     "num_anomalies": anomalies,
                     # first run includes jit compiles (persistently cached)
                     "seconds_with_compile": round(dt, 2),
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
+def main_snap() -> None:
+    """SNAP ladder tier (BASELINE.json "configs"; VERDICT r1 item 4).
+
+    LPA(maxIter=5) + connected components on every rung through
+    com-LiveJournal (34M edges — single-chip scale), plus Louvain below
+    1M edges. Real SNAP edge lists are used automatically when present
+    under ``$GRAPHMINE_SNAP_DIR`` or ``./data`` (drop e.g.
+    ``com-lj.ungraph.txt`` there); this environment has zero network
+    egress and no vendored SNAP files, so absent files run the R-MAT
+    stand-in at the rung's true scale with ``source="rmat-standin"``
+    recorded — same sizes, same skew family, honestly labeled."""
+    import jax
+    import jax.numpy as jnp
+
+    build_graph_and_plan, lpa_superstep_bucketed = _setup_jax_cache()
+
+    from graphmine_tpu.datasets import load, snap_path
+    from graphmine_tpu.ops.cc import connected_components
+    from graphmine_tpu.ops.louvain import louvain
+    from graphmine_tpu.ops.lpa import num_communities
+
+    data_dir = os.environ.get(
+        "GRAPHMINE_SNAP_DIR", os.path.join(_REPO_DIR, "data")
+    )
+    rungs = ["ego-facebook", "com-amazon", "com-livejournal"]
+    max_scale = None
+    if _CPU_FALLBACK:
+        rungs = rungs[:2]
+        max_scale = 16
+    out = []
+    for name in rungs:
+        real = snap_path(name, data_dir) is not None
+        et = load(name, data_dir=data_dir, max_scale=max_scale)
+        v, e = et.num_vertices, int(len(et.src))
+
+        t0 = time.perf_counter()
+        graph, plan = build_graph_and_plan(et.src, et.dst, num_vertices=v)
+        t_build = time.perf_counter() - t0
+
+        step = jax.jit(lpa_superstep_bucketed)
+        labels = step(jnp.arange(v, dtype=jnp.int32), graph, plan)
+        np.asarray(labels[:4])  # compile + settle
+        labels = jnp.arange(v, dtype=jnp.int32)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            labels = step(labels, graph, plan)
+        np.asarray(labels[:4])
+        t_lpa = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        cc = connected_components(graph)
+        n_cc = int(num_communities(cc))
+        t_cc = time.perf_counter() - t0
+
+        rec = {
+            "rung": name,
+            "source": "snap" if real else "rmat-standin",
+            "vertices": v,
+            "edges": e,
+            "build_seconds": round(t_build, 2),
+            "lpa5_seconds": round(t_lpa, 3),
+            "lpa_edges_per_sec": round(e * 5 / t_lpa),
+            "lpa_communities": int(num_communities(labels)),
+            "cc_seconds": round(t_cc, 2),
+            "components": n_cc,
+        }
+        if e <= 2_000_000:
+            t0 = time.perf_counter()
+            _, q = louvain(graph)
+            rec["louvain_seconds"] = round(time.perf_counter() - t0, 2)
+            rec["louvain_modularity"] = round(float(q), 4)
+        out.append(rec)
+        print(json.dumps({"progress": rec}), file=sys.stderr, flush=True)
+
+    top = out[-1]
+    eps = top["lpa_edges_per_sec"]
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "snap_ladder_lpa_edges_per_sec_cpu_fallback"
+                    if _CPU_FALLBACK else "snap_ladder_lpa_edges_per_sec_per_chip"
+                ),
+                "value": eps,
+                "unit": "edges/s" if _CPU_FALLBACK else "edges/s/chip",
+                "vs_baseline": 0.0 if _CPU_FALLBACK else round(
+                    eps / BASELINE_EDGES_PER_SEC_PER_CHIP, 3
+                ),
+                "detail": {
+                    "headline_rung": top["rung"],
+                    "rungs": out,
+                    "data_dir": data_dir,
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
+def main_quality() -> None:
+    """Quality tier (VERDICT r1 item 8): community-detection *accuracy* —
+    the ``Overview:9`` axis the reference names but never measures.
+
+    ARI/NMI against SBM planted truth plus modularity, for LPA vs Louvain
+    vs Leiden at two scales. Headline value: best ARI on the larger SBM."""
+    import jax
+
+    _setup_jax_cache()
+
+    from graphmine_tpu.datasets import sbm
+    from graphmine_tpu.graph.container import build_graph
+    from graphmine_tpu.ops.cluster_metrics import (
+        adjusted_rand_index,
+        normalized_mutual_info,
+    )
+    from graphmine_tpu.ops.louvain import leiden, louvain
+    from graphmine_tpu.ops.lpa import label_propagation
+    from graphmine_tpu.ops.modularity import modularity
+
+    configs = [
+        ("sbm-2k", [100] * 20, 0.1, 0.002),
+        ("sbm-20k", [400] * 50, 0.04, 0.0004),
+    ]
+    if _CPU_FALLBACK:
+        configs = configs[:1]
+    out = []
+    for name, sizes, p_in, p_out in configs:
+        src, dst, truth = sbm(sizes, p_in, p_out, seed=3)
+        v = int(truth.shape[0])
+        g = build_graph(src, dst, num_vertices=v)
+        rec = {"config": name, "vertices": v, "edges": int(len(src)), "algos": {}}
+        runs = {
+            "lpa": lambda: (label_propagation(g, max_iter=5), None),
+            "louvain": lambda: louvain(g),
+            "leiden": lambda: leiden(g),
+        }
+        for algo, fn in runs.items():
+            t0 = time.perf_counter()
+            labels, q = fn()
+            labels = np.asarray(labels)
+            dt = time.perf_counter() - t0
+            if q is None:
+                q = float(modularity(labels, g))
+            rec["algos"][algo] = {
+                "ari": round(float(adjusted_rand_index(labels, truth)), 4),
+                "nmi": round(float(normalized_mutual_info(labels, truth)), 4),
+                "modularity": round(float(q), 4),
+                "communities": int(len(np.unique(labels))),
+                "seconds": round(dt, 2),
+            }
+        out.append(rec)
+        print(json.dumps({"progress": rec}), file=sys.stderr, flush=True)
+
+    big = out[-1]
+    best = max(a["ari"] for a in big["algos"].values())
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "community_quality_best_ari_cpu_fallback"
+                    if _CPU_FALLBACK else "community_quality_best_ari"
+                ),
+                "value": best,
+                "unit": "ari",
+                # baseline 0.5: mid-quality recovery; planted SBM structure
+                # at these densities is fully recoverable (ARI ~1) by a
+                # good method, so > 1.6 here means near-perfect recovery.
+                # Fallback runs only the small config: no ratio claimed.
+                "vs_baseline": 0.0 if _CPU_FALLBACK else round(best / 0.5, 3),
+                "detail": {
+                    "configs": out,
                     "device": str(jax.devices()[0]),
                 },
             }
@@ -243,8 +422,12 @@ def main() -> None:
                     if _CPU_FALLBACK else "lpa_edges_per_sec_per_chip"
                 ),
                 "value": round(eps_chip),
-                "unit": "edges/s/chip",
-                "vs_baseline": round(eps_chip / BASELINE_EDGES_PER_SEC_PER_CHIP, 3),
+                "unit": "edges/s" if _CPU_FALLBACK else "edges/s/chip",
+                # A degraded CPU record must not report a ratio against
+                # the TPU per-chip baseline (same rule as northstar).
+                "vs_baseline": 0.0 if _CPU_FALLBACK else round(
+                    eps_chip / BASELINE_EDGES_PER_SEC_PER_CHIP, 3
+                ),
                 "detail": {
                     "num_vertices": NUM_VERTICES,
                     "num_edges": NUM_EDGES,
@@ -278,6 +461,8 @@ _CHILD_TIMEOUT_S = {
     "chip": 900.0,
     "northstar": 2700.0,
     "lof": 1200.0,
+    "snap": 2400.0,
+    "quality": 1200.0,
 }
 
 
@@ -344,10 +529,19 @@ def _run_child(tier, env, timeout_s):
                 continue
         if line:
             print(f"[child stdout] {line}", file=sys.stderr)
-    if p.returncode != 0:
-        return None, f"measurement child rc={p.returncode}"
     if record is None:
+        if p.returncode != 0:
+            return None, f"measurement child rc={p.returncode}"
         return None, "child produced no JSON record"
+    if p.returncode != 0:
+        # The measurement completed and printed its record before the
+        # interpreter died (the round-1 flaky-teardown class): keep the
+        # real data, disclose the exit code.
+        print(
+            f"[capture] child rc={p.returncode} after printing its record; "
+            "record salvaged", file=sys.stderr,
+        )
+        record.setdefault("detail", {})["child_rc"] = p.returncode
     return record, None
 
 
@@ -396,6 +590,14 @@ def orchestrate(tier):
             reasons.append(f"probe{attempt}: {info}")
             continue
         tpu_info = info
+        if platform != "tpu":
+            # No accelerator in this environment: don't run the full-scale
+            # tier under the TPU metric name (and don't burn the budget on
+            # e.g. a 100M-edge CPU northstar) — go straight to the honest
+            # reduced-scale CPU-fallback record.
+            reasons.append(f"probe{attempt}: default backend is "
+                           f"'{platform}', not tpu")
+            break
         attempts = attempt
         record, err = _run_child(
             tier, dict(os.environ), min(timeout_s, max(remaining(), 60.0))
@@ -457,10 +659,18 @@ def orchestrate(tier):
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument(
-        "--tier", choices=["chip", "northstar", "lof"], default="chip"
+        "--tier",
+        choices=["chip", "northstar", "lof", "snap", "quality"],
+        default="chip",
     )
     args = ap.parse_args()
-    _TIERS = {"chip": main, "northstar": main_northstar, "lof": main_lof}
+    _TIERS = {
+        "chip": main,
+        "northstar": main_northstar,
+        "lof": main_lof,
+        "snap": main_snap,
+        "quality": main_quality,
+    }
     if os.environ.get("_GRAPHMINE_BENCH_CHILD") == "1":
         _TIERS[args.tier]()
     else:

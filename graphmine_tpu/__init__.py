@@ -61,8 +61,10 @@ from graphmine_tpu import datasets
 from graphmine_tpu.table import Table, read_parquet
 from graphmine_tpu.ops.svdpp import svd_plus_plus, svdpp_predict
 from graphmine_tpu.interop import from_networkx, graph_from_networkx, to_networkx
+from graphmine_tpu.oracle import graphx_label_propagation
 
 __all__ = [
+    "graphx_label_propagation",
     "Graph",
     "GraphFrame",
     "build_graph",

@@ -28,7 +28,10 @@ from dataclasses import dataclass
 
 import numpy as np
 
-__all__ = ["rmat", "LadderRung", "LADDER", "load", "inject_structural_anomalies"]
+__all__ = [
+    "rmat", "LadderRung", "LADDER", "load", "snap_path",
+    "inject_structural_anomalies",
+]
 
 
 def rmat(
@@ -168,6 +171,20 @@ LADDER: dict[str, LadderRung] = {
 }
 
 
+def snap_path(name: str, data_dir: str = "data") -> str | None:
+    """Path to the rung's real SNAP edge list, or ``None`` when absent.
+
+    The single source of truth for real-vs-stand-in resolution: ``load``
+    uses it to pick the input and ``bench.py --tier snap`` uses it to
+    label the record's ``source`` — the two can't desync.
+    """
+    rung = LADDER.get(name)
+    if rung is None:
+        raise KeyError(f"unknown ladder rung {name!r}; have {sorted(LADDER)}")
+    path = os.path.join(data_dir, rung.snap_file)
+    return path if os.path.exists(path) else None
+
+
 def load(name: str, data_dir: str = "data", seed: int = 0, max_scale: int | None = None):
     """Load a ladder rung: the real SNAP file when present, else R-MAT.
 
@@ -178,8 +195,8 @@ def load(name: str, data_dir: str = "data", seed: int = 0, max_scale: int | None
     rung = LADDER.get(name)
     if rung is None:
         raise KeyError(f"unknown ladder rung {name!r}; have {sorted(LADDER)}")
-    path = os.path.join(data_dir, rung.snap_file)
-    if os.path.exists(path):
+    path = snap_path(name, data_dir)
+    if path is not None:
         from graphmine_tpu.io.edges import load_edge_list
 
         return load_edge_list(path)

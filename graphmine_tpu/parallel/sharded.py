@@ -136,16 +136,20 @@ def partition_graph(
     mp = max(int(counts.max(initial=0)), 1)
     mp = -(-mp // pad_multiple) * pad_multiple
 
-    recv_local = np.full((d, mp), vc, dtype=np.int32)  # Vc = drop sentinel
-    send_pad = np.zeros((d, mp), dtype=np.int32)
+    # Per-shard slice copies write straight into the padded rows (no temp
+    # per shard, no full-array pre-fill — only the padded tails are filled).
+    recv_local = np.empty((d, mp), dtype=np.int32)
+    send_pad = np.empty((d, mp), dtype=np.int32)
     w_pad = None if w_msg is None else np.zeros((d, mp), dtype=np.float32)
     offsets = np.zeros(d + 1, dtype=np.int64)
     np.cumsum(counts, out=offsets[1:])
     for s in range(d):
         lo, hi = offsets[s], offsets[s + 1]
         n = hi - lo
-        recv_local[s, :n] = recv[lo:hi] - s * vc
+        np.subtract(recv[lo:hi], s * vc, out=recv_local[s, :n], casting="unsafe")
+        recv_local[s, n:] = vc  # Vc = drop sentinel
         send_pad[s, :n] = send[lo:hi]
+        send_pad[s, n:] = 0
         if w_pad is not None:
             w_pad[s, :n] = w_msg[lo:hi]
 
@@ -184,8 +188,14 @@ def _build_shard_bucket_plan(deg, send_pad, counts, chunk_size, d):
     is padded to the max across shards so one SPMD program serves all
     devices. No histogram path here — a per-shard [n, V] count matrix
     would replicate per device; mega-hubs ride wide sort rows instead.
+
+    Vectorized across shards (one grouped argsort + per-class batched
+    gathers instead of classes x shards ``_class_rows`` calls — the
+    round-1 host-side scaling wall, VERDICT item 6). Semantics are pinned
+    against the direct ``_class_rows`` reference by
+    ``tests/test_sharded.py::test_bucket_plan_matches_class_rows_reference``.
     """
-    from graphmine_tpu.ops.bucketed_mode import _class_rows, _extend_widths
+    from graphmine_tpu.ops.bucketed_mode import _extend_widths
 
     sentinel_send = chunk_size * d          # the label sentinel slot
     widths = _extend_widths(int(deg.max(initial=1)))
@@ -194,24 +204,43 @@ def _build_shard_bucket_plan(deg, send_pad, counts, chunk_size, d):
     ptr = np.zeros((d, chunk_size), dtype=np.int64)
     np.cumsum(deg[:, :-1], axis=1, out=ptr[:, 1:])
 
+    eligible = deg > 0
+    n_classes = len(widths)
+    # Group owned vertices by class in one stable argsort per shard;
+    # ineligible (deg == 0) vertices sort to a trailing pseudo-class.
+    # Stability keeps rows in ascending vertex order within each class,
+    # matching _class_rows' nonzero() order.
+    sort_key = np.where(eligible, classes, n_classes).astype(np.int64)
+    order = np.argsort(sort_key, axis=1, kind="stable")       # [d, vc]
+    flat = (np.arange(d, dtype=np.int64)[:, None] * (n_classes + 1) + sort_key)
+    cnt = np.bincount(flat.ravel(), minlength=d * (n_classes + 1))
+    cnt = cnt.reshape(d, n_classes + 1)                       # [d, classes+1]
+    start = np.zeros_like(cnt)
+    np.cumsum(cnt[:, :-1], axis=1, out=start[:, 1:])
+    # _class_rows clamps gather indices to the shard's true message count.
+    max_idx = np.maximum(counts.astype(np.int64) - 1, 0)[:, None, None]
+
     bucket_send, bucket_target = [], []
-    for c in np.unique(classes[deg > 0]):
+    for c in np.unique(classes[eligible]):
         w = int(widths[c])
-        per_shard = [
-            _class_rows(
-                ptr[s], deg[s], deg[s] > 0, classes[s], c, w,
-                send_pad[s], sentinel_send, int(counts[s]),
-            )
-            for s in range(d)
-        ]
-        n_c = max(len(rows) for rows, _ in per_shard)
-        send_c = np.full((d, n_c, w), sentinel_send, dtype=np.int32)
-        # Padding rows get DISTINCT out-of-range targets (chunk_size + i):
+        n_s = cnt[:, c]                                       # rows per shard
+        n_c = int(n_s.max())
+        j = np.arange(n_c, dtype=np.int64)[None, :]           # [1, n_c]
+        row_valid = j < n_s[:, None]                          # [d, n_c]
+        pos = np.minimum(start[:, c, None] + j, deg.shape[1] - 1)
+        rows = np.take_along_axis(order, pos, 1)              # [d, n_c]
+        ptr_r = np.take_along_axis(ptr, rows, 1)
+        deg_r = np.where(row_valid, np.take_along_axis(deg, rows, 1), 0)
+        offs = np.arange(w, dtype=np.int64)[None, None, :]
+        idx = ptr_r[..., None] + offs                         # [d, n_c, w]
+        valid = offs < deg_r[..., None]
+        gathered = np.take_along_axis(
+            send_pad, np.minimum(idx, max_idx).reshape(d, -1), 1
+        ).reshape(d, n_c, w)
+        send_c = np.where(valid, gathered, sentinel_send).astype(np.int32)
+        # Padding rows get DISTINCT out-of-range targets (chunk_size + j):
         # mode="drop" discards them, and unique_indices=True stays honest.
-        tgt_c = chunk_size + np.tile(np.arange(n_c, dtype=np.int32), (d, 1))
-        for s, (rows, mat) in enumerate(per_shard):
-            send_c[s, : len(rows)] = mat
-            tgt_c[s, : len(rows)] = rows
+        tgt_c = np.where(row_valid, rows, chunk_size + j).astype(np.int32)
         bucket_send.append(send_c)
         bucket_target.append(tgt_c)
     return tuple(bucket_send), tuple(bucket_target)

@@ -22,6 +22,12 @@ class PipelineConfig:
     # engine (the plugin boundary from BASELINE.json)
     backend: str = "jax"  # jax | graphframes
     num_devices: int | None = None  # None = all visible (local[*] parity, :12)
+    # Multi-device LPA schedule: "replicated" gathers the full V-length
+    # label vector per superstep (fastest to ~100M vertices); "ring" keeps
+    # labels fully sharded and rotates chunks over ICI via ppermute —
+    # O(V/D + M/D) memory per device, the scalable path for graphs whose
+    # label vector doesn't fit replicated (parallel/ring.py).
+    schedule: str = "replicated"  # replicated | ring
     # community detection
     community_method: str = "lpa"  # lpa (Graphframes.py:81 parity) | louvain | leiden
     max_iter: int = 5  # Graphframes.py:81
@@ -30,7 +36,12 @@ class PipelineConfig:
     outlier_method: str = "both"  # recursive_lpa | lof | both | none
     sub_max_iter: int = 5  # Graphframes.py:126
     decile: float = 0.1  # Graphframes.py:136
-    lof_k: int = 20
+    # LOF neighborhood size. Must exceed the size of any *clustered*
+    # anomaly group or the group's members score each other as inliers:
+    # measured AUROC 0.49 at k=20 vs 0.91-0.93 at k>=100 on 64 injected
+    # hubs (docs/DESIGN.md, bench.py --tier lof). 128 is the measured
+    # best; the driver clamps it to num_vertices - 1 on small graphs.
+    lof_k: int = 128
     # observability
     show: int = 10  # .show(10) parity
     profile_dir: str | None = None  # jax.profiler trace output
@@ -43,6 +54,8 @@ class PipelineConfig:
             raise ValueError(f"unknown data_format {self.data_format!r}")
         if self.backend not in ("jax", "graphframes"):
             raise ValueError(f"unknown backend {self.backend!r}")
+        if self.schedule not in ("replicated", "ring"):
+            raise ValueError(f"unknown schedule {self.schedule!r}")
         if self.outlier_method not in ("recursive_lpa", "lof", "both", "none"):
             raise ValueError(f"unknown outlier_method {self.outlier_method!r}")
         if self.community_method not in ("lpa", "louvain", "leiden"):

@@ -201,9 +201,24 @@ def _run_lpa(
             m.emit("resume", iteration=start_iter)
 
     use_sharded = n_dev > 1
-    if use_sharded:
+    if config.schedule == "ring" and not use_sharded:
+        m.emit("warning", message="schedule='ring' needs >1 device; "
+               "running the single-device fused kernel instead")
+    if use_sharded and config.schedule == "ring":
+        # Memory-scalable schedule: labels stay sharded, chunks rotate
+        # over ICI (parallel/ring.py). Uses the sort-body message CSR.
+        from graphmine_tpu.parallel.ring import ring_label_propagation
+
         mesh = make_mesh(n_dev)
-        with m.timed("partition", shards=n_dev):
+        with m.timed("partition", shards=n_dev, schedule="ring"):
+            sg = shard_graph_arrays(partition_graph(graph, mesh=mesh), mesh)
+
+        def one_iter(lbl):
+            return ring_label_propagation(sg, mesh, max_iter=1, init_labels=lbl)
+
+    elif use_sharded:
+        mesh = make_mesh(n_dev)
+        with m.timed("partition", shards=n_dev, schedule="replicated"):
             sg = shard_graph_arrays(
                 partition_graph(graph, mesh=mesh, build_bucket_plan=True),
                 mesh,

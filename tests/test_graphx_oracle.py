@@ -1,0 +1,123 @@
+"""GraphX ``LabelPropagation`` oracle cross-validation (VERDICT r1 item 3).
+
+The north-star clause "matching GraphFrames community IDs on bundled data"
+(BASELINE.json; call site ``Graphframes.py:81``) is validated here without
+a JVM: ``graphmine_tpu.oracle`` reproduces GraphX's exact Pregel structure
+(both-direction messages, multiplicity, fixed supersteps, first-max
+``maxBy``) with the tie-break explicit, and the TPU engine is required to
+match it label-for-label under the shared deterministic tie rule. GraphX's
+own tie order is machine-dependent (Scala Map iteration order downstream
+of Spark's combiner merge order — see the module docstring), so the
+GraphX-like ``hash_order`` rule is compared at partition level with the
+measured agreement pinned.
+"""
+
+import numpy as np
+import pytest
+
+from graphmine_tpu.oracle import (
+    canonical_partition,
+    graphx_label_propagation,
+    scala_trie_order_key,
+)
+
+
+def _ari(a, b):
+    from graphmine_tpu.ops.cluster_metrics import adjusted_rand_index
+
+    return float(adjusted_rand_index(np.asarray(a), np.asarray(b)))
+
+
+def test_triangle_and_isolate():
+    # Synchronous LPA has no convergence guarantee (GraphX runs exactly
+    # maxIter steps for the same reason — odd cycles can oscillate under
+    # some tie choices); under the smallest-label rule the triangle does
+    # settle, and the isolated vertex keeps its label under every rule.
+    src = np.array([0, 1, 2], np.int64)
+    dst = np.array([1, 2, 0], np.int64)
+    labels = graphx_label_propagation(src, dst, 4, max_iter=4, tie="smallest")
+    assert set(labels[:3]) == {0}
+    for tie in ("smallest", "largest", "hash_order", "random"):
+        labels = graphx_label_propagation(src, dst, 4, max_iter=4, tie=tie)
+        assert labels[3] == 3  # no messages -> keeps initial label
+
+
+def test_tie_rules_differ_on_even_split():
+    # Vertex 2 hears {0: 1, 1: 1}: a pure tie between labels 0 and 1.
+    src = np.array([0, 1], np.int64)
+    dst = np.array([2, 2], np.int64)
+    small = graphx_label_propagation(src, dst, 3, max_iter=1, tie="smallest")
+    large = graphx_label_propagation(src, dst, 3, max_iter=1, tie="largest")
+    hashy = graphx_label_propagation(src, dst, 3, max_iter=1, tie="hash_order")
+    assert small[2] == 0 and large[2] == 1
+    # hash_order picks whichever of {0, 1} iterates first in the Scala trie.
+    keys = scala_trie_order_key(np.array([0, 1], np.int64))
+    assert hashy[2] == int(np.argmin(keys))
+
+
+def test_duplicate_edges_carry_multiplicity():
+    # Two copies of 1->3 outvote one 2->3 (Graphframes.py:70-74 keeps dups).
+    src = np.array([1, 1, 2], np.int64)
+    dst = np.array([3, 3, 3], np.int64)
+    labels = graphx_label_propagation(src, dst, 4, max_iter=1, tie="largest")
+    assert labels[3] == 1  # multiplicity 2 beats tie-rule preference
+
+
+def test_engine_matches_oracle_exactly_on_random_graphs():
+    """Label-for-label parity engine==oracle under the shared smallest-label
+    tie rule, across sizes and seeds: the engine implements GraphX's
+    structure, differing only in the (explicit) tie-break."""
+    from graphmine_tpu.graph.container import build_graph
+    from graphmine_tpu.ops.lpa import label_propagation
+
+    for v, e, seed in ((50, 120, 0), (300, 1500, 1), (1000, 8000, 2)):
+        r = np.random.default_rng(seed)
+        src = r.integers(0, v, e).astype(np.int32)
+        dst = r.integers(0, v, e).astype(np.int32)
+        g = build_graph(src, dst, num_vertices=v)
+        engine = np.asarray(label_propagation(g, max_iter=5))
+        oracle = graphx_label_propagation(src, dst, v, max_iter=5, tie="smallest")
+        np.testing.assert_array_equal(engine, oracle.astype(np.int32))
+
+
+def test_engine_matches_oracle_exactly_on_bundled_data(bundled_graph, bundled_edges):
+    from graphmine_tpu.ops.lpa import label_propagation
+
+    v = bundled_edges.num_vertices
+    engine = np.asarray(label_propagation(bundled_graph, max_iter=5))
+    oracle = graphx_label_propagation(
+        bundled_edges.src, bundled_edges.dst, v, max_iter=5, tie="smallest"
+    )
+    np.testing.assert_array_equal(engine, oracle.astype(np.int32))
+
+
+def test_bundled_partition_agreement_across_tie_rules(bundled_edges):
+    """The north-star check, stated honestly: community *partitions* on the
+    bundled data agree to ARI > 0.85 between this engine's tie rule and
+    the GraphX-like hash-order rule (measured 0.896; community counts
+    579 vs 612) and ARI > 0.8 even vs the adversarial largest-label rule
+    (measured 0.835; 650 communities), with every rule inside the
+    measured community-count band (BASELINE.md: ~650, band [550, 750]).
+    Ties move individual vertices but not the community structure — and
+    any single GraphX run is itself one sample from this tie-rule
+    family."""
+    v = bundled_edges.num_vertices
+    parts = {}
+    for tie in ("smallest", "hash_order", "largest"):
+        labels = graphx_label_propagation(
+            bundled_edges.src, bundled_edges.dst, v, max_iter=5, tie=tie
+        )
+        n_comm = len(np.unique(labels))
+        assert 550 <= n_comm <= 750, (tie, n_comm)
+        parts[tie] = canonical_partition(labels)
+    assert _ari(parts["smallest"], parts["hash_order"]) > 0.85
+    assert _ari(parts["smallest"], parts["largest"]) > 0.8
+
+
+def test_canonical_partition_invariant_to_relabeling(rng):
+    labels = rng.integers(0, 7, 100)
+    perm = rng.permutation(100)  # arbitrary label-value permutation
+    relabeled = perm[labels]
+    np.testing.assert_array_equal(
+        canonical_partition(labels), canonical_partition(relabeled)
+    )
