@@ -204,8 +204,8 @@ def main_snap() -> None:
     """SNAP ladder tier (BASELINE.json "configs"; VERDICT r1 item 4).
 
     LPA(maxIter=5) + connected components on every rung through
-    com-LiveJournal (34M edges — single-chip scale), plus Louvain below
-    1M edges. Real SNAP edge lists are used automatically when present
+    com-LiveJournal (34M edges — single-chip scale), plus Louvain on
+    rungs up to 2M edges. Real SNAP edge lists are used automatically when present
     under ``$GRAPHMINE_SNAP_DIR`` or ``./data`` (drop e.g.
     ``com-lj.ungraph.txt`` there); this environment has zero network
     egress and no vendored SNAP files, so absent files run the R-MAT

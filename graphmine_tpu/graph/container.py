@@ -139,19 +139,26 @@ def build_graph(
     sums instead of counts. Weight permutation needs the NumPy sort path.
     """
     src, dst, num_vertices = _prepare_edges(src, dst, num_vertices)
-    w = None
-    if edge_weights is not None:
-        w = np.asarray(edge_weights, dtype=np.float32)
-        if w.shape != src.shape:
-            raise ValueError("edge_weights must be one float per edge")
-        if len(w) and not np.all(w >= 0):  # also catches NaN (NaN >= 0 is False)
-            raise ValueError("edge_weights must be non-negative and not NaN")
+    w = _prepare_weights(edge_weights, src)
     ptr, recv, send, w_sorted = _message_csr(
         src, dst, num_vertices, symmetric, use_native, weights=w
     )
     return _graph_from_csr(
         src, dst, ptr, recv, send, num_vertices, symmetric, msg_weight=w_sorted
     )
+
+
+def _prepare_weights(edge_weights, src):
+    """Shared edge-weight coercion/validation (one float per edge, >= 0,
+    not NaN) for the graph builders (here and ``build_graph_and_plan``)."""
+    if edge_weights is None:
+        return None
+    w = np.asarray(edge_weights, dtype=np.float32)
+    if w.shape != src.shape:
+        raise ValueError("edge_weights must be one float per edge")
+    if len(w) and not np.all(w >= 0):  # also catches NaN (NaN >= 0 is False)
+        raise ValueError("edge_weights must be non-negative and not NaN")
+    return w
 
 
 def _prepare_edges(src, dst, num_vertices):

@@ -79,14 +79,16 @@ def _gather_rows_device(send, starts, degs, w: int, fill: int):
     return jnp.where(valid, send[safe].astype(jnp.int32), fill)
 
 
-def _class_rows(ptr, deg, eligible, classes, c, w, values, fill, num_values):
+def _class_rows(ptr, deg, eligible, classes, c, w, values, fill, num_values,
+                out_dtype=np.int32):
     """Rows and padded [n, w] gather matrix for one width class (host).
 
     The single source of truth for bucket-row construction, shared by
     :meth:`BucketedModePlan.from_ptr` and the sharded plan builder
     (``parallel/sharded.py``) so the two stay semantically identical.
     ``values=None`` emits message *indices* (non-fused plans); otherwise
-    ``values`` is gathered (fused plans: sender ids). Padding slots get
+    ``values`` is gathered (fused plans: sender ids, or — with
+    ``out_dtype=float32`` — per-message weights). Padding slots get
     ``fill``.
     """
     rows = np.nonzero((classes == c) & eligible)[0]
@@ -97,7 +99,7 @@ def _class_rows(ptr, deg, eligible, classes, c, w, values, fill, num_values):
         mat = np.where(valid, idx, fill)
     else:
         mat = np.where(valid, values[np.minimum(idx, max(num_values - 1, 0))], fill)
-    return rows, mat.astype(np.int32)
+    return rows, mat.astype(out_dtype)
 
 
 @jax.tree_util.register_dataclass
@@ -126,6 +128,11 @@ class BucketedModePlan:
     hist_vertex_ids: jax.Array | None = None
     hist_send: jax.Array | None = None
     hist_row_offset: jax.Array | None = None
+    # Weighted-mode payload (built when the graph carries msg_weight):
+    # per-class float32 [n_b, w_b] weights aligned slot-for-slot with
+    # send_idx/msg_idx (padding = 0), plus the hub messages' weights.
+    weight_mat: tuple | None = None
+    hist_weight: jax.Array | None = None
 
     @classmethod
     def from_graph(cls, graph: Graph, with_send: bool = False) -> "BucketedModePlan":
@@ -134,7 +141,11 @@ class BucketedModePlan:
         edge arrays are still on host, prefer :meth:`from_edges` (no device
         round-trip, fused-gather plan included)."""
         send = np.asarray(graph.msg_send) if with_send else None
-        return cls.from_ptr(np.asarray(graph.msg_ptr), graph.num_vertices, send)
+        w = None if graph.msg_weight is None else np.asarray(graph.msg_weight)
+        return cls.from_ptr(
+            np.asarray(graph.msg_ptr), graph.num_vertices, send,
+            weights_sorted=w,
+        )
 
     @classmethod
     def from_edges(
@@ -158,12 +169,22 @@ class BucketedModePlan:
         cls, ptr: np.ndarray, num_vertices: int,
         send_sorted: np.ndarray | None = None,
         send_device: "jax.Array | None" = None,
+        weights_sorted: np.ndarray | None = None,
     ) -> "BucketedModePlan":
         """``send_device``: the device-resident ``[M]`` sender array (e.g.
         ``graph.msg_send``). When given, bucket matrices and hub histogram
         inputs are built on the accelerator — only ``[n_b]`` row starts and
         degrees cross the host boundary instead of the ~2.5E padded plan
-        entries. Bit-identical to the host path."""
+        entries. Bit-identical to the host path.
+
+        ``weights_sorted``: optional float [M] per-message weights in the
+        same CSR order; builds the weighted-mode payload (host path only).
+        """
+        if weights_sorted is not None and send_device is not None:
+            raise ValueError(
+                "weighted plans are host-built; pass send_sorted, not "
+                "send_device"
+            )
         ptr = np.asarray(ptr).astype(np.int64)
         deg = ptr[1:] - ptr[:-1]
         m = int(ptr[-1])
@@ -183,7 +204,7 @@ class BucketedModePlan:
 
         widths = _extend_widths(int(deg[~hist_mask].max(initial=1)))
         classes = np.searchsorted(widths, np.maximum(deg, 1))
-        vertex_ids, msg_idx, send_idx = [], [], []
+        vertex_ids, msg_idx, send_idx, weight_mat = [], [], [], []
         bucketed = (deg > 0) & ~hist_mask
         for c in np.unique(classes[bucketed]):
             # Fused plans carry only sender-id matrices — msg_idx would
@@ -203,10 +224,17 @@ class BucketedModePlan:
                     send_sorted, num_vertices if send_sorted is not None else m, m,
                 )
                 mat = jnp.asarray(mat)
+            if weights_sorted is not None:
+                _, wmat = _class_rows(
+                    ptr, deg, bucketed, classes, c, int(widths[c]),
+                    np.asarray(weights_sorted, np.float32), 0.0, m,
+                    out_dtype=np.float32,
+                )
+                weight_mat.append(jnp.asarray(wmat))
             vertex_ids.append(jnp.asarray(ids.astype(np.int32)))
             (msg_idx if send_sorted is None else send_idx).append(mat)
 
-        hist_vertex_ids = hist_send = hist_row_offset = None
+        hist_vertex_ids = hist_send = hist_row_offset = hist_weight = None
         if hist_mask.any():
             hubs = np.nonzero(hist_mask)[0]
             rows = np.repeat(np.arange(len(hubs), dtype=np.int64), deg[hubs])
@@ -223,6 +251,10 @@ class BucketedModePlan:
                     [np.arange(ptr[h], ptr[h + 1], dtype=np.int64) for h in hubs]
                 )
                 hist_send = jnp.asarray(send_sorted[pos].astype(np.int32))
+                if weights_sorted is not None:
+                    hist_weight = jnp.asarray(
+                        np.asarray(weights_sorted, np.float32)[pos]
+                    )
             hist_row_offset = jnp.asarray((rows * num_vertices).astype(np.int32))
 
         return cls(
@@ -234,34 +266,45 @@ class BucketedModePlan:
             hist_vertex_ids=hist_vertex_ids,
             hist_send=hist_send,
             hist_row_offset=hist_row_offset,
+            weight_mat=tuple(weight_mat) if weights_sorted is not None else None,
+            hist_weight=hist_weight,
         )
 
 
 def build_graph_and_plan(
     src, dst, num_vertices: int | None = None, symmetric: bool = True,
-    use_native: bool = True,
+    use_native: bool = True, edge_weights=None,
 ):
     """Build the :class:`Graph` and its fused plan from ONE message-CSR
     pass — the pipeline's single-device fast path. Calling
     :func:`~graphmine_tpu.graph.container.build_graph` and
     :meth:`BucketedModePlan.from_edges` separately runs the counting sort
-    twice over the same edges; this shares it."""
+    twice over the same edges; this shares it. ``edge_weights`` builds a
+    weighted graph plus the plan's weight payload in the same pass."""
     from graphmine_tpu.graph.container import (
         _graph_from_csr,
         _message_csr,
         _prepare_edges,
+        _prepare_weights,
     )
 
     src, dst, num_vertices = _prepare_edges(src, dst, num_vertices)
-    ptr, recv, send, _ = _message_csr(src, dst, num_vertices, symmetric, use_native)
-    graph = _graph_from_csr(src, dst, ptr, recv, send, num_vertices, symmetric)
+    w = _prepare_weights(edge_weights, src)
+    ptr, recv, send, w_sorted = _message_csr(
+        src, dst, num_vertices, symmetric, use_native, weights=w
+    )
+    graph = _graph_from_csr(
+        src, dst, ptr, recv, send, num_vertices, symmetric, msg_weight=w_sorted
+    )
     # Host plan build by default. A device-side variant exists
     # (from_ptr(send_device=graph.msg_send)) that avoids shipping the
     # ~2.5E padded plan entries over the host boundary, but it costs one
     # XLA compile per width class whose shapes change with every graph —
     # measured a wash warm and far slower cold on the current setup; see
     # docs/DESIGN.md ("Plan construction placement").
-    return graph, BucketedModePlan.from_ptr(ptr, num_vertices, send)
+    return graph, BucketedModePlan.from_ptr(
+        ptr, num_vertices, send, weights_sorted=w_sorted
+    )
 
 
 def _rowwise_mode(lbl: jax.Array) -> jax.Array:
@@ -311,13 +354,77 @@ def _bucket_mode(mat: jax.Array) -> jax.Array:
     return _rowwise_mode(mat)
 
 
-def bucketed_mode(plan: BucketedModePlan, messages: jax.Array, fallback: jax.Array):
+def _rowwise_wmode(lbl: jax.Array, wgt: jax.Array) -> jax.Array:
+    """Weighted mode of each ``[n, w]`` row: argmax of per-label weight
+    sums, ties toward the smallest label. Sentinel slots carry weight 0
+    and are excluded. Weights must be non-negative (LPA weights are): a
+    run's within-run cumulative sums then never exceed its total, so the
+    global max of the cumulative scan is always attained at a run end."""
+    order = jnp.argsort(lbl, axis=1)
+    s = jnp.take_along_axis(lbl, order, axis=1)
+    ws = jnp.take_along_axis(jnp.where(lbl == _SENTINEL, 0.0, wgt), order, axis=1)
+    w = s.shape[1]
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    new_run = jnp.concatenate(
+        [jnp.ones((s.shape[0], 1), jnp.bool_), s[:, 1:] != s[:, :-1]], axis=1
+    )
+    run_start = lax.cummax(jnp.where(new_run, pos, -1), axis=1)
+    prefix = jnp.cumsum(ws, axis=1)
+    before = jnp.take_along_axis(
+        prefix, jnp.maximum(run_start - 1, 0), axis=1
+    )
+    score = prefix - jnp.where(run_start > 0, before, 0.0)  # cumweight in run
+    score = jnp.where(s == _SENTINEL, -1.0, score)
+    best = score.max(axis=1)
+    cand = jnp.where(score == best[:, None], s, _SENTINEL)
+    return cand.min(axis=1)
+
+
+def _rowwise_wmode_pairwise(lbl: jax.Array, wgt: jax.Array) -> jax.Array:
+    """Same contract as :func:`_rowwise_wmode` via O(w^2) pairwise-equality
+    weight sums — no sort network for narrow rows."""
+    valid = lbl != _SENTINEL
+    wz = jnp.where(valid, wgt, 0.0)
+    eq = (lbl[:, :, None] == lbl[:, None, :]) & valid[:, None, :]
+    scores = jnp.where(valid, jnp.sum(eq * wz[:, None, :], axis=2), -1.0)
+    best = scores.max(axis=1)
+    cand = jnp.where(scores == best[:, None], lbl, _SENTINEL)
+    return cand.min(axis=1)
+
+
+def _bucket_wmode(mat: jax.Array, wmat: jax.Array) -> jax.Array:
+    """Weighted :func:`_bucket_mode`: cheapest method per bucket width."""
+    w = mat.shape[1]
+    if w == 1:
+        return mat[:, 0]
+    if w == 2:
+        # degree-2 rows are exact: equal labels -> that label; else the
+        # heavier label wins, equal weights tie toward the smaller label.
+        l0, l1 = mat[:, 0], mat[:, 1]
+        w0, w1 = wmat[:, 0], wmat[:, 1]
+        pick0 = (w0 > w1) | ((w0 == w1) & (l0 <= l1))
+        return jnp.where(l0 == l1, l0, jnp.where(pick0, l0, l1))
+    if w <= _PAIRWISE_MAX_W:
+        return _rowwise_wmode_pairwise(mat, wmat)
+    return _rowwise_wmode(mat, wmat)
+
+
+def bucketed_mode(plan: BucketedModePlan, messages: jax.Array, fallback: jax.Array,
+                  weights: str | None = "plan"):
     """Per-vertex mode of ``messages`` under the plan's CSR layout.
 
     ``messages``: int32 ``[M]`` in message-CSR order (``labels[msg_send]``).
     ``fallback``: int32 ``[V]`` — value for vertices with no messages
     (LPA: keep the old label). Returns int32 ``[V]``.
+
+    ``weights="plan"`` (default): when the plan carries a weight payload
+    (built from a weighted graph), the mode is the argmax of per-value
+    weight sums — weighted-LPA semantics. Pass ``weights=None`` to force
+    the plain unweighted mode for generic reductions over a weighted
+    graph's plan.
     """
+    if weights not in ("plan", None):
+        raise ValueError("weights must be 'plan' or None")
     if plan.msg_idx is None:
         raise ValueError(
             "this plan is fused (send_idx only) — use lpa_superstep_bucketed, "
@@ -332,10 +439,15 @@ def bucketed_mode(plan: BucketedModePlan, messages: jax.Array, fallback: jax.Arr
         [messages.astype(jnp.int32), jnp.full((1,), _SENTINEL, jnp.int32)]
     )
     out = fallback.astype(jnp.int32)
-    for ids, idx in zip(plan.vertex_ids, plan.msg_idx):
-        out = out.at[ids].set(
-            _bucket_mode(msgs_pad[idx]), unique_indices=True, mode="drop"
-        )
+    wmats = (
+        plan.weight_mat
+        if weights == "plan" and plan.weight_mat is not None
+        else (None,) * len(plan.vertex_ids)
+    )
+    for ids, idx, wmat in zip(plan.vertex_ids, plan.msg_idx, wmats):
+        mat = msgs_pad[idx]
+        mode = _bucket_mode(mat) if wmat is None else _bucket_wmode(mat, wmat)
+        out = out.at[ids].set(mode, unique_indices=True, mode="drop")
     return out
 
 
@@ -348,11 +460,17 @@ def lpa_superstep_bucketed(
     With a fused plan (``send_idx`` present, e.g. from
     :meth:`BucketedModePlan.from_edges`) the [M] message array is never
     materialized: each bucket gathers sender labels directly — one gather
-    instead of two, saving an [M]-sized HBM round trip per superstep."""
-    if graph.msg_weight is not None:
+    instead of two, saving an [M]-sized HBM round trip per superstep.
+
+    Weighted graphs are first-class (r2; was sort-path-only): the plan
+    carries slot-aligned weight matrices and the row modes become argmax
+    of per-label weight sums (ties toward the smallest label, matching
+    ``segment_mode(weights=...)``)."""
+    if graph.msg_weight is not None and plan.weight_mat is None:
         raise ValueError(
-            "the bucketed kernel computes unweighted modes; weighted graphs "
-            "use the sort path (label_propagation with plan=None)"
+            "graph carries msg_weight but the plan has no weight payload; "
+            "build it with build_graph_and_plan(edge_weights=...), "
+            "BucketedModePlan.from_graph, or from_ptr(weights_sorted=...)"
         )
     if plan.send_idx is not None:
         if (
@@ -368,19 +486,27 @@ def lpa_superstep_bucketed(
             [labels.astype(jnp.int32), jnp.full((1,), _SENTINEL, jnp.int32)]
         )
         out = labels.astype(jnp.int32)
-        for ids, sidx in zip(plan.vertex_ids, plan.send_idx):
-            out = out.at[ids].set(
-                _bucket_mode(lbl_pad[sidx]), unique_indices=True, mode="drop"
+        wmats = plan.weight_mat or (None,) * len(plan.vertex_ids)
+        for ids, sidx, wmat in zip(plan.vertex_ids, plan.send_idx, wmats):
+            mat = lbl_pad[sidx]
+            mode = (
+                _bucket_mode(mat) if wmat is None else _bucket_wmode(mat, wmat)
             )
+            out = out.at[ids].set(mode, unique_indices=True, mode="drop")
         if plan.hist_vertex_ids is not None:
             # Mega-hub mode: per-hub label histogram + argmax. Exact slot
             # count (no padding), no wide sort; argmax's first-max rule is
-            # the smallest-label tie-break.
+            # the smallest-label tie-break. Weighted: the histogram
+            # accumulates weights instead of counts.
             n_hist = plan.hist_vertex_ids.shape[0]
             neigh = labels[plan.hist_send].astype(jnp.int32)
             flat = plan.hist_row_offset + neigh
-            hist = jnp.zeros((n_hist * plan.num_vertices,), jnp.int32)
-            hist = hist.at[flat].add(1, mode="drop")
+            if plan.hist_weight is None:
+                hist = jnp.zeros((n_hist * plan.num_vertices,), jnp.int32)
+                hist = hist.at[flat].add(1, mode="drop")
+            else:
+                hist = jnp.zeros((n_hist * plan.num_vertices,), jnp.float32)
+                hist = hist.at[flat].add(plan.hist_weight, mode="drop")
             counts = hist.reshape(n_hist, plan.num_vertices)
             modes = jnp.argmax(counts, axis=1).astype(jnp.int32)
             out = out.at[plan.hist_vertex_ids].set(

@@ -76,10 +76,11 @@ def label_propagation(
         plan = None
         if (
             init_labels is None
-            and graph.msg_weight is None  # fused kernel counts, not weights
             and not isinstance(graph.msg_ptr, jax.core.Tracer)
             and graph.num_messages >= (1 << 16)
         ):
+            # Weighted graphs ride the fast path too (r2): from_graph
+            # builds the plan's slot-aligned weight payload.
             plan = _cached_auto_plan(graph)
     elif plan is not None and not isinstance(plan, BucketedModePlan):
         raise ValueError(

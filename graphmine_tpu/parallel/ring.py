@@ -81,6 +81,18 @@ def _lpa_ring_body(own, recv_local, send, deg, *, chunk_size, num_shards):
     return jnp.where(deg > 0, mode, own).astype(jnp.int32)
 
 
+def _lpa_ring_body_weighted(own, recv_local, send, deg, w, *, chunk_size,
+                            num_shards):
+    """Weighted variant: the per-message weights are shard-local (they
+    ride the same padded rows as the message CSR; padding weight 0), so
+    only the labels travel the ring — the mode becomes an argmax of
+    weight sums via ``segment_mode(weights=...)``."""
+    recv_local, send, deg, w = recv_local[0], send[0], deg[0], w[0]
+    msg = _ring_gather(own, send, num_shards=num_shards, chunk_size=chunk_size)
+    mode, _ = segment_mode(recv_local, msg, num_segments=chunk_size, weights=w)
+    return jnp.where(deg > 0, mode, own).astype(jnp.int32)
+
+
 def _cc_ring_body(own, recv_local, send, deg, *, chunk_size, num_shards):
     """Min-label propagation + ring-based pointer jumping, labels sharded."""
     recv_local, send, deg = recv_local[0], send[0], deg[0]
@@ -94,11 +106,11 @@ def _cc_ring_body(own, recv_local, send, deg, *, chunk_size, num_shards):
     return jnp.minimum(new, rep).astype(jnp.int32)
 
 
-def _ring_step_fn(sg: ShardedGraph, mesh, body):
+def _ring_step_fn(sg: ShardedGraph, mesh, body, n_graph_args: int = 3):
     return jax.shard_map(
         partial(body, chunk_size=sg.chunk_size, num_shards=sg.num_shards),
         mesh=mesh,
-        in_specs=(P(VERTEX_AXIS), P(VERTEX_AXIS, None), P(VERTEX_AXIS, None), P(VERTEX_AXIS, None)),
+        in_specs=(P(VERTEX_AXIS),) + (P(VERTEX_AXIS, None),) * n_graph_args,
         out_specs=P(VERTEX_AXIS),
     )
 
@@ -115,16 +127,20 @@ def ring_label_propagation(
     memory/communication schedule. Returns int32 labels ``[V]``.
     """
     _check_mesh(sg, mesh)
-    if sg.msg_weight is not None:
-        raise NotImplementedError(
-            "the ring schedule computes unweighted modes; weighted LPA runs "
-            "via sharded_label_propagation (sort body) or single-device"
-        )
-    step_fn = _ring_step_fn(sg, mesh, _lpa_ring_body)
     labels = _padded_init_labels(sg) if init_labels is None else _pad_labels(init_labels, sg)
-    labels = _scan_supersteps(
-        lambda l: step_fn(l, sg.msg_recv_local, sg.msg_send, sg.degrees), labels, max_iter
-    )
+    if sg.msg_weight is not None:
+        step_fn = _ring_step_fn(sg, mesh, _lpa_ring_body_weighted, n_graph_args=4)
+        labels = _scan_supersteps(
+            lambda l: step_fn(l, sg.msg_recv_local, sg.msg_send, sg.degrees,
+                              sg.msg_weight),
+            labels, max_iter,
+        )
+    else:
+        step_fn = _ring_step_fn(sg, mesh, _lpa_ring_body)
+        labels = _scan_supersteps(
+            lambda l: step_fn(l, sg.msg_recv_local, sg.msg_send, sg.degrees),
+            labels, max_iter,
+        )
     return labels[: sg.num_vertices]
 
 

@@ -85,6 +85,10 @@ class ShardedGraph:
     # sort shard body; padding slots carry weight 0 and are dropped by the
     # recv sentinel anyway).
     msg_weight: jax.Array | None = None
+    # Weighted bucket plan (r2): per class, float32 [D, n_c, w_c] weights
+    # aligned slot-for-slot with bucket_send (padding slots 0). Empty on
+    # unweighted graphs.
+    bucket_weight: tuple = ()
 
     @property
     def padded_vertices(self) -> int:
@@ -117,12 +121,6 @@ def partition_graph(
         # One source of truth for message-CSR construction semantics.
         graph_or_src = build_graph(graph_or_src, dst, num_vertices=num_vertices)
     g = graph_or_src
-    if g.msg_weight is not None and build_bucket_plan:
-        raise ValueError(
-            "the bucketed shard body computes unweighted modes; partition a "
-            "weighted graph with build_bucket_plan=False (the sort body "
-            "honors the weights)"
-        )
     recv = np.asarray(g.msg_recv)
     send = np.asarray(g.msg_send)
     w_msg = None if g.msg_weight is None else np.asarray(g.msg_weight, np.float32)
@@ -158,10 +156,10 @@ def partition_graph(
     # recv ids beyond num_vertices never occur; reshape covers padded tail
     deg[:, :] = deg_flat.reshape(d, vc)
 
-    bucket_send, bucket_target = (), ()
+    bucket_send, bucket_target, bucket_weight = (), (), ()
     if build_bucket_plan:
-        bucket_send, bucket_target = _build_shard_bucket_plan(
-            deg, send_pad, counts, vc, d
+        bucket_send, bucket_target, bucket_weight = _build_shard_bucket_plan(
+            deg, send_pad, counts, vc, d, w_pad
         )
 
     # Fields stay host-side (NumPy): shard_graph_arrays does the one
@@ -177,10 +175,11 @@ def partition_graph(
         bucket_send=bucket_send,
         bucket_target=bucket_target,
         msg_weight=w_pad,
+        bucket_weight=bucket_weight,
     )
 
 
-def _build_shard_bucket_plan(deg, send_pad, counts, chunk_size, d):
+def _build_shard_bucket_plan(deg, send_pad, counts, chunk_size, d, w_pad=None):
     """Stacked per-shard degree-bucket plan with uniform shapes.
 
     Every shard's owned vertices are bucketed on the shared 1.5x width
@@ -220,7 +219,7 @@ def _build_shard_bucket_plan(deg, send_pad, counts, chunk_size, d):
     # _class_rows clamps gather indices to the shard's true message count.
     max_idx = np.maximum(counts.astype(np.int64) - 1, 0)[:, None, None]
 
-    bucket_send, bucket_target = [], []
+    bucket_send, bucket_target, bucket_weight = [], [], []
     for c in np.unique(classes[eligible]):
         w = int(widths[c])
         n_s = cnt[:, c]                                       # rows per shard
@@ -234,16 +233,18 @@ def _build_shard_bucket_plan(deg, send_pad, counts, chunk_size, d):
         offs = np.arange(w, dtype=np.int64)[None, None, :]
         idx = ptr_r[..., None] + offs                         # [d, n_c, w]
         valid = offs < deg_r[..., None]
-        gathered = np.take_along_axis(
-            send_pad, np.minimum(idx, max_idx).reshape(d, -1), 1
-        ).reshape(d, n_c, w)
+        flat_idx = np.minimum(idx, max_idx).reshape(d, -1)
+        gathered = np.take_along_axis(send_pad, flat_idx, 1).reshape(d, n_c, w)
         send_c = np.where(valid, gathered, sentinel_send).astype(np.int32)
         # Padding rows get DISTINCT out-of-range targets (chunk_size + j):
         # mode="drop" discards them, and unique_indices=True stays honest.
         tgt_c = np.where(row_valid, rows, chunk_size + j).astype(np.int32)
         bucket_send.append(send_c)
         bucket_target.append(tgt_c)
-    return tuple(bucket_send), tuple(bucket_target)
+        if w_pad is not None:
+            wg = np.take_along_axis(w_pad, flat_idx, 1).reshape(d, n_c, w)
+            bucket_weight.append(np.where(valid, wg, 0.0).astype(np.float32))
+    return tuple(bucket_send), tuple(bucket_target), tuple(bucket_weight)
 
 
 def shard_graph_arrays(sg: ShardedGraph, mesh, lpa_only: bool = False) -> ShardedGraph:
@@ -272,6 +273,7 @@ def shard_graph_arrays(sg: ShardedGraph, mesh, lpa_only: bool = False) -> Sharde
         bucket_send=tuple(jax.device_put(b, spec3) for b in sg.bucket_send),
         bucket_target=tuple(jax.device_put(t, spec) for t in sg.bucket_target),
         msg_weight=None if sg.msg_weight is None else jax.device_put(sg.msg_weight, spec),
+        bucket_weight=tuple(jax.device_put(b, spec3) for b in sg.bucket_weight),
     )
 
 
@@ -309,7 +311,8 @@ def _lpa_shard_body(labels_full, recv_local, send, deg, weight, *, chunk_size, a
 
 
 def _lpa_shard_body_bucketed(
-    labels_full, bucket_send, bucket_target, *, chunk_size, axes
+    labels_full, bucket_send, bucket_target, bucket_weight=None, *,
+    chunk_size, axes
 ):
     """Fast LPA shard body: degree-bucketed dense mode per shard.
 
@@ -318,20 +321,27 @@ def _lpa_shard_body_bucketed(
     bucketed plan (see ops/bucketed_mode.py — gather-bound analysis).
     Padding rows gather the sentinel label and scatter to index
     ``chunk_size``, which ``mode="drop"`` discards; vertices with no
-    messages are in no bucket and keep their label.
+    messages are in no bucket and keep their label. ``bucket_weight``
+    (r2): slot-aligned weights switch the row modes to weighted argmax.
     """
-    from graphmine_tpu.ops.bucketed_mode import _SENTINEL, _bucket_mode
+    from graphmine_tpu.ops.bucketed_mode import (
+        _SENTINEL,
+        _bucket_mode,
+        _bucket_wmode,
+    )
 
     lbl_pad = jnp.concatenate(
         [labels_full, jnp.full((1,), _SENTINEL, jnp.int32)]
     )
     start = lax.axis_index(axes).astype(jnp.int32) * chunk_size
     own = lax.dynamic_slice(labels_full, (start,), (chunk_size,))
-    for sidx, tgt in zip(bucket_send, bucket_target):
+    wmats = bucket_weight or (None,) * len(bucket_send)
+    for sidx, tgt, wmat in zip(bucket_send, bucket_target, wmats):
         mat = lbl_pad[sidx[0]]
-        own = own.at[tgt[0]].set(
-            _bucket_mode(mat), unique_indices=True, mode="drop"
+        mode = (
+            _bucket_mode(mat) if wmat is None else _bucket_wmode(mat, wmat[0])
         )
+        own = own.at[tgt[0]].set(mode, unique_indices=True, mode="drop")
     return lax.all_gather(own.astype(jnp.int32), axes, tiled=True)
 
 
@@ -397,24 +407,25 @@ def sharded_label_propagation(
     axes = _vertex_axes(mesh)
     rep = P()
     if sg.bucket_send:
-        if sg.msg_weight is not None:
-            raise ValueError(
-                "the bucketed shard body computes unweighted modes but this "
-                "graph carries msg_weight; partition with "
-                "build_bucket_plan=False for weighted LPA"
-            )
-        # Fast path: stacked degree-bucket plan (built by partition_graph).
+        # Fast path: stacked degree-bucket plan (built by partition_graph);
+        # weighted graphs carry slot-aligned bucket_weight matrices (r2).
         n = len(sg.bucket_send)
+        nw = len(sg.bucket_weight)
         body = jax.shard_map(
             partial(_lpa_shard_body_bucketed, chunk_size=sg.chunk_size, axes=axes),
             mesh=mesh,
-            in_specs=(rep, (P(axes, None, None),) * n, (P(axes, None),) * n),
+            in_specs=(
+                rep,
+                (P(axes, None, None),) * n,
+                (P(axes, None),) * n,
+                (P(axes, None, None),) * nw,
+            ),
             out_specs=rep,
             # The output is a tiled all_gather — replicated by construction,
             # which the vma checker cannot infer statically.
             check_vma=False,
         )
-        step = lambda l: body(l, sg.bucket_send, sg.bucket_target)
+        step = lambda l: body(l, sg.bucket_send, sg.bucket_target, sg.bucket_weight)
     else:
         in_specs, _ = _shard_specs(mesh)
         data_spec = P(axes, None)

@@ -16,13 +16,27 @@ class GraphFramesUnavailable(RuntimeError):
     pass
 
 
+# The bridge materializes per-row Python lists on the driver, exactly the
+# scaling cliff the reference hits (Graphframes.py:100-118). It exists for
+# small-graph cross-validation only; refuse anything bigger.
+MAX_BRIDGE_EDGES = 5_000_000
+
+
 def lpa_graphframes(edge_table, max_iter: int) -> np.ndarray:
     """Run labelPropagation via GraphFrames (reference engine, Graphframes.py:78-81).
 
     Returns int labels aligned to the edge table's dense vertex ids.
     Raises :class:`GraphFramesUnavailable` when pyspark/graphframes are not
-    installed (they are not part of this environment).
+    installed (they are not part of this environment), and ``ValueError``
+    beyond :data:`MAX_BRIDGE_EDGES` — the driver-side row lists below
+    would OOM like the reference does; the jax backend is the scale path.
     """
+    if edge_table.num_edges > MAX_BRIDGE_EDGES:
+        raise ValueError(
+            f"graphframes bridge is capped at {MAX_BRIDGE_EDGES:,} edges "
+            f"(got {edge_table.num_edges:,}): it collects driver-side row "
+            "lists; use backend='jax' at scale"
+        )
     try:
         import pyspark  # noqa: F401
         from graphframes import GraphFrame  # noqa: F401

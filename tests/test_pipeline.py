@@ -122,6 +122,19 @@ def test_graphframes_backend_gated(bundled_edges):
         lpa_graphframes(bundled_edges, 5)
 
 
+def test_graphframes_bridge_edge_cap():
+    """The legacy bridge refuses graphs that would OOM its driver-side row
+    lists (the reference's own cliff, Graphframes.py:100-118) — before
+    touching pyspark, so the guard holds in any environment."""
+    from graphmine_tpu.io.edges import from_arrays
+    from graphmine_tpu.pipeline.backends import MAX_BRIDGE_EDGES, lpa_graphframes
+
+    n = MAX_BRIDGE_EDGES + 1
+    big = from_arrays(np.zeros(n, np.int32), np.ones(n, np.int32))
+    with pytest.raises(ValueError, match="capped"):
+        lpa_graphframes(big, 5)
+
+
 def test_orbax_checkpoint_roundtrip(tmp_path):
     from graphmine_tpu.pipeline.checkpoint import load_sharded, save_sharded
 

@@ -248,10 +248,23 @@ def test_weighted_sharded_lpa_matches_single_device(mesh8):
     got = np.asarray(sharded_label_propagation(sg, mesh8, max_iter=4))
     np.testing.assert_array_equal(want, got)
 
-    with pytest.raises(ValueError, match="unweighted"):
-        partition_graph(g, mesh=mesh8, build_bucket_plan=True)
-    with pytest.raises(NotImplementedError, match="unweighted"):
-        ring_label_propagation(sg, mesh8, max_iter=2)
+    # r2: the ring schedule handles weights (weights are shard-local;
+    # only labels travel the ring)
+    ring = np.asarray(ring_label_propagation(sg, mesh8, max_iter=4))
+    np.testing.assert_array_equal(want, ring)
+
+    # r2: the bucketed shard body handles weights too. Exact weights
+    # (multiples of 1/4) so the bucketed kernel's different summation
+    # order can't produce near-tie rounding differences vs the sort body.
+    w_x = (rng.integers(1, 16, e) / 4.0).astype(np.float32)
+    g_x = build_graph(src, dst, num_vertices=v, edge_weights=w_x)
+    want_x = np.asarray(label_propagation(g_x, max_iter=4, plan=None))
+    sg_x = shard_graph_arrays(
+        partition_graph(g_x, mesh=mesh8, build_bucket_plan=True), mesh8
+    )
+    assert sg_x.bucket_weight
+    got_x = np.asarray(sharded_label_propagation(sg_x, mesh8, max_iter=4))
+    np.testing.assert_array_equal(want_x, got_x)
 
 
 def test_bucket_plan_matches_class_rows_reference():
