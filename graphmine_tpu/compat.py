@@ -1040,9 +1040,14 @@ class GraphFrame:
         """GraphFrames ``bfs``: SQL expression strings (or boolean masks)
         select the endpoint sets; returns the paths DataFrame with columns
         ``from, e0, v1, e1, ..., to`` — vertex cells hold the vertex id,
-        edge cells ``(src_id, dst_id)`` pairs."""
+        edge cells ``(src_id, dst_id)`` pairs. ``edgeFilter``: SQL
+        expression (or mask) over the edge columns (id-valued ``src``/
+        ``dst``, GraphFrames semantics) restricting traversable edges;
+        the vertex set is unchanged."""
         if edgeFilter is not None:
-            raise NotImplementedError("bfs edgeFilter is not supported")
+            return self.filterEdges(edgeFilter).bfs(
+                fromExpr, toExpr, maxPathLength=maxPathLength
+            )
         from graphmine_tpu.ops.paths import bfs as _bfs
 
         src_ids = np.flatnonzero(self._vertex_sql_mask(fromExpr))

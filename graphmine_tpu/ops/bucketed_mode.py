@@ -80,26 +80,31 @@ def _gather_rows_device(send, starts, degs, w: int, fill: int):
 
 
 def _class_rows(ptr, deg, eligible, classes, c, w, values, fill, num_values,
-                out_dtype=np.int32):
+                out_dtype=np.int32, weight_values=None):
     """Rows and padded [n, w] gather matrix for one width class (host).
 
     The single source of truth for bucket-row construction, shared by
     :meth:`BucketedModePlan.from_ptr` and the sharded plan builder
     (``parallel/sharded.py``) so the two stay semantically identical.
     ``values=None`` emits message *indices* (non-fused plans); otherwise
-    ``values`` is gathered (fused plans: sender ids, or — with
-    ``out_dtype=float32`` — per-message weights). Padding slots get
-    ``fill``.
+    ``values`` is gathered (fused plans: sender ids). Padding slots get
+    ``fill``. ``weight_values``: optional per-message weights gathered
+    through the SAME idx/valid in the same pass (padding 0) — returns a
+    third float32 matrix, avoiding a second full construction.
     """
     rows = np.nonzero((classes == c) & eligible)[0]
     offs = np.arange(w, dtype=np.int64)[None, :]
     idx = ptr[rows][:, None] + offs
     valid = offs < deg[rows][:, None]
+    safe = np.minimum(idx, max(num_values - 1, 0))
     if values is None:
         mat = np.where(valid, idx, fill)
     else:
-        mat = np.where(valid, values[np.minimum(idx, max(num_values - 1, 0))], fill)
-    return rows, mat.astype(out_dtype)
+        mat = np.where(valid, values[safe], fill)
+    if weight_values is None:
+        return rows, mat.astype(out_dtype)
+    wmat = np.where(valid, weight_values[safe], 0.0).astype(np.float32)
+    return rows, mat.astype(out_dtype), wmat
 
 
 @jax.tree_util.register_dataclass
@@ -218,19 +223,20 @@ class BucketedModePlan:
                     int(widths[c]), num_vertices,
                 )
                 ids = rows
+            elif weights_sorted is not None:
+                ids, mat, wmat = _class_rows(
+                    ptr, deg, bucketed, classes, c, int(widths[c]),
+                    send_sorted, num_vertices if send_sorted is not None else m, m,
+                    weight_values=np.asarray(weights_sorted, np.float32),
+                )
+                mat = jnp.asarray(mat)
+                weight_mat.append(jnp.asarray(wmat))
             else:
                 ids, mat = _class_rows(
                     ptr, deg, bucketed, classes, c, int(widths[c]),
                     send_sorted, num_vertices if send_sorted is not None else m, m,
                 )
                 mat = jnp.asarray(mat)
-            if weights_sorted is not None:
-                _, wmat = _class_rows(
-                    ptr, deg, bucketed, classes, c, int(widths[c]),
-                    np.asarray(weights_sorted, np.float32), 0.0, m,
-                    out_dtype=np.float32,
-                )
-                weight_mat.append(jnp.asarray(wmat))
             vertex_ids.append(jnp.asarray(ids.astype(np.int32)))
             (msg_idx if send_sorted is None else send_idx).append(mat)
 
@@ -359,21 +365,26 @@ def _rowwise_wmode(lbl: jax.Array, wgt: jax.Array) -> jax.Array:
     sums, ties toward the smallest label. Sentinel slots carry weight 0
     and are excluded. Weights must be non-negative (LPA weights are): a
     run's within-run cumulative sums then never exceed its total, so the
-    global max of the cumulative scan is always attained at a run end."""
+    global max of the scan is always attained at a run end.
+
+    Per-run sums come from a SEGMENTED scan (reset at run boundaries),
+    not differences of a row-wide cumsum: at wide rows the row prefix
+    reaches magnitudes where float32 ulp exceeds small weight gaps, and
+    total-as-difference misranks labels (the same corruption
+    ``segment.py:_segment_mode_weighted`` documents and avoids)."""
     order = jnp.argsort(lbl, axis=1)
     s = jnp.take_along_axis(lbl, order, axis=1)
     ws = jnp.take_along_axis(jnp.where(lbl == _SENTINEL, 0.0, wgt), order, axis=1)
-    w = s.shape[1]
-    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
     new_run = jnp.concatenate(
         [jnp.ones((s.shape[0], 1), jnp.bool_), s[:, 1:] != s[:, :-1]], axis=1
     )
-    run_start = lax.cummax(jnp.where(new_run, pos, -1), axis=1)
-    prefix = jnp.cumsum(ws, axis=1)
-    before = jnp.take_along_axis(
-        prefix, jnp.maximum(run_start - 1, 0), axis=1
-    )
-    score = prefix - jnp.where(run_start > 0, before, 0.0)  # cumweight in run
+
+    def _seg_comb(a, b):
+        af, av = a
+        bf, bv = b
+        return af | bf, jnp.where(bf, bv, av + bv)
+
+    _, score = lax.associative_scan(_seg_comb, (new_run, ws), axis=1)
     score = jnp.where(s == _SENTINEL, -1.0, score)
     best = score.max(axis=1)
     cand = jnp.where(score == best[:, None], s, _SENTINEL)

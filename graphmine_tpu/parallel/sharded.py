@@ -272,7 +272,9 @@ def shard_graph_arrays(sg: ShardedGraph, mesh, lpa_only: bool = False) -> Sharde
         num_shards=sg.num_shards,
         bucket_send=tuple(jax.device_put(b, spec3) for b in sg.bucket_send),
         bucket_target=tuple(jax.device_put(t, spec) for t in sg.bucket_target),
-        msg_weight=None if sg.msg_weight is None else jax.device_put(sg.msg_weight, spec),
+        # msg_weight is a sort-body array too (the bucketed body reads
+        # bucket_weight) — drop it under lpa_only like the rest.
+        msg_weight=None if sg.msg_weight is None else place(sg.msg_weight, spec),
         bucket_weight=tuple(jax.device_put(b, spec3) for b in sg.bucket_weight),
     )
 

@@ -220,6 +220,22 @@ def test_bfs_sql_expressions_paths_dataframe(shim):
     assert z.collect()[0]["from"] == "c" and z.collect()[0]["to"] == "c"
 
 
+def test_bfs_edge_filter_restricts_traversal(shim):
+    """GraphFrames ``bfs(edgeFilter=...)``: only edges satisfying the SQL
+    predicate are traversable; the vertex set is unchanged (was a
+    NotImplementedError through r1)."""
+    g = graph_with_attrs(shim)
+    # a->b->c->d exists, but c->d has rel='g': filtering to rel='f' cuts it
+    assert g.bfs("id = 'a'", "id = 'd'").count() > 0
+    assert g.bfs("id = 'a'", "id = 'd'", edgeFilter="rel = 'f'").count() == 0
+    # a->b->c survives the filter
+    paths = g.bfs("id = 'a'", "id = 'c'", edgeFilter="rel = 'f'")
+    row = paths.collect()[0]
+    assert row["from"] == "a" and row["to"] == "c" and row["v1"] == "b"
+    # predicates see id-valued src/dst (GraphFrames semantics)
+    assert g.bfs("id = 'a'", "id = 'e'", edgeFilter="dst != 'e'").count() == 0
+
+
 def test_find_motifs_dataframe(shim):
     g = graph_with_attrs(shim)
     m = g.find("(x)-[e]->(y); (y)-[]->(z)")

@@ -2,8 +2,10 @@
 
 The framework has four LPA execution paths (sort-based superstep, fused
 bucketed kernel, vertex-range-sharded shard_map — sort and bucketed
-bodies — and the ppermute ring schedule) and three CC paths. Synchronous
-semantics are deterministic, so on ANY graph they must agree bit-for-bit.
+bodies — and the ppermute ring schedule) — each in unweighted AND
+weighted (r2) form — and three CC paths. Synchronous semantics are
+deterministic, so on ANY graph they must agree bit-for-bit (weighted:
+with exactly-representable weights, so summation order can't round).
 This sweep hammers that invariant across random graph shapes: sparse,
 dense, star-heavy (histogram hubs), self-loops, duplicates, isolates.
 """
@@ -62,6 +64,59 @@ def test_all_lpa_paths_agree(case, mesh8):
     want = np.asarray(label_propagation(g, max_iter=4, plan=None))
 
     g2, plan = build_graph_and_plan(src, dst, num_vertices=v)
+    lbl = jnp.arange(v, dtype=jnp.int32)
+    step = jax.jit(lpa_superstep_bucketed)
+    for _ in range(4):
+        lbl = step(lbl, g2, plan)
+    np.testing.assert_array_equal(want, np.asarray(lbl), err_msg="fused bucketed")
+
+    sg_fast = shard_graph_arrays(
+        partition_graph(g, mesh=mesh8, build_bucket_plan=True), mesh8
+    )
+    np.testing.assert_array_equal(
+        want,
+        np.asarray(sharded_label_propagation(sg_fast, mesh8, max_iter=4)),
+        err_msg="sharded bucketed",
+    )
+    sg = shard_graph_arrays(partition_graph(g, mesh=mesh8), mesh8)
+    np.testing.assert_array_equal(
+        want,
+        np.asarray(sharded_label_propagation(sg, mesh8, max_iter=4)),
+        err_msg="sharded sort",
+    )
+    np.testing.assert_array_equal(
+        want,
+        np.asarray(ring_label_propagation(sg, mesh8, max_iter=4)),
+        err_msg="ring",
+    )
+
+
+@pytest.mark.parametrize("case", range(6))
+def test_all_weighted_lpa_paths_agree(case, mesh8):
+    """r2: weighted LPA has the same four execution paths; same one-answer
+    invariant. Weights are multiples of 1/4 so per-label sums are exact in
+    float32 under every path's summation order."""
+    import jax
+    import jax.numpy as jnp
+
+    from graphmine_tpu.ops.bucketed_mode import (
+        build_graph_and_plan,
+        lpa_superstep_bucketed,
+    )
+    from graphmine_tpu.parallel.ring import ring_label_propagation
+    from graphmine_tpu.parallel.sharded import (
+        partition_graph,
+        shard_graph_arrays,
+        sharded_label_propagation,
+    )
+
+    src, dst, v = _graphs()[case]
+    rng = np.random.default_rng(1000 + case)
+    w = (rng.integers(1, 16, len(src)) / 4.0).astype(np.float32)
+    g = build_graph(src, dst, num_vertices=v, edge_weights=w)
+    want = np.asarray(label_propagation(g, max_iter=4, plan=None))
+
+    g2, plan = build_graph_and_plan(src, dst, num_vertices=v, edge_weights=w)
     lbl = jnp.arange(v, dtype=jnp.int32)
     step = jax.jit(lpa_superstep_bucketed)
     for _ in range(4):
