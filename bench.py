@@ -378,24 +378,35 @@ def main_quality() -> None:
     )
 
 
-def main() -> None:
+def _run_chip_tier(weighted: bool) -> None:
+    """Shared chip-tier measurement: fused-kernel LPA supersteps on the
+    standard power-law graph, one timing path for the unweighted and
+    weighted (r2) metrics. Same graph/size either way so the weighted/
+    unweighted cost ratio is directly readable."""
     import jax
     import jax.numpy as jnp
 
     build_graph_and_plan, lpa_superstep_bucketed = _setup_jax_cache()
 
     src, dst = powerlaw_edges(NUM_VERTICES, NUM_EDGES)
+    w = None
+    if weighted:
+        # Quarters: exactly representable, sums exact in float32 — the
+        # same convention the weighted parity tests use.
+        rng = np.random.default_rng(7)
+        w = (rng.integers(1, 16, NUM_EDGES) / 4.0).astype(np.float32)
     # Fused degree-bucketed kernel (ops/bucketed_mode.py): ~3x the sort-
     # based superstep at this scale, bit-identical labels (tested). Graph
     # and plan share one host message-CSR build (native counting sort).
-    graph, plan = build_graph_and_plan(src, dst, num_vertices=NUM_VERTICES)
+    graph, plan = build_graph_and_plan(
+        src, dst, num_vertices=NUM_VERTICES, edge_weights=w
+    )
 
     # Compile a single superstep once; the timed loop feeds labels back so
     # every iteration computes on fresh data (steady-state throughput).
     raw_step = jax.jit(lpa_superstep_bucketed)
-    step = lambda lbl, g: raw_step(lbl, g, plan)
-    labels = jnp.arange(NUM_VERTICES, dtype=jnp.int32)
-    labels = step(labels, graph)
+    step = lambda lbl: raw_step(lbl, graph, plan)
+    labels = step(jnp.arange(NUM_VERTICES, dtype=jnp.int32))
     np.asarray(labels[:8])
 
     # Completion signal: a tiny device->host fetch of a slice that depends
@@ -404,9 +415,10 @@ def main() -> None:
     # finished (33us/iter for a 16M-element sort loop — physically
     # impossible); a data fetch cannot be early. The 32-byte transfer adds
     # negligible time to the window.
+    labels = jnp.arange(NUM_VERTICES, dtype=jnp.int32)
     t0 = time.perf_counter()
     for _ in range(ITERS):
-        labels = step(labels, graph)
+        labels = step(labels)
     np.asarray(labels[:8])
     dt = time.perf_counter() - t0
 
@@ -414,12 +426,13 @@ def main() -> None:
     # device count would understate the per-chip number on multi-chip hosts.
     chips = 1
     eps_chip = NUM_EDGES * ITERS / dt / chips
+    prefix = "weighted_lpa" if weighted else "lpa"
     print(
         json.dumps(
             {
                 "metric": (
-                    "lpa_edges_per_sec_cpu_fallback"
-                    if _CPU_FALLBACK else "lpa_edges_per_sec_per_chip"
+                    f"{prefix}_edges_per_sec_cpu_fallback"
+                    if _CPU_FALLBACK else f"{prefix}_edges_per_sec_per_chip"
                 ),
                 "value": round(eps_chip),
                 "unit": "edges/s" if _CPU_FALLBACK else "edges/s/chip",
@@ -438,6 +451,16 @@ def main() -> None:
             }
         )
     )
+
+
+def main() -> None:
+    _run_chip_tier(weighted=False)
+
+
+def main_weighted() -> None:
+    """Weighted-LPA throughput (r2: weighted rides the fused bucketed
+    kernel — argmax of per-label weight sums)."""
+    _run_chip_tier(weighted=True)
 
 
 # ---------------------------------------------------------------------------
@@ -463,6 +486,7 @@ _CHILD_TIMEOUT_S = {
     "lof": 1200.0,
     "snap": 2400.0,
     "quality": 1200.0,
+    "weighted": 900.0,
 }
 
 
@@ -660,7 +684,7 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--tier",
-        choices=["chip", "northstar", "lof", "snap", "quality"],
+        choices=["chip", "northstar", "lof", "snap", "quality", "weighted"],
         default="chip",
     )
     args = ap.parse_args()
@@ -670,6 +694,7 @@ if __name__ == "__main__":
         "lof": main_lof,
         "snap": main_snap,
         "quality": main_quality,
+        "weighted": main_weighted,
     }
     if os.environ.get("_GRAPHMINE_BENCH_CHILD") == "1":
         _TIERS[args.tier]()
