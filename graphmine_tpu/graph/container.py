@@ -189,8 +189,13 @@ def _graph_from_csr(
 
 
 def graph_from_edge_table(table, symmetric: bool = True) -> Graph:
-    """Build a graph from an :class:`graphmine_tpu.io.edges.EdgeTable`."""
-    return build_graph(table.src, table.dst, num_vertices=table.num_vertices, symmetric=symmetric)
+    """Build a graph from an :class:`graphmine_tpu.io.edges.EdgeTable`;
+    the table's optional per-edge ``weights`` carry through to weighted
+    message flow (``load_edge_list(weight_col=...)``)."""
+    return build_graph(
+        table.src, table.dst, num_vertices=table.num_vertices,
+        symmetric=symmetric, edge_weights=getattr(table, "weights", None),
+    )
 
 
 def simple_undirected_edges(graph: Graph) -> tuple[np.ndarray, np.ndarray]:

@@ -34,6 +34,7 @@ class EdgeTable:
     dst: np.ndarray  # int32 [E] — ChildDomain index
     names: np.ndarray  # str [V] — vertex id -> domain string
     num_rows_raw: int = 0  # rows before the null filter (Graphframes.py:18)
+    weights: np.ndarray | None = None  # float32 [E] — optional edge weights
 
     @property
     def num_vertices(self) -> int:
@@ -139,14 +140,20 @@ def _resolve_paths(path: str) -> list[str]:
     return paths
 
 
-def load_edge_list(path: str, comments: str = "#", use_native: bool = True) -> EdgeTable:
-    """Load a SNAP-style whitespace edge list (``src dst`` per line).
+def load_edge_list(path: str, comments: str = "#", use_native: bool = True,
+                   weight_col: int | None = None) -> EdgeTable:
+    """Load a SNAP-style whitespace edge list (``src dst [weight ...]``).
 
     IDs may be arbitrary integers or strings; they are densified to int32.
     Uses the native C++ parser (:mod:`graphmine_tpu.io.native`) when built,
     falling back to NumPy.
+
+    ``weight_col``: 0-based column index holding a per-edge float weight
+    (the common 3-column weighted edge-list format uses ``weight_col=2``).
+    Weighted parses take the NumPy path; weights feed weighted LPA via
+    ``build_graph(edge_weights=...)`` / ``graph_from_edge_table``.
     """
-    if use_native:
+    if use_native and weight_col is None:
         from graphmine_tpu.io import native
 
         et = native.load_edge_list_native(path, comments=comments)
@@ -155,8 +162,18 @@ def load_edge_list(path: str, comments: str = "#", use_native: bool = True) -> E
     raw = np.loadtxt(path, comments=comments, dtype=str, ndmin=2)
     if raw.shape[1] < 2:
         raise ValueError(f"edge list {path!r} needs >= 2 columns")
+    weights = None
+    if weight_col is not None:
+        if weight_col < 2 or weight_col >= raw.shape[1]:
+            raise ValueError(
+                f"weight_col={weight_col} out of range for a "
+                f"{raw.shape[1]}-column edge list (and columns 0-1 are the "
+                "endpoints)"
+            )
+        weights = raw[:, weight_col].astype(np.float32)
     (src, dst), names = factorize(raw[:, 0], raw[:, 1])
-    return EdgeTable(src=src, dst=dst, names=names, num_rows_raw=len(raw))
+    return EdgeTable(src=src, dst=dst, names=names, num_rows_raw=len(raw),
+                     weights=weights)
 
 
 def from_arrays(src, dst, names=None) -> EdgeTable:

@@ -15,20 +15,25 @@ import os
 import numpy as np
 
 
-def graph_fingerprint(src, dst) -> str:
-    """Content hash of the int edge arrays — the id-assignment identity.
+def graph_fingerprint(src, dst, weights=None) -> str:
+    """Content hash of the edge arrays — the id-assignment identity.
 
     Labels index vertices by the ids the loader assigned; any change to
     the data OR to id-assignment order (e.g. bulk vs ``batch_rows``
     streaming ingestion, which documents different id orders) changes
     this fingerprint, so a stale checkpoint cannot silently relabel a
-    permuted graph.
+    permuted graph. ``weights`` participate too: weighted and unweighted
+    dynamics over the same topology follow different label trajectories,
+    so their checkpoints must not be interchangeable.
     """
     import hashlib
 
     h = hashlib.sha1()
     h.update(np.ascontiguousarray(np.asarray(src, np.int32)).tobytes())
     h.update(np.ascontiguousarray(np.asarray(dst, np.int32)).tobytes())
+    if weights is not None:
+        h.update(b"w")
+        h.update(np.ascontiguousarray(np.asarray(weights, np.float32)).tobytes())
     return h.hexdigest()
 
 

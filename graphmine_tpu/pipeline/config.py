@@ -19,6 +19,9 @@ class PipelineConfig:
     data_path: str = "/root/reference/CommunityDetection/data/outlinks_pq"
     data_format: str = "parquet"  # parquet | edgelist
     batch_rows: int | None = None  # parquet only: stream in bounded batches
+    # edgelist only: 0-based column holding a per-edge float weight
+    # (weighted LPA: mode = argmax of incoming weight sums).
+    edge_weight_col: int | None = None
     # engine (the plugin boundary from BASELINE.json)
     backend: str = "jax"  # jax | graphframes
     num_devices: int | None = None  # None = all visible (local[*] parity, :12)
@@ -71,6 +74,13 @@ class PipelineConfig:
             raise ValueError("batch_rows must be positive")
         if self.batch_rows is not None and self.data_format != "parquet":
             raise ValueError("batch_rows applies to parquet input only")
+        if self.edge_weight_col is not None and self.data_format != "edgelist":
+            raise ValueError("edge_weight_col applies to edgelist input only")
+        if self.edge_weight_col is not None and self.backend == "graphframes":
+            raise ValueError(
+                "backend='graphframes' runs unweighted labelPropagation; "
+                "use backend='jax' for weighted LPA"
+            )
         if not 0 < self.decile < 1:
             raise ValueError("decile must be in (0, 1)")
         return self

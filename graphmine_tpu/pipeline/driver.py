@@ -54,7 +54,9 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
         if config.data_format == "parquet":
             table = load_parquet_edges(config.data_path, batch_rows=config.batch_rows)
         else:
-            table = load_edge_list(config.data_path)
+            table = load_edge_list(
+                config.data_path, weight_col=config.edge_weight_col
+            )
     m.emit(
         "counts",  # parity with the prints at Graphframes.py:18 and :54
         rows_raw=table.num_rows_raw,
@@ -79,7 +81,8 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
             from graphmine_tpu.ops.bucketed_mode import build_graph_and_plan
 
             graph, mode_plan = build_graph_and_plan(
-                table.src, table.dst, num_vertices=table.num_vertices
+                table.src, table.dst, num_vertices=table.num_vertices,
+                edge_weights=table.weights,
             )
         else:
             graph = graph_from_edge_table(table)
@@ -181,10 +184,12 @@ def _run_lpa(
     start_iter = 0
     labels = jnp.arange(graph.num_vertices, dtype=jnp.int32)
 
-    # One O(E) hash per run; ties every checkpoint to this exact graph and
-    # id assignment (bulk vs batch_rows ingestion assign different ids).
+    # One O(E) hash per run; ties every checkpoint to this exact graph,
+    # id assignment (bulk vs batch_rows ingestion assign different ids),
+    # and edge weights (weighted/unweighted trajectories differ).
     fingerprint = (
-        ckpt.graph_fingerprint(table.src, table.dst) if config.checkpoint_dir else None
+        ckpt.graph_fingerprint(table.src, table.dst, table.weights)
+        if config.checkpoint_dir else None
     )
 
     if config.resume and config.checkpoint_dir:

@@ -81,3 +81,35 @@ def test_streaming_parquet_matches_bulk():
     import pytest
     with pytest.raises(ValueError, match="positive"):
         load_parquet_edges(REFERENCE_PARQUET, batch_rows=0)
+
+
+def test_weighted_edge_list_loading(tmp_path):
+    """r2: 3-column weighted edge lists (`src dst weight`) load via
+    weight_col and feed weighted LPA end-to-end."""
+    import pytest
+
+    from graphmine_tpu.graph.container import graph_from_edge_table
+    from graphmine_tpu.io.edges import load_edge_list
+    from graphmine_tpu.ops.lpa import label_propagation
+
+    p = tmp_path / "weighted.txt"
+    # vertex c hears a (weight 1) and b (weight 8): b must win the mode
+    p.write_text("# comment line\na c 1.0\nb c 8.0\na b 0.5\n")
+    et = load_edge_list(str(p), weight_col=2)
+    assert et.weights is not None and et.weights.dtype == np.float32
+    np.testing.assert_allclose(et.weights, [1.0, 8.0, 0.5])
+
+    g = graph_from_edge_table(et)
+    assert g.msg_weight is not None
+    labels = np.asarray(label_propagation(g, max_iter=1))
+    b, c = [int(np.flatnonzero(et.names == n)[0]) for n in ("b", "c")]
+    assert labels[c] == b  # weight 8 beats weight 1
+
+    # unweighted parse of the same file ignores the column
+    et_u = load_edge_list(str(p))
+    assert et_u.weights is None and et_u.num_edges == 3
+
+    with pytest.raises(ValueError, match="weight_col"):
+        load_edge_list(str(p), weight_col=5)
+    with pytest.raises(ValueError, match="weight_col"):
+        load_edge_list(str(p), weight_col=1)
