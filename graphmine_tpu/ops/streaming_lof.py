@@ -125,6 +125,17 @@ class StreamingLOF:
     def fitted(self) -> bool:
         return self._model is not None
 
+    def sync(self) -> None:
+        """Block until the most recent re-fit has completed on device.
+
+        ``update`` blocks on the chunk's *scores* (host fetch) but
+        dispatches the window re-fit asynchronously — its cost is normally
+        absorbed by the next chunk's scoring. Call this after the last
+        chunk when measuring throughput, so the final fit's device time is
+        inside the timed window."""
+        if self._model is not None:
+            jax.block_until_ready(self._model)
+
     def update(self, chunk) -> np.ndarray:
         """Score ``chunk`` against the window, then admit it and re-fit.
 
