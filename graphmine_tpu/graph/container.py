@@ -91,22 +91,24 @@ def message_ptr(
 def _message_csr(src, dst, num_vertices, symmetric, use_native=True, weights=None):
     """(ptr int64 [V+1], recv_sorted, send_sorted int32 [M], w_sorted|None)
     — messages grouped by receiver, stable order. Native counting sort when
-    available; a weight payload rides the NumPy sort path (both directions
-    of an edge carry its weight)."""
+    available (incl. the weighted build since r2); both directions of an
+    edge carry its weight."""
     if len(src) and (
         min(src.min(), dst.min()) < 0
         or max(src.max(), dst.max()) >= num_vertices
     ):
         raise ValueError(f"edge endpoint out of range [0, {num_vertices})")
-    if use_native and weights is None:
+    if use_native:
         from graphmine_tpu.io import native
 
-        out = native.build_message_csr(src, dst, num_vertices, symmetric)
+        out = native.build_message_csr(
+            src, dst, num_vertices, symmetric, weights=weights
+        )
         if out is not None:
-            ptr, recv, send = out
+            ptr, recv, send, w_sorted = out
             if ptr[-1] >= np.iinfo(np.int32).max:
                 raise ValueError("message count exceeds int32; shard the build")
-            return ptr, recv, send, None
+            return ptr, recv, send, w_sorted
     if symmetric:
         recv = np.concatenate([dst, src])
         send = np.concatenate([src, dst])
@@ -136,7 +138,7 @@ def build_graph(
     ``edge_weights``: optional non-negative float [E] per-edge weights;
     both message directions of an edge carry its weight, and weighted LPA
     (:func:`~graphmine_tpu.ops.lpa.label_propagation`) argmaxes weight
-    sums instead of counts. Weight permutation needs the NumPy sort path.
+    sums instead of counts.
     """
     src, dst, num_vertices = _prepare_edges(src, dst, num_vertices)
     w = _prepare_weights(edge_weights, src)

@@ -74,6 +74,21 @@ def _bind(lib: ctypes.CDLL) -> None:
         np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
         np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
     ]
+    if not hasattr(lib, "gb_build_message_csr_weighted"):
+        return
+    lib.gb_build_message_csr_weighted.restype = ctypes.c_int
+    lib.gb_build_message_csr_weighted.argtypes = [
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+    ]
 
 
 def available() -> bool:
@@ -112,18 +127,22 @@ def load_edge_list_native(path: str, comments: str = "#"):
     return EdgeTable(src=src, dst=dst, names=names, num_rows_raw=int(ne))
 
 
-def build_message_csr(src, dst, num_vertices: int, symmetric: bool = True):
+def build_message_csr(src, dst, num_vertices: int, symmetric: bool = True,
+                      weights=None):
     """Native stable counting-sort message-CSR build.
 
     Returns ``(ptr int64 [V+1], recv_sorted int32 [M], send_sorted int32
-    [M])`` matching the NumPy layout in ``container.build_graph`` exactly
-    (asserted by tests), or ``None`` when the library is unavailable.
-    Raises ``ValueError`` on out-of-range endpoints (parity with the
-    bounds implied by ``num_vertices``).
+    [M], w_sorted float32 [M] | None)`` matching the NumPy layout in
+    ``container.build_graph`` exactly (asserted by tests), or ``None``
+    when the library (or, for weighted builds, its weighted entry point)
+    is unavailable. Raises ``ValueError`` on out-of-range endpoints
+    (parity with the bounds implied by ``num_vertices``).
     """
     lib = _lib()
     if lib is None or not hasattr(lib, "gb_build_message_csr"):
         return None
+    if weights is not None and not hasattr(lib, "gb_build_message_csr_weighted"):
+        return None  # stale .so: caller falls back to the NumPy sort
     src = np.ascontiguousarray(src, dtype=np.int32)
     dst = np.ascontiguousarray(dst, dtype=np.int32)
     if src.shape != dst.shape or src.ndim != 1:
@@ -133,9 +152,24 @@ def build_message_csr(src, dst, num_vertices: int, symmetric: bool = True):
     ptr = np.empty(num_vertices + 1, dtype=np.int64)
     recv_sorted = np.empty(max(m, 1), dtype=np.int32)
     send_sorted = np.empty(max(m, 1), dtype=np.int32)
-    rc = lib.gb_build_message_csr(
-        src, dst, e, num_vertices, int(symmetric), ptr, recv_sorted, send_sorted
-    )
+    if weights is None:
+        rc = lib.gb_build_message_csr(
+            src, dst, e, num_vertices, int(symmetric), ptr, recv_sorted,
+            send_sorted,
+        )
+        w_sorted = None
+    else:
+        weights = np.ascontiguousarray(weights, dtype=np.float32)
+        if weights.shape != src.shape:
+            raise ValueError("weights must be one float per edge")
+        w_sorted = np.empty(max(m, 1), dtype=np.float32)
+        rc = lib.gb_build_message_csr_weighted(
+            src, dst, weights, e, num_vertices, int(symmetric), ptr,
+            recv_sorted, send_sorted, w_sorted,
+        )
     if rc != 0:
         raise ValueError("edge endpoint out of range [0, num_vertices)")
-    return ptr, recv_sorted[:m], send_sorted[:m]
+    return (
+        ptr, recv_sorted[:m], send_sorted[:m],
+        None if w_sorted is None else w_sorted[:m],
+    )

@@ -111,18 +111,19 @@ int64_t gb_load_edge_list(const char* path, char comment, int32_t** src_out,
   return ne;
 }
 
-// Builds the message-CSR layout (graphmine_tpu/graph/container.py contract):
-// messages grouped by receiver in stable (input) order; when `symmetric`,
-// messages flow both directions (recv = concat(dst, src), send = the
-// opposite endpoints). A stable counting sort — O(M + V) vs NumPy's
-// O(M log M) argsort, the hot host-side step of every graph build.
-//
-// Caller allocates: ptr[v+1] (int64), recv_sorted[m], send_sorted[m]
-// (int32) where m = symmetric ? 2*e : e. Returns 0, or -1 when an endpoint
-// is out of [0, v) — nothing is written in that case.
-int gb_build_message_csr(const int32_t* src, const int32_t* dst, int64_t e,
-                         int64_t v, int symmetric, int64_t* ptr,
-                         int32_t* recv_sorted, int32_t* send_sorted) {
+namespace {
+
+// Shared body of the message-CSR builders (graphmine_tpu/graph/container.py
+// contract): messages grouped by receiver in stable (input) order; when
+// `symmetric`, messages flow both directions (recv = concat(dst, src),
+// send = the opposite endpoints). A stable counting sort — O(M + V) vs
+// NumPy's O(M log M) argsort, the hot host-side step of every graph build.
+// `weights`/`w_sorted` are nullable: when present, both directions of an
+// edge carry its weight through the same permutation.
+int build_csr_impl(const int32_t* src, const int32_t* dst,
+                   const float* weights, int64_t e, int64_t v, int symmetric,
+                   int64_t* ptr, int32_t* recv_sorted, int32_t* send_sorted,
+                   float* w_sorted) {
   for (int64_t i = 0; i < e; ++i) {
     if (src[i] < 0 || src[i] >= v || dst[i] < 0 || dst[i] >= v) return -1;
   }
@@ -139,15 +140,41 @@ int gb_build_message_csr(const int32_t* src, const int32_t* dst, int64_t e,
     int64_t pos = cursor[static_cast<size_t>(dst[i])]++;
     recv_sorted[pos] = dst[i];
     send_sorted[pos] = src[i];
+    if (weights) w_sorted[pos] = weights[i];
   }
   if (symmetric) {
     for (int64_t i = 0; i < e; ++i) {
       int64_t pos = cursor[static_cast<size_t>(src[i])]++;
       recv_sorted[pos] = src[i];
       send_sorted[pos] = dst[i];
+      if (weights) w_sorted[pos] = weights[i];
     }
   }
   return 0;
+}
+
+}  // namespace
+
+// Caller allocates: ptr[v+1] (int64), recv_sorted[m], send_sorted[m]
+// (int32) where m = symmetric ? 2*e : e. Returns 0, or -1 when an endpoint
+// is out of [0, v) — nothing is written in that case.
+int gb_build_message_csr(const int32_t* src, const int32_t* dst, int64_t e,
+                         int64_t v, int symmetric, int64_t* ptr,
+                         int32_t* recv_sorted, int32_t* send_sorted) {
+  return build_csr_impl(src, dst, nullptr, e, v, symmetric, ptr, recv_sorted,
+                        send_sorted, nullptr);
+}
+
+// Weighted variant of gb_build_message_csr: same layout plus the float32
+// weight payload. A separate entry point keeps the ABI compatible with
+// older libgraphbuild.so builds.
+int gb_build_message_csr_weighted(const int32_t* src, const int32_t* dst,
+                                  const float* weights, int64_t e, int64_t v,
+                                  int symmetric, int64_t* ptr,
+                                  int32_t* recv_sorted, int32_t* send_sorted,
+                                  float* w_sorted) {
+  return build_csr_impl(src, dst, weights, e, v, symmetric, ptr, recv_sorted,
+                        send_sorted, w_sorted);
 }
 
 void gb_free(void* p) { free(p); }

@@ -82,3 +82,31 @@ def test_native_message_csr_matches_numpy():
         np.testing.assert_array_equal(sn, sp)
     with pytest.raises(ValueError):
         native.build_message_csr(np.array([99], np.int32), np.array([0], np.int32), 50)
+
+
+def test_native_weighted_message_csr_matches_numpy():
+    """r2: the weighted build rides the native counting sort too (was
+    NumPy-argsort-only); layout AND weight permutation must match the
+    NumPy path bit-for-bit."""
+    from graphmine_tpu.graph.container import _message_csr
+    from graphmine_tpu.io import native
+
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(5)
+    src = rng.integers(0, 50, 400).astype(np.int32)
+    dst = rng.integers(0, 50, 400).astype(np.int32)
+    w = rng.uniform(0.1, 9.0, 400).astype(np.float32)
+    for sym in (True, False):
+        pn, rn, sn, wn = _message_csr(src, dst, 50, sym, use_native=True, weights=w)
+        pp, rp, sp, wp = _message_csr(src, dst, 50, sym, use_native=False, weights=w)
+        assert wn is not None
+        np.testing.assert_array_equal(pn, pp)
+        np.testing.assert_array_equal(rn, rp)
+        np.testing.assert_array_equal(sn, sp)
+        np.testing.assert_array_equal(wn, wp)
+    with pytest.raises(ValueError):
+        native.build_message_csr(
+            np.array([99], np.int32), np.array([0], np.int32), 50,
+            weights=np.array([1.0], np.float32),
+        )
