@@ -42,13 +42,17 @@ def _on_tpu() -> bool:
         return False
 
 
-def _tiled_knn(queries, refs, k, row_tile, *, exclude_self=False, ref_mask=None):
+def _tiled_knn(queries, refs, k, row_tile, *, exclude_self=False, ref_mask=None,
+               query_ids=None, ref_ids=None):
     """Shared row-tiled distance + top-k core.
 
     ``d2[i, j] = |q_i|^2 - 2 q_i . r_j + |r_j|^2`` — the matmul is the MXU
     op; tiles keep the [N, M] distance matrix from materializing.
     ``exclude_self`` masks the diagonal (queries are the refs);
-    ``ref_mask`` (bool [M]) hides invalid reference slots.
+    ``ref_mask`` (bool [M]) hides invalid reference slots;
+    ``query_ids``/``ref_ids`` (int32 [N]/[M], given together) exclude
+    pairs whose ids match — the ring-sharded path's self-exclusion, where
+    query and reference chunks carry global row ids.
     """
     n, _ = queries.shape
     m = refs.shape[0]
@@ -62,6 +66,10 @@ def _tiled_knn(queries, refs, k, row_tile, *, exclude_self=False, ref_mask=None)
     rows = jnp.pad(queries, ((0, pad), (0, 0))).reshape(n_pad // row_tile, row_tile, -1)
     row_sq = jnp.pad(q_sq, (0, pad)).reshape(n_pad // row_tile, row_tile)
     row_idx = jnp.arange(n_pad, dtype=jnp.int32).reshape(n_pad // row_tile, row_tile)
+    if query_ids is not None:
+        row_idx = jnp.pad(
+            query_ids.astype(jnp.int32), (0, pad), constant_values=-1
+        ).reshape(n_pad // row_tile, row_tile)
     invalid = None if ref_mask is None else ~ref_mask
 
     def tile_knn(args):
@@ -71,6 +79,8 @@ def _tiled_knn(queries, refs, k, row_tile, *, exclude_self=False, ref_mask=None)
         if exclude_self:
             self_mask = tile_ids[:, None] == jnp.arange(m, dtype=jnp.int32)[None, :]
             d2 = jnp.where(self_mask, jnp.inf, d2)
+        if query_ids is not None:
+            d2 = jnp.where(tile_ids[:, None] == ref_ids[None, :], jnp.inf, d2)
         if invalid is not None:
             d2 = jnp.where(invalid[None, :], jnp.inf, d2)
         neg_top, idx = lax.top_k(-d2, k)

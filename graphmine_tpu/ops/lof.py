@@ -43,6 +43,15 @@ def lof_scores(
     going from k=20 to k=100; see ``bench.py --tier lof``).
     """
     d2, idx = knn(points, k=k, row_tile=row_tile, impl=impl)
+    return lof_from_knn(d2, idx, k)
+
+
+def lof_from_knn(d2: jax.Array, idx: jax.Array, k: int) -> jax.Array:
+    """LOF scores from a kNN result (``[N, k]`` squared distances +
+    neighbor indices). Shared by the all-pairs path above and the
+    ring-sharded path (:func:`graphmine_tpu.parallel.knn.sharded_lof`) —
+    the gathers ``kdist[idx]`` / ``lrd[idx]`` are over ``[N]`` vectors, so
+    under GSPMD they cost one small all-gather each."""
     dists = jnp.sqrt(d2)
     pos = dists > 0
     eps = 1e-3 * dists.sum() / jnp.maximum(pos.sum(), 1)

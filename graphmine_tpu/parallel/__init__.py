@@ -1,3 +1,4 @@
+from graphmine_tpu.parallel.knn import sharded_knn, sharded_lof
 from graphmine_tpu.parallel.mesh import initialize_distributed, make_mesh, make_multislice_mesh
 from graphmine_tpu.parallel.ring import (
     ring_connected_components,
@@ -24,4 +25,6 @@ __all__ = [
     "sharded_pagerank",
     "ring_label_propagation",
     "ring_connected_components",
+    "sharded_knn",
+    "sharded_lof",
 ]

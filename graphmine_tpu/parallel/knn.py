@@ -1,0 +1,125 @@
+"""Ring-sharded kNN + LOF over the device mesh.
+
+The north-star outlier path (BASELINE.json: "kNN-graph + LOF ... batched
+all-pairs-distance + top-k") runs single-device in :mod:`ops/knn` — every
+row's distances need every point, so a naive GSPMD partition of the
+all-pairs matmul replicates the full ``[N, F]`` point set per device.
+This module is the memory-scalable design, the same schedule as
+:mod:`parallel/ring`'s LPA: points stay row-sharded, chunks rotate around
+the mesh ring via ``ppermute``, and each device folds the visiting chunk
+into a running top-k for its own rows. Per-device memory is
+O(N/D x (F + k)) plus one visiting chunk — no replicated [N, F] term,
+and each rotation step's distance tile is still one MXU matmul.
+
+Semantics match :func:`graphmine_tpu.ops.knn.knn` (self excluded by
+global id, duplicates kept, squared Euclidean, ascending) — pinned by
+the virtual-mesh parity tests — with one scoped difference: among
+*exactly tied* distances (duplicate points), neighbor order follows the
+ring visit order rather than ascending global index, so tied neighbor
+id lists can differ while the distance lists agree.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from graphmine_tpu.ops.knn import _tiled_knn
+from graphmine_tpu.parallel.mesh import VERTEX_AXIS
+
+
+def _knn_ring_body(pts, *, n: int, k: int, chunk: int, num_shards: int,
+                   row_tile: int):
+    """Per-device ring kNN (runs under shard_map; ``pts`` is this device's
+    ``[chunk, F]`` row slice). Each hop folds the visiting chunk into the
+    running top-k via the shared :func:`ops.knn._tiled_knn` core
+    (id-equality self-exclusion, padding slots masked) and one ``top_k``
+    over ``[chunk, 2k]``; D-1 ppermute hops total."""
+    my = lax.axis_index(VERTEX_AXIS).astype(jnp.int32)
+    local_gid = my * chunk + jnp.arange(chunk, dtype=jnp.int32)
+    best_d = jnp.full((chunk, k), jnp.inf, jnp.float32)
+    best_g = jnp.zeros((chunk, k), jnp.int32)
+    perm = [(i, (i + 1) % num_shards) for i in range(num_shards)]
+    visit = pts
+    for r in range(num_shards):
+        owner = jnp.mod(my - r, num_shards)
+        visit_gid = owner * chunk + jnp.arange(chunk, dtype=jnp.int32)
+        d2, idx = _tiled_knn(
+            pts, visit, k, row_tile,
+            ref_mask=visit_gid < n,
+            query_ids=local_gid, ref_ids=visit_gid,
+        )
+        cat_d = jnp.concatenate([best_d, d2], axis=1)
+        cat_g = jnp.concatenate([best_g, visit_gid[idx]], axis=1)
+        neg, pos = lax.top_k(-cat_d, k)
+        best_d = -neg
+        best_g = jnp.take_along_axis(cat_g, pos, axis=1)
+        if r != num_shards - 1:
+            visit = lax.ppermute(visit, VERTEX_AXIS, perm)
+    return best_d, best_g
+
+
+# One compiled ring program per (mesh, n, k, chunk, row_tile): a fresh
+# jit/shard_map wrapper per call would re-trace the D-unrolled ring on
+# every invocation.
+_BODY_CACHE: dict = {}
+
+
+def _compiled_body(mesh, n: int, k: int, chunk: int, row_tile: int):
+    key = (mesh, n, k, chunk, row_tile)
+    fn = _BODY_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(jax.shard_map(
+            partial(_knn_ring_body, n=n, k=k, chunk=chunk,
+                    num_shards=mesh.size, row_tile=row_tile),
+            mesh=mesh,
+            in_specs=P(VERTEX_AXIS, None),
+            out_specs=(P(VERTEX_AXIS, None), P(VERTEX_AXIS, None)),
+        ))
+        _BODY_CACHE[key] = fn
+    return fn
+
+
+def sharded_knn(points, mesh, k: int, row_tile: int = 1024):
+    """k nearest neighbors with the point set sharded over a 1-D mesh.
+
+    ``points``: host ``[N, F]`` array. Returns ``(d2, idx)`` jax arrays
+    of shape ``[N, k]``, vertex-range sharded over the mesh — same
+    contract as :func:`graphmine_tpu.ops.knn.knn` (ascending squared
+    distances, self excluded, duplicates kept).
+    """
+    points = np.asarray(points, np.float32)
+    n, f = points.shape
+    d = mesh.size
+    chunk = -(-n // d)
+    if k >= n:
+        raise ValueError(f"k={k} must be < number of points {n}")
+    if k > chunk:
+        raise ValueError(
+            f"k={k} exceeds the per-device chunk {chunk} (= ceil(N/D)); "
+            "use fewer devices or the single-device ops.knn path"
+        )
+    padded = np.zeros((d * chunk, f), np.float32)
+    padded[:n] = points
+    pts = jax.device_put(padded, NamedSharding(mesh, P(VERTEX_AXIS, None)))
+    d2, gid = _compiled_body(mesh, n, k, chunk, row_tile)(pts)
+    return d2[:n], gid[:n]
+
+
+def sharded_lof(points, mesh, k: int = 128, row_tile: int = 1024):
+    """Distributed LOF scores: ring-sharded kNN + the shared LOF formula.
+
+    The post-kNN gathers (``kdist[idx]``, ``lrd[idx]``) touch only ``[N]``
+    vectors, so GSPMD's inserted collectives are small; the O(N^2) work
+    stays ring-scheduled. Returns float32 ``[N]`` (sharded).
+    """
+    from graphmine_tpu.ops.lof import lof_from_knn
+
+    d2, gid = sharded_knn(points, mesh, k, row_tile)
+    return jax.jit(lof_from_knn, static_argnums=2)(d2, gid, k)
