@@ -86,6 +86,15 @@ def _compiled_body(mesh, n: int, k: int, chunk: int, row_tile: int):
     return fn
 
 
+def can_shard(n: int, num_devices: int, k: int) -> bool:
+    """Whether an ``[n, F]`` point set can ride the ring with this ``k``:
+    every per-device chunk (``ceil(n/D)``) must hold at least ``k``
+    candidates for the per-hop top-k. The single owner of the constraint
+    :func:`sharded_knn` enforces — dispatchers use this instead of
+    re-deriving it."""
+    return 0 < k < n and k <= -(-n // num_devices)
+
+
 def sharded_knn(points, mesh, k: int, row_tile: int = 1024):
     """k nearest neighbors with the point set sharded over a 1-D mesh.
 

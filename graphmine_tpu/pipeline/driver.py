@@ -142,10 +142,23 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
         from graphmine_tpu.ops.features import standardize, vertex_features
         from graphmine_tpu.ops.lof import lof_scores
 
-        with m.timed("outliers_lof", k=config.lof_k):
+        from graphmine_tpu.parallel.knn import can_shard
+
+        k = min(config.lof_k, graph.num_vertices - 1)
+        use_sharded_lof = n_dev > 1 and can_shard(graph.num_vertices, n_dev, k)
+        with m.timed("outliers_lof", k=config.lof_k,
+                     devices=n_dev if use_sharded_lof else 1):
             feats = standardize(vertex_features(graph, labels))
-            k = min(config.lof_k, graph.num_vertices - 1)
-            scores = lof_scores(feats, k=k)
+            if use_sharded_lof:
+                # Multi-device: ring-sharded kNN + distributed LOF — the
+                # O(V^2) distance work is scheduled over the mesh with no
+                # replicated [V, F] (parallel/knn.py).
+                from graphmine_tpu.parallel.knn import sharded_lof
+                from graphmine_tpu.parallel.mesh import make_mesh
+
+                scores = sharded_lof(feats, make_mesh(n_dev), k=k)
+            else:
+                scores = lof_scores(feats, k=k)
             result.lof = np.asarray(scores)
         m.emit(
             "outlier_summary",

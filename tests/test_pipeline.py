@@ -68,6 +68,27 @@ def test_multi_device_pipeline():
 
 
 @needs_data
+def test_multi_device_lof_matches_single_device():
+    """r2: with >1 device the pipeline's LOF phase runs the ring-sharded
+    distributed path; scores must match the single-device all-pairs path."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    multi = run_pipeline(PipelineConfig(num_devices=8, outlier_method="lof"))
+    single = run_pipeline(PipelineConfig(num_devices=1, outlier_method="lof"))
+    # Discrete graph features produce many identical rows; tied neighbor
+    # sets legitimately differ between the ring merge and the single
+    # top_k (measured: 5/4613 scores off by <8e-4 on the bundled data),
+    # so scores agree to tie-noise tolerance and the outlier ranking's
+    # head must be identical.
+    np.testing.assert_allclose(multi.lof, single.lof, rtol=5e-3, atol=2e-3)
+    top_m = set(np.argsort(multi.lof)[::-1][:10])
+    top_s = set(np.argsort(single.lof)[::-1][:10])
+    assert top_m == top_s
+
+
+@needs_data
 def test_ring_schedule_pipeline():
     """--schedule ring reaches ring_label_propagation from the product
     surface (VERDICT r1: the memory-scalable path was unreachable) and
