@@ -107,9 +107,9 @@ def sharded_knn(points, mesh, k: int, row_tile: int = 1024):
     n, f = points.shape
     d = mesh.size
     chunk = -(-n // d)
-    if k >= n:
-        raise ValueError(f"k={k} must be < number of points {n}")
-    if k > chunk:
+    if not can_shard(n, d, k):
+        if not 0 < k < n:
+            raise ValueError(f"k={k} must be < number of points {n}")
         raise ValueError(
             f"k={k} exceeds the per-device chunk {chunk} (= ceil(N/D)); "
             "use fewer devices or the single-device ops.knn path"

@@ -149,6 +149,48 @@ def test_weighted_edgelist_pipeline(tmp_path):
     )
 
 
+def test_kitchen_sink_weighted_ring_checkpoint(tmp_path):
+    """Integration: every r2 feature in one run — weighted edge list, ring
+    schedule on 8 devices, checkpoint mid-run + resume, both outlier
+    methods — and the resumed result matches an uninterrupted run."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    rng = np.random.default_rng(4)
+    v, e = 120, 900
+    src = rng.integers(0, v, e)
+    dst = rng.integers(0, v, e)
+    w = rng.integers(1, 8, e) / 2.0
+    p = tmp_path / "wg.txt"
+    p.write_text("".join(f"n{s} n{d} {x}\n" for s, d, x in zip(src, dst, w)))
+
+    def cfg(**kw):
+        base = dict(
+            data_path=str(p), data_format="edgelist", edge_weight_col=2,
+            # lof_k=15 == ceil(V/8): the LARGEST k that still routes
+            # through the ring-sharded LOF path (asserted below)
+            num_devices=8, schedule="ring", max_iter=4, lof_k=15,
+        )
+        base.update(kw)
+        return PipelineConfig(**base)
+
+    full = run_pipeline(cfg(outlier_method="both"))
+    assert full.lof is not None and full.outliers is not None
+    lof_rec = [r for r in full.metrics.records if r["phase"] == "outliers_lof"]
+    assert lof_rec and lof_rec[0]["devices"] == 8  # sharded path taken
+
+    # interrupt at iteration 2, then resume to 4
+    ck = str(tmp_path / "ck")
+    run_pipeline(cfg(outlier_method="none", max_iter=2, checkpoint_dir=ck))
+    resumed = run_pipeline(
+        cfg(outlier_method="none", checkpoint_dir=ck, resume=True)
+    )
+    np.testing.assert_array_equal(resumed.labels, full.labels)
+    resume_events = [r for r in resumed.metrics.records if r["phase"] == "resume"]
+    assert resume_events and resume_events[0]["iteration"] == 2
+
+
 def test_config_validation():
     with pytest.raises(ValueError):
         PipelineConfig(backend="spark").validate()
