@@ -372,9 +372,12 @@ def _rowwise_wmode(lbl: jax.Array, wgt: jax.Array) -> jax.Array:
     reaches magnitudes where float32 ulp exceeds small weight gaps, and
     total-as-difference misranks labels (the same corruption
     ``segment.py:_segment_mode_weighted`` documents and avoids)."""
-    order = jnp.argsort(lbl, axis=1)
-    s = jnp.take_along_axis(lbl, order, axis=1)
-    ws = jnp.take_along_axis(jnp.where(lbl == _SENTINEL, 0.0, wgt), order, axis=1)
+    # One multi-operand sort carries the weights through the sort network
+    # itself — no argsort + per-slot gathers (gathers are the measured
+    # bottleneck on TPU, docs/DESIGN.md).
+    s, ws = lax.sort(
+        (lbl, jnp.where(lbl == _SENTINEL, 0.0, wgt)), dimension=1, num_keys=1
+    )
     new_run = jnp.concatenate(
         [jnp.ones((s.shape[0], 1), jnp.bool_), s[:, 1:] != s[:, :-1]], axis=1
     )
