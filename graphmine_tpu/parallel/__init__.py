@@ -3,6 +3,7 @@ from graphmine_tpu.parallel.mesh import initialize_distributed, make_mesh, make_
 from graphmine_tpu.parallel.ring import (
     ring_connected_components,
     ring_label_propagation,
+    ring_pagerank,
 )
 from graphmine_tpu.parallel.sharded import (
     ShardedGraph,
@@ -25,6 +26,7 @@ __all__ = [
     "sharded_pagerank",
     "ring_label_propagation",
     "ring_connected_components",
+    "ring_pagerank",
     "sharded_knn",
     "sharded_lof",
 ]

@@ -145,6 +145,70 @@ def ring_label_propagation(
 
 
 @partial(jax.jit, static_argnames=("max_iter", "mesh"))
+def ring_pagerank(
+    sg: ShardedGraph,
+    mesh,
+    out_degrees: jax.Array,
+    alpha: float = 0.85,
+    max_iter: int = 100,
+    tol: float = 1e-6,
+) -> jax.Array:
+    """Distributed PageRank with the rank vector fully sharded.
+
+    Parity with :func:`graphmine_tpu.ops.pagerank.pagerank` and
+    :func:`graphmine_tpu.parallel.sharded.sharded_pagerank` (virtual-mesh
+    tested); differs only in the schedule: per power iteration the
+    rank/out-degree contribution chunks rotate the ring (one
+    ``_ring_gather``), the dangling mass and the convergence delta are
+    two scalar ``psum``s, and no device ever holds the full [V] rank
+    vector. ``sg`` must come from a **directed** graph. Returns float32
+    ranks ``[V]`` summing to 1.
+    """
+    from graphmine_tpu.parallel.sharded import _pagerank_terms
+
+    _check_mesh(sg, mesh)
+    v = sg.num_vertices
+    chunk, d = sg.chunk_size, sg.num_shards
+    inv_out, reset, dangling = _pagerank_terms(
+        out_degrees, v, sg.padded_vertices
+    )
+
+    def body(inv_o, res, dang, recv_local, send):
+        recv_local, send = recv_local[0], send[0]
+        gather = partial(_ring_gather, num_shards=d, chunk_size=chunk)
+
+        def cond(state):
+            _, delta, it = state
+            return (delta > tol) & (it < max_iter)
+
+        def step(state):
+            pr, _, it = state
+            msg = gather(pr * inv_o, send)
+            inflow = jax.ops.segment_sum(
+                msg * (recv_local < chunk), recv_local, num_segments=chunk
+            )
+            dm = lax.psum(jnp.sum(jnp.where(dang, pr, 0.0)), VERTEX_AXIS)
+            new = alpha * (inflow + dm * res) + (1.0 - alpha) * res
+            delta = lax.psum(jnp.abs(new - pr).sum(), VERTEX_AXIS)
+            return new, delta, it + 1
+
+        pr, _, _ = lax.while_loop(
+            cond, step, (res, jnp.float32(1.0), jnp.int32(0))
+        )
+        return pr
+
+    sharded = P(VERTEX_AXIS)
+    data = P(VERTEX_AXIS, None)
+    pr = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(sharded, sharded, sharded, data, data),
+        out_specs=sharded,
+    )(inv_out, reset, dangling, sg.msg_recv_local, sg.msg_send)
+    return pr[:v]
+
+
+@partial(jax.jit, static_argnames=("max_iter", "mesh"))
 def ring_connected_components(sg: ShardedGraph, mesh, max_iter: int = 0) -> jax.Array:
     """Distributed weakly-connected components with sharded labels; parity
     with :func:`graphmine_tpu.ops.cc.connected_components`."""

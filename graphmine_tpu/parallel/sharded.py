@@ -470,6 +470,20 @@ def _pad_labels(labels: jax.Array, sg: ShardedGraph) -> jax.Array:
     return jnp.concatenate([labels.astype(jnp.int32), pad])
 
 
+def _pagerank_terms(out_degrees, v: int, v_pad: int):
+    """Padded degree-derived PageRank terms shared by the replicated and
+    ring schedules (one owner for the dangling/teleport semantics).
+    Returns ``(inv_out, reset, dangling)``, each ``[v_pad]``."""
+    out_deg = jnp.zeros((v_pad,), jnp.int32).at[:v].set(
+        jnp.asarray(out_degrees).astype(jnp.int32)
+    )
+    live = jnp.arange(v_pad) < v
+    inv_out = jnp.where(out_deg > 0, 1.0 / jnp.maximum(out_deg, 1), 0.0)
+    dangling = (out_deg == 0) & live
+    reset = jnp.where(live, 1.0 / v, 0.0).astype(jnp.float32)
+    return inv_out, reset, dangling
+
+
 def _pagerank_shard_body(state, recv_local, send, deg, *, chunk_size, axes, alpha):
     """Per-device PageRank power-iteration step.
 
@@ -512,14 +526,9 @@ def sharded_pagerank(
     virtual-device tests. Returns float32 ranks ``[V]`` summing to 1.
     """
     _check_mesh(sg, mesh)
-    v, v_pad = sg.num_vertices, sg.padded_vertices
-    out_deg = jnp.zeros((v_pad,), jnp.int32).at[:v].set(
-        out_degrees.astype(jnp.int32)
+    inv_out, reset, dangling = _pagerank_terms(
+        out_degrees, sg.num_vertices, sg.padded_vertices
     )
-    live = jnp.arange(v_pad) < v
-    inv_out = jnp.where(out_deg > 0, 1.0 / jnp.maximum(out_deg, 1), 0.0)
-    dangling = (out_deg == 0) & live
-    reset = jnp.where(live, 1.0 / v, 0.0).astype(jnp.float32)
 
     in_specs, rep = _shard_specs(mesh)
     body = jax.shard_map(
@@ -547,6 +556,5 @@ def sharded_pagerank(
         delta = jnp.abs(new - pr).sum()
         return new, delta, it + 1
 
-    pr0 = jnp.where(live, 1.0 / v, 0.0).astype(jnp.float32)
-    pr, _, _ = lax.while_loop(cond, step, (pr0, jnp.float32(1.0), jnp.int32(0)))
-    return pr[:v]
+    pr, _, _ = lax.while_loop(cond, step, (reset, jnp.float32(1.0), jnp.int32(0)))
+    return pr[: sg.num_vertices]

@@ -77,3 +77,30 @@ def test_ring_mesh_size_one(rng):
     got = np.asarray(ring_label_propagation(sg, mesh, max_iter=3))
     want = np.asarray(label_propagation(g, max_iter=3))
     np.testing.assert_array_equal(got, want)
+
+
+def test_ring_pagerank_matches_single_and_sharded(mesh8, rng):
+    """r2: PageRank joins the ring family — parity with both the
+    single-device kernel and the replicated sharded path."""
+    from graphmine_tpu.graph.container import build_graph
+    from graphmine_tpu.ops.degrees import out_degrees
+    from graphmine_tpu.ops.pagerank import pagerank
+    from graphmine_tpu.parallel.ring import ring_pagerank
+    from graphmine_tpu.parallel.sharded import (
+        partition_graph,
+        shard_graph_arrays,
+        sharded_pagerank,
+    )
+
+    v, e = 200, 1400
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    g = build_graph(src, dst, num_vertices=v, symmetric=False)
+    od = out_degrees(g)
+    want = np.asarray(pagerank(g, max_iter=60))
+    sg = shard_graph_arrays(partition_graph(g, mesh=mesh8), mesh8)
+    shard = np.asarray(sharded_pagerank(sg, mesh8, od, max_iter=60))
+    ring = np.asarray(ring_pagerank(sg, mesh8, od, max_iter=60))
+    np.testing.assert_allclose(ring, want, rtol=2e-4, atol=1e-7)
+    np.testing.assert_allclose(ring, shard, rtol=2e-4, atol=1e-7)
+    assert abs(ring.sum() - 1.0) < 1e-4
