@@ -30,7 +30,7 @@ from graphmine_tpu.ops.cc import connected_components
 from graphmine_tpu.ops.louvain import leiden, louvain
 from graphmine_tpu.ops.modularity import modularity
 from graphmine_tpu.ops.pagerank import pagerank, parallel_personalized_pagerank
-from graphmine_tpu.ops.degrees import degrees, in_degrees, out_degrees
+from graphmine_tpu.ops.degrees import degrees, in_degrees, out_degrees, out_weights
 from graphmine_tpu.ops.paths import (
     bfs,
     bfs_distances,

@@ -21,6 +21,22 @@ def out_degrees(graph: Graph) -> jax.Array:
     )
 
 
+def out_weights(graph: Graph) -> jax.Array:
+    """Out-edge weight sums (weighted out-degree), float32.
+
+    The ``out_degrees`` analog for weighted graphs — what the distributed
+    PageRank schedules take for weighted rank splitting. On an unweighted
+    graph this is just ``out_degrees`` as float. Note: on a symmetric
+    graph messages flow both directions, so the sum is the *undirected*
+    strength; pass a directed graph for true out-strengths.
+    """
+    if graph.msg_weight is None:
+        return out_degrees(graph).astype(jnp.float32)
+    return jax.ops.segment_sum(
+        graph.msg_weight, graph.msg_send, num_segments=graph.num_vertices
+    )
+
+
 def in_degrees(graph: Graph) -> jax.Array:
     return jax.ops.segment_sum(
         jnp.ones_like(graph.dst), graph.dst, num_segments=graph.num_vertices

@@ -30,6 +30,8 @@ from graphmine_tpu.parallel.mesh import VERTEX_AXIS
 from graphmine_tpu.parallel.sharded import (
     ShardedGraph,
     _check_mesh,
+    _check_pagerank_weighted,
+    _pagerank_terms,
     _fixpoint_supersteps,
     _padded_init_labels,
     _pad_labels,
@@ -144,7 +146,7 @@ def ring_label_propagation(
     return labels[: sg.num_vertices]
 
 
-@partial(jax.jit, static_argnames=("max_iter", "mesh"))
+@partial(jax.jit, static_argnames=("max_iter", "mesh", "weighted"))
 def ring_pagerank(
     sg: ShardedGraph,
     mesh,
@@ -152,6 +154,7 @@ def ring_pagerank(
     alpha: float = 0.85,
     max_iter: int = 100,
     tol: float = 1e-6,
+    weighted: bool | None = None,
 ) -> jax.Array:
     """Distributed PageRank with the rank vector fully sharded.
 
@@ -161,20 +164,22 @@ def ring_pagerank(
     rank/out-degree contribution chunks rotate the ring (one
     ``_ring_gather``), the dangling mass and the convergence delta are
     two scalar ``psum``s, and no device ever holds the full [V] rank
-    vector. ``sg`` must come from a **directed** graph. Returns float32
-    ranks ``[V]`` summing to 1.
+    vector. ``sg`` must come from a **directed** graph; for a weighted
+    one pass float out-edge weight sums as ``out_degrees`` (see
+    :func:`~graphmine_tpu.parallel.sharded.sharded_pagerank`). Returns
+    float32 ranks ``[V]`` summing to 1.
     """
-    from graphmine_tpu.parallel.sharded import _pagerank_terms
-
     _check_mesh(sg, mesh)
+    weighted = _check_pagerank_weighted(sg, out_degrees, weighted)
     v = sg.num_vertices
     chunk, d = sg.chunk_size, sg.num_shards
     inv_out, reset, dangling = _pagerank_terms(
         out_degrees, v, sg.padded_vertices
     )
 
-    def body(inv_o, res, dang, recv_local, send):
+    def body(inv_o, res, dang, recv_local, send, *weight):
         recv_local, send = recv_local[0], send[0]
+        w = weight[0][0] if weighted else None
         gather = partial(_ring_gather, num_shards=d, chunk_size=chunk)
 
         def cond(state):
@@ -183,10 +188,10 @@ def ring_pagerank(
 
         def step(state):
             pr, _, it = state
-            msg = gather(pr * inv_o, send)
-            inflow = jax.ops.segment_sum(
-                msg * (recv_local < chunk), recv_local, num_segments=chunk
-            )
+            msg = gather(pr * inv_o, send) * (recv_local < chunk)
+            if w is not None:
+                msg = msg * w
+            inflow = jax.ops.segment_sum(msg, recv_local, num_segments=chunk)
             dm = lax.psum(jnp.sum(jnp.where(dang, pr, 0.0)), VERTEX_AXIS)
             new = alpha * (inflow + dm * res) + (1.0 - alpha) * res
             delta = lax.psum(jnp.abs(new - pr).sum(), VERTEX_AXIS)
@@ -202,9 +207,11 @@ def ring_pagerank(
     pr = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(sharded, sharded, sharded, data, data),
+        in_specs=(sharded, sharded, sharded, data, data)
+        + ((data,) if weighted else ()),
         out_specs=sharded,
-    )(inv_out, reset, dangling, sg.msg_recv_local, sg.msg_send)
+    )(inv_out, reset, dangling, sg.msg_recv_local, sg.msg_send,
+      *((sg.msg_weight,) if weighted else ()))
     return pr[:v]
 
 

@@ -470,36 +470,65 @@ def _pad_labels(labels: jax.Array, sg: ShardedGraph) -> jax.Array:
     return jnp.concatenate([labels.astype(jnp.int32), pad])
 
 
+def _check_pagerank_weighted(sg, out_degrees, weighted):
+    """Resolve/validate the weighted flag for the distributed PageRank
+    schedules (one owner; used by the replicated and ring paths).
+
+    ``None`` -> weighted iff the graph carries ``msg_weight``. A weighted
+    run requires FLOAT out-edge weight sums (``ops.degrees.out_weights``):
+    integer out-degrees would mix w-weighted messages with 1/deg outflow
+    and silently stop conserving rank mass.
+    """
+    if weighted is None:
+        weighted = sg.msg_weight is not None
+    if weighted:
+        if sg.msg_weight is None:
+            raise ValueError("weighted=True but the graph has no msg_weight")
+        if not jnp.issubdtype(jnp.result_type(out_degrees), jnp.floating):
+            raise ValueError(
+                "weighted PageRank needs float out-edge weight sums "
+                "(ops.degrees.out_weights), not integer out-degrees; pass "
+                "weighted=False for unweighted ranks on this graph"
+            )
+    return weighted
+
+
 def _pagerank_terms(out_degrees, v: int, v_pad: int):
     """Padded degree-derived PageRank terms shared by the replicated and
     ring schedules (one owner for the dangling/teleport semantics).
+    ``out_degrees`` may be int out-degrees (unweighted) or float out-edge
+    weight sums (weighted; each vertex splits rank in proportion to edge
+    weight — NetworkX semantics, matching ``ops.pagerank(weights=...)``).
     Returns ``(inv_out, reset, dangling)``, each ``[v_pad]``."""
-    out_deg = jnp.zeros((v_pad,), jnp.int32).at[:v].set(
-        jnp.asarray(out_degrees).astype(jnp.int32)
+    out = jnp.zeros((v_pad,), jnp.float32).at[:v].set(
+        jnp.asarray(out_degrees).astype(jnp.float32)
     )
     live = jnp.arange(v_pad) < v
-    inv_out = jnp.where(out_deg > 0, 1.0 / jnp.maximum(out_deg, 1), 0.0)
-    dangling = (out_deg == 0) & live
+    inv_out = jnp.where(out > 0, 1.0 / jnp.maximum(out, 1e-30), 0.0)
+    dangling = (out <= 0) & live
     reset = jnp.where(live, 1.0 / v, 0.0).astype(jnp.float32)
     return inv_out, reset, dangling
 
 
-def _pagerank_shard_body(state, recv_local, send, deg, *, chunk_size, axes, alpha):
+def _pagerank_shard_body(state, recv_local, send, deg, weight=None, *,
+                         chunk_size, axes, alpha):
     """Per-device PageRank power-iteration step.
 
     ``state``: (pr_full, inv_out_full, dangling_mass_reset_full) — the
     replicated rank vector and precomputed degree terms. Messages ride the
     same vertex-range-sharded CSR as LPA; per-iteration comms is one tiled
-    all_gather of the rank chunk.
+    all_gather of the rank chunk. ``weight``: optional [1, Mp] per-message
+    weights — with float out-strengths in ``inv_out`` this is weighted
+    PageRank (contribution = rank x w/out_w).
     """
     pr_full, inv_out_full, reset_full, dangling_full = state
     recv_local = recv_local[0]
     send = send[0]
     contrib_full = pr_full * inv_out_full
-    inflow = jax.ops.segment_sum(
-        contrib_full[send] * (recv_local < chunk_size), recv_local,
-        num_segments=chunk_size,
-    )
+    msg = contrib_full[send] * (recv_local < chunk_size)
+    if weight is not None:
+        msg = msg * weight[0]
+    inflow = jax.ops.segment_sum(msg, recv_local, num_segments=chunk_size)
     dangling_mass = jnp.sum(jnp.where(dangling_full, pr_full, 0.0))
     start = lax.axis_index(axes).astype(jnp.int32) * chunk_size
     reset_own = lax.dynamic_slice(reset_full, (start,), (chunk_size,))
@@ -507,7 +536,7 @@ def _pagerank_shard_body(state, recv_local, send, deg, *, chunk_size, axes, alph
     return lax.all_gather(new_own, axes, tiled=True)
 
 
-@partial(jax.jit, static_argnames=("max_iter", "mesh"))
+@partial(jax.jit, static_argnames=("max_iter", "mesh", "weighted"))
 def sharded_pagerank(
     sg: ShardedGraph,
     mesh,
@@ -515,22 +544,33 @@ def sharded_pagerank(
     alpha: float = 0.85,
     max_iter: int = 100,
     tol: float = 1e-6,
+    weighted: bool | None = None,
 ) -> jax.Array:
     """Distributed PageRank over the vertex-range-sharded message CSR.
 
     ``sg`` must be partitioned from a **directed** graph
     (``build_graph(..., symmetric=False)``); ``out_degrees`` is the
     directed out-degree vector ``[V]`` (see
-    :func:`graphmine_tpu.ops.degrees.out_degrees`). Parity with
-    :func:`graphmine_tpu.ops.pagerank.pagerank` is asserted by the
-    virtual-device tests. Returns float32 ranks ``[V]`` summing to 1.
+    :func:`graphmine_tpu.ops.degrees.out_degrees`) — or, for a weighted
+    run, the float out-edge weight sums
+    (:func:`graphmine_tpu.ops.degrees.out_weights`): rank then splits
+    across out-edges in proportion to weight, matching
+    ``ops.pagerank(weights=...)``. ``weighted=None`` follows
+    ``sg.msg_weight`` presence; int out_degrees on a weighted run are
+    rejected (the w/out mixture would silently conserve no rank mass) —
+    pass ``weighted=False`` for unweighted ranks on a weighted graph.
+    Parity with :func:`graphmine_tpu.ops.pagerank.pagerank` is asserted
+    by the virtual-device tests. Returns float32 ranks ``[V]`` summing
+    to 1.
     """
     _check_mesh(sg, mesh)
+    weighted = _check_pagerank_weighted(sg, out_degrees, weighted)
     inv_out, reset, dangling = _pagerank_terms(
         out_degrees, sg.num_vertices, sg.padded_vertices
     )
 
     in_specs, rep = _shard_specs(mesh)
+    data_spec = P(_vertex_axes(mesh), None)
     body = jax.shard_map(
         partial(
             _pagerank_shard_body,
@@ -539,7 +579,8 @@ def sharded_pagerank(
             alpha=alpha,
         ),
         mesh=mesh,
-        in_specs=((rep, rep, rep, rep),) + in_specs[1:],
+        in_specs=((rep, rep, rep, rep),) + in_specs[1:]
+        + ((data_spec,) if weighted else ()),
         out_specs=rep,
         check_vma=False,
     )
@@ -550,8 +591,10 @@ def sharded_pagerank(
 
     def step(state):
         pr, _, it = state
+        args = (sg.msg_weight,) if weighted else ()
         new = body(
-            (pr, inv_out, reset, dangling), sg.msg_recv_local, sg.msg_send, sg.degrees
+            (pr, inv_out, reset, dangling), sg.msg_recv_local, sg.msg_send,
+            sg.degrees, *args,
         )
         delta = jnp.abs(new - pr).sum()
         return new, delta, it + 1

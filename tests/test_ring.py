@@ -104,3 +104,51 @@ def test_ring_pagerank_matches_single_and_sharded(mesh8, rng):
     np.testing.assert_allclose(ring, want, rtol=2e-4, atol=1e-7)
     np.testing.assert_allclose(ring, shard, rtol=2e-4, atol=1e-7)
     assert abs(ring.sum() - 1.0) < 1e-4
+
+
+def test_weighted_pagerank_sharded_and_ring_parity(mesh8, rng):
+    """r2: weighted PageRank on both distributed schedules — rank splits
+    across out-edges in proportion to weight, matching the single-device
+    ops.pagerank(weights=...) semantics."""
+    import jax.numpy as jnp
+
+    from graphmine_tpu.graph.container import build_graph
+    from graphmine_tpu.ops.pagerank import pagerank
+    from graphmine_tpu.parallel.ring import ring_pagerank
+    from graphmine_tpu.parallel.sharded import (
+        partition_graph,
+        shard_graph_arrays,
+        sharded_pagerank,
+    )
+    import jax
+
+    v, e = 150, 1100
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    w = rng.uniform(0.2, 4.0, e).astype(np.float32)
+    g = build_graph(src, dst, num_vertices=v, symmetric=False, edge_weights=w)
+    want = np.asarray(pagerank(g, max_iter=60, weights=jnp.asarray(w)))
+    # weights change the answer on this graph
+    assert not np.allclose(want, np.asarray(pagerank(g, max_iter=60)), atol=1e-5)
+
+    from graphmine_tpu.ops.degrees import out_degrees, out_weights
+
+    out_w = out_weights(g)
+    sg = shard_graph_arrays(partition_graph(g, mesh=mesh8), mesh8)
+    assert sg.msg_weight is not None
+    shard = np.asarray(sharded_pagerank(sg, mesh8, out_w, max_iter=60))
+    ring = np.asarray(ring_pagerank(sg, mesh8, out_w, max_iter=60))
+    np.testing.assert_allclose(shard, want, rtol=2e-4, atol=1e-7)
+    np.testing.assert_allclose(ring, want, rtol=2e-4, atol=1e-7)
+
+    # the silent-mixture trap is rejected: int out-degrees + weighted graph
+    import pytest
+    with pytest.raises(ValueError, match="out_weights"):
+        sharded_pagerank(sg, mesh8, out_degrees(g), max_iter=5)
+    with pytest.raises(ValueError, match="out_weights"):
+        ring_pagerank(sg, mesh8, out_degrees(g), max_iter=5)
+    # weighted=False opts back into unweighted ranks on the same graph
+    unw = np.asarray(sharded_pagerank(sg, mesh8, out_degrees(g), max_iter=60,
+                                      weighted=False))
+    np.testing.assert_allclose(
+        unw, np.asarray(pagerank(g, max_iter=60)), rtol=2e-4, atol=1e-7)
