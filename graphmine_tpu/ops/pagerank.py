@@ -86,8 +86,22 @@ def pagerank(
     return pr
 
 
-@partial(jax.jit, static_argnames=("v", "max_iter"))
-def _batched_ppr(src, dst, v, sources, alpha, max_iter, tol):
+def _validate_sources(sources, v: int) -> np.ndarray:
+    """Shared source-id coercion/validation for the single-device and
+    source-sharded (parallel/ppr.py) PPR entry points."""
+    sources = np.asarray(sources, dtype=np.int32)
+    if sources.size and (sources.min() < 0 or sources.max() >= v):
+        bad = sources[(sources < 0) | (sources >= v)]
+        raise ValueError(f"source ids {bad.tolist()} out of range [0, {v})")
+    return sources
+
+
+@partial(jax.jit, static_argnames=("v", "max_iter", "varying_axes"))
+def _batched_ppr(src, dst, v, sources, alpha, max_iter, tol,
+                 varying_axes=None):
+    """``varying_axes``: set when called inside ``shard_map`` with sharded
+    ``sources`` (parallel/ppr.py) — the loop carry must then be marked
+    device-varying up front so its type matches the varying loop output."""
     s = sources.shape[0]
     out_deg = jax.ops.segment_sum(jnp.ones_like(src), src, num_segments=v)
     inv_out = jnp.where(out_deg > 0, 1.0 / jnp.maximum(out_deg, 1), 0.0).astype(
@@ -111,7 +125,11 @@ def _batched_ppr(src, dst, v, sources, alpha, max_iter, tol):
         return (delta > tol) & (it < max_iter)
 
     pr0 = jnp.full((v, s), 1.0 / v, jnp.float32)
-    pr, _, _ = lax.while_loop(cond, step, (pr0, jnp.float32(1.0), jnp.int32(0)))
+    delta0 = jnp.float32(1.0)
+    if varying_axes:
+        pr0 = lax.pcast(pr0, varying_axes, to="varying")
+        delta0 = lax.pcast(delta0, varying_axes, to="varying")
+    pr, _, _ = lax.while_loop(cond, step, (pr0, delta0, jnp.int32(0)))
     return pr
 
 
@@ -132,16 +150,9 @@ def parallel_personalized_pagerank(
     sources cost barely more HBM traffic than one (vs GraphX, which runs a
     vector program per source over the same Pregel machinery).
     """
-    sources = np.asarray(sources, dtype=np.int32)
+    sources = _validate_sources(sources, graph.num_vertices)
     if sources.size == 0:
         return jnp.zeros((graph.num_vertices, 0), jnp.float32)
-    if sources.size and (
-        sources.min() < 0 or sources.max() >= graph.num_vertices
-    ):
-        bad = sources[(sources < 0) | (sources >= graph.num_vertices)]
-        raise ValueError(
-            f"source ids {bad.tolist()} out of range [0, {graph.num_vertices})"
-        )
     return _batched_ppr(
         graph.src, graph.dst, graph.num_vertices, jnp.asarray(sources), alpha,
         max_iter, tol,

@@ -1,4 +1,5 @@
 from graphmine_tpu.parallel.knn import sharded_knn, sharded_lof
+from graphmine_tpu.parallel.ppr import sharded_personalized_pagerank
 from graphmine_tpu.parallel.mesh import initialize_distributed, make_mesh, make_multislice_mesh
 from graphmine_tpu.parallel.ring import (
     ring_connected_components,
@@ -29,4 +30,5 @@ __all__ = [
     "ring_pagerank",
     "sharded_knn",
     "sharded_lof",
+    "sharded_personalized_pagerank",
 ]

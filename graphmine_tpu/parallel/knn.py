@@ -31,7 +31,10 @@ from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from graphmine_tpu.ops.knn import _tiled_knn
-from graphmine_tpu.parallel.mesh import VERTEX_AXIS
+from graphmine_tpu.ops.lof import lof_from_knn
+
+_lof_from_knn = jax.jit(lof_from_knn, static_argnums=2)
+from graphmine_tpu.parallel.mesh import VERTEX_AXIS, cached_jit_shard_map
 
 
 def _knn_ring_body(pts, *, n: int, k: int, chunk: int, num_shards: int,
@@ -65,25 +68,20 @@ def _knn_ring_body(pts, *, n: int, k: int, chunk: int, num_shards: int,
     return best_d, best_g
 
 
-# One compiled ring program per (mesh, n, k, chunk, row_tile): a fresh
-# jit/shard_map wrapper per call would re-trace the D-unrolled ring on
-# every invocation.
-_BODY_CACHE: dict = {}
-
-
 def _compiled_body(mesh, n: int, k: int, chunk: int, row_tile: int):
-    key = (mesh, n, k, chunk, row_tile)
-    fn = _BODY_CACHE.get(key)
-    if fn is None:
-        fn = jax.jit(jax.shard_map(
+    """One compiled ring program per (mesh, n, k, chunk, row_tile) — a
+    fresh wrapper per call would re-trace the D-unrolled ring every
+    invocation."""
+    return cached_jit_shard_map(
+        ("knn_ring", mesh, n, k, chunk, row_tile),
+        lambda: jax.shard_map(
             partial(_knn_ring_body, n=n, k=k, chunk=chunk,
                     num_shards=mesh.size, row_tile=row_tile),
             mesh=mesh,
             in_specs=P(VERTEX_AXIS, None),
             out_specs=(P(VERTEX_AXIS, None), P(VERTEX_AXIS, None)),
-        ))
-        _BODY_CACHE[key] = fn
-    return fn
+        ),
+    )
 
 
 def can_shard(n: int, num_devices: int, k: int) -> bool:
@@ -128,7 +126,5 @@ def sharded_lof(points, mesh, k: int = 128, row_tile: int = 1024):
     vectors, so GSPMD's inserted collectives are small; the O(N^2) work
     stays ring-scheduled. Returns float32 ``[N]`` (sharded).
     """
-    from graphmine_tpu.ops.lof import lof_from_knn
-
     d2, gid = sharded_knn(points, mesh, k, row_tile)
-    return jax.jit(lof_from_knn, static_argnums=2)(d2, gid, k)
+    return _lof_from_knn(d2, gid, k)

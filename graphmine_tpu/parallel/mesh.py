@@ -101,3 +101,23 @@ def initialize_distributed(**kw) -> bool:
             raise
         return False
     return True
+
+
+# Shared compiled-program cache for jit(shard_map(...)) wrappers: a fresh
+# wrapper per call would re-trace the program every invocation. Callers key
+# on everything that shapes the program (mesh, static sizes) plus a tag.
+_SHARD_MAP_CACHE: dict = {}
+
+
+def cached_jit_shard_map(key, make):
+    """Return (building once) ``jax.jit(make())`` memoized under ``key``.
+
+    ``make`` is a zero-arg callable producing the shard_map-wrapped body;
+    ``key`` must be hashable and include a per-call-site tag so different
+    ops never collide. Used by ``parallel/knn.py`` and ``parallel/ppr.py``.
+    """
+    fn = _SHARD_MAP_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(make())
+        _SHARD_MAP_CACHE[key] = fn
+    return fn

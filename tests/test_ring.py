@@ -152,3 +152,25 @@ def test_weighted_pagerank_sharded_and_ring_parity(mesh8, rng):
                                       weighted=False))
     np.testing.assert_allclose(
         unw, np.asarray(pagerank(g, max_iter=60)), rtol=2e-4, atol=1e-7)
+
+
+def test_sharded_ppr_matches_single_device(mesh8, rng):
+    """r2: source-axis data parallelism for parallelPersonalizedPageRank —
+    column parity with the single-device batched op, incl. a source count
+    that doesn't divide the mesh (padding columns sliced away)."""
+    from graphmine_tpu.ops.pagerank import parallel_personalized_pagerank
+    from graphmine_tpu.parallel.ppr import sharded_personalized_pagerank
+
+    v, e = 120, 800
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    g = build_graph(src, dst, num_vertices=v, symmetric=False)
+    sources = np.array([3, 77, 5, 41, 99, 0], np.int32)  # 6 % 8 != 0
+    want = np.asarray(parallel_personalized_pagerank(g, sources, max_iter=60))
+    got = np.asarray(sharded_personalized_pagerank(g, sources, mesh8, max_iter=60))
+    assert got.shape == (v, 6)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-7)
+
+    assert sharded_personalized_pagerank(g, [], mesh8).shape == (v, 0)
+    with pytest.raises(ValueError, match="out of range"):
+        sharded_personalized_pagerank(g, [v + 1], mesh8)

@@ -23,17 +23,28 @@ def mesh8():
     return make_mesh(8)
 
 
-def test_sharded_knn_matches_single_device(mesh8, rng):
+def test_sharded_knn_matches_single_device(mesh8):
     from graphmine_tpu.parallel.knn import sharded_knn
 
-    for n, f, k in ((400, 8, 5), (1000, 16, 32), (257, 4, 3)):
-        pts = rng.normal(size=(n, f)).astype(np.float32)
+    # Own rng (session-fixture state is order-dependent). Index parity is
+    # asserted except where the two paths saw a near-tie: the full-row and
+    # per-chunk matmuls round d2 differently in the last ulp, which can
+    # swap neighbors whose true distances are (nearly) equal — at any such
+    # disagreement the distances themselves must match, proving the swap
+    # is a legitimate tie, not a wrong neighborhood.
+    for n, f, k, seed in ((400, 8, 5, 0), (1000, 16, 32, 1), (257, 4, 3, 2)):
+        r = np.random.default_rng(seed)
+        pts = r.normal(size=(n, f)).astype(np.float32)
         want_d, want_i = knn(pts, k=k, impl="xla")
         got_d, got_i = sharded_knn(pts, mesh8, k=k, row_tile=64)
+        got_d, got_i = np.asarray(got_d), np.asarray(got_i)
+        want_d, want_i = np.asarray(want_d), np.asarray(want_i)
+        np.testing.assert_allclose(got_d, want_d, rtol=1e-5, atol=1e-6)
+        diff = got_i != want_i
+        assert diff.mean() < 0.01  # near-tie swaps are rare
         np.testing.assert_allclose(
-            np.asarray(got_d), np.asarray(want_d), rtol=1e-5, atol=1e-6
+            got_d[diff], want_d[diff], rtol=1e-5, atol=1e-6
         )
-        np.testing.assert_array_equal(np.asarray(got_i), np.asarray(want_i))
 
 
 def test_sharded_knn_handles_duplicates_and_ragged_n(mesh8):
@@ -59,10 +70,10 @@ def test_sharded_knn_handles_duplicates_and_ragged_n(mesh8):
     assert (got_i != np.arange(len(pts))[:, None]).all()  # self excluded
 
 
-def test_sharded_lof_matches_single_device(mesh8, rng):
+def test_sharded_lof_matches_single_device(mesh8):
     from graphmine_tpu.parallel.knn import sharded_lof
 
-    pts = rng.normal(size=(600, 8)).astype(np.float32)
+    pts = np.random.default_rng(7).normal(size=(600, 8)).astype(np.float32)
     pts[0] = 40.0  # one blatant outlier
     want = np.asarray(lof_scores(pts, k=16, impl="xla"))
     got = np.asarray(sharded_lof(pts, mesh8, k=16, row_tile=64))
@@ -70,11 +81,12 @@ def test_sharded_lof_matches_single_device(mesh8, rng):
     assert got[0] == got.max() and got[0] > 2.0
 
 
-def test_sharded_knn_validates_k(mesh8, rng):
+def test_sharded_knn_validates_k(mesh8):
     from graphmine_tpu.parallel.knn import sharded_knn
 
-    pts = rng.normal(size=(32, 4)).astype(np.float32)
+    r = np.random.default_rng(3)
+    pts = r.normal(size=(32, 4)).astype(np.float32)
     with pytest.raises(ValueError, match="chunk"):
         sharded_knn(pts, mesh8, k=5)  # chunk = 4 < k
     with pytest.raises(ValueError, match="must be <"):
-        sharded_knn(rng.normal(size=(8, 2)).astype(np.float32), mesh8, k=8)
+        sharded_knn(r.normal(size=(8, 2)).astype(np.float32), mesh8, k=8)
