@@ -39,6 +39,20 @@ from graphmine_tpu.parallel.sharded import (
 )
 
 
+def _check_ring_mesh(sg: ShardedGraph, mesh) -> None:
+    """Ring schedules ppermute over the single ``VERTEX_AXIS`` — reject
+    multi-axis meshes with a real error instead of a cryptic trace-time
+    axis failure (the replicated ``sharded.*`` schedules handle 2-D
+    ``("dcn", "ici")`` meshes; use those there)."""
+    _check_mesh(sg, mesh)
+    if tuple(mesh.axis_names) != (VERTEX_AXIS,):
+        raise ValueError(
+            f"ring schedules need a 1-D ('{VERTEX_AXIS}',) mesh (got axes "
+            f"{tuple(mesh.axis_names)}); use the sharded_* replicated "
+            "schedules on multi-slice meshes"
+        )
+
+
 def _ring_gather(chunk: jax.Array, global_idx: jax.Array, *, num_shards: int, chunk_size: int) -> jax.Array:
     """Gather ``values[global_idx]`` from a vertex-range-sharded vector.
 
@@ -128,7 +142,7 @@ def ring_label_propagation(
     (asserted by the virtual-device parity tests); differs only in the
     memory/communication schedule. Returns int32 labels ``[V]``.
     """
-    _check_mesh(sg, mesh)
+    _check_ring_mesh(sg, mesh)
     labels = _padded_init_labels(sg) if init_labels is None else _pad_labels(init_labels, sg)
     if sg.msg_weight is not None:
         step_fn = _ring_step_fn(sg, mesh, _lpa_ring_body_weighted, n_graph_args=4)
@@ -169,7 +183,7 @@ def ring_pagerank(
     :func:`~graphmine_tpu.parallel.sharded.sharded_pagerank`). Returns
     float32 ranks ``[V]`` summing to 1.
     """
-    _check_mesh(sg, mesh)
+    _check_ring_mesh(sg, mesh)
     weighted = _check_pagerank_weighted(sg, out_degrees, weighted)
     v = sg.num_vertices
     chunk, d = sg.chunk_size, sg.num_shards
@@ -219,7 +233,7 @@ def ring_pagerank(
 def ring_connected_components(sg: ShardedGraph, mesh, max_iter: int = 0) -> jax.Array:
     """Distributed weakly-connected components with sharded labels; parity
     with :func:`graphmine_tpu.ops.cc.connected_components`."""
-    _check_mesh(sg, mesh)
+    _check_ring_mesh(sg, mesh)
     step_fn = _ring_step_fn(sg, mesh, _cc_ring_body)
     return _fixpoint_supersteps(
         lambda l: step_fn(l, sg.msg_recv_local, sg.msg_send, sg.degrees), sg, max_iter

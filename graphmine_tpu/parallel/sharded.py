@@ -129,8 +129,12 @@ def partition_graph(
     d = num_shards
     vc = -(-num_vertices // d)  # ceil
     vc = -(-vc // pad_multiple) * pad_multiple
-    shard_of = recv // vc
-    counts = np.bincount(shard_of, minlength=d)
+    # recv is CSR-sorted ascending: shard boundaries come from d binary
+    # searches instead of an O(M) divide + bincount pass.
+    offsets = np.zeros(d + 1, dtype=np.int64)
+    offsets[1:-1] = np.searchsorted(recv, np.arange(1, d) * vc)
+    offsets[-1] = len(recv)
+    counts = np.diff(offsets)
     mp = max(int(counts.max(initial=0)), 1)
     mp = -(-mp // pad_multiple) * pad_multiple
 
@@ -139,8 +143,6 @@ def partition_graph(
     recv_local = np.empty((d, mp), dtype=np.int32)
     send_pad = np.empty((d, mp), dtype=np.int32)
     w_pad = None if w_msg is None else np.zeros((d, mp), dtype=np.float32)
-    offsets = np.zeros(d + 1, dtype=np.int64)
-    np.cumsum(counts, out=offsets[1:])
     for s in range(d):
         lo, hi = offsets[s], offsets[s + 1]
         n = hi - lo
@@ -151,10 +153,12 @@ def partition_graph(
         if w_pad is not None:
             w_pad[s, :n] = w_msg[lo:hi]
 
-    deg = np.zeros((d, vc), dtype=np.int32)
-    deg_flat = np.bincount(recv, minlength=d * vc)[: d * vc]
-    # recv ids beyond num_vertices never occur; reshape covers padded tail
-    deg[:, :] = deg_flat.reshape(d, vc)
+    # Degrees come free from the CSR pointer (O(V) diff, not an O(M)
+    # bincount over the messages); padded vertices get degree 0.
+    ptr = np.asarray(g.msg_ptr, dtype=np.int64)
+    deg = np.zeros(d * vc, dtype=np.int32)
+    deg[:num_vertices] = np.diff(ptr).astype(np.int32)
+    deg = deg.reshape(d, vc)
 
     bucket_send, bucket_target, bucket_weight = (), (), ()
     if build_bucket_plan:

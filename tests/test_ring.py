@@ -174,3 +174,19 @@ def test_sharded_ppr_matches_single_device(mesh8, rng):
     assert sharded_personalized_pagerank(g, [], mesh8).shape == (v, 0)
     with pytest.raises(ValueError, match="out of range"):
         sharded_personalized_pagerank(g, [v + 1], mesh8)
+
+
+def test_ring_rejects_multislice_mesh(rng):
+    """Ring schedules ppermute one axis; a 2-D mesh must be rejected with
+    a clear error, not a cryptic trace failure."""
+    from graphmine_tpu.parallel.mesh import make_multislice_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh2 = make_multislice_mesh(2, 4)
+    src = rng.integers(0, 40, 200).astype(np.int32)
+    dst = rng.integers(0, 40, 200).astype(np.int32)
+    g = build_graph(src, dst, num_vertices=40)
+    sg = shard_graph_arrays(partition_graph(g, mesh=mesh2), mesh2)
+    with pytest.raises(ValueError, match="1-D"):
+        ring_label_propagation(sg, mesh2, max_iter=2)
