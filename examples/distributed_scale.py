@@ -74,6 +74,32 @@ assert np.array_equal(np.asarray(labels), np.asarray(gm.label_propagation(g, max
 ring = ring_label_propagation(sg, mesh, max_iter=5)
 assert np.array_equal(np.asarray(ring), np.asarray(labels))
 
+# ── 4b. the rest of the distributed family (r2) ──────────────────────────
+# PageRank on both schedules (replicated frontier vs fully-sharded ring),
+# personalized PageRank with the SOURCE axis sharded, and the outlier
+# path at mesh scale: ring-sharded kNN + distributed LOF.
+from graphmine_tpu.parallel import (
+    ring_pagerank,
+    sharded_lof,
+    sharded_pagerank,
+    sharded_personalized_pagerank,
+)
+
+g_dir = gm.build_graph(src, dst, num_vertices=v, symmetric=False)
+sgd = shard_graph_arrays(partition_graph(g_dir, mesh=mesh), mesh)
+od = gm.out_degrees(g_dir)
+pr = sharded_pagerank(sgd, mesh, od, max_iter=30)
+pr_ring = ring_pagerank(sgd, mesh, od, max_iter=30)
+assert np.allclose(np.asarray(pr), np.asarray(pr_ring), rtol=2e-4, atol=1e-7)
+print(f"pagerank mass: {float(np.asarray(pr).sum()):.4f} (both schedules agree)")
+
+ppr = sharded_personalized_pagerank(g_dir, [0, 7, 42], mesh, max_iter=30)
+print(f"ppr columns: {ppr.shape}")
+
+feats = np.asarray(gm.standardize(gm.vertex_features(g, labels)))
+lof = np.asarray(sharded_lof(feats, mesh, k=32))
+print(f"top LOF score: {lof.max():.2f} (ring-sharded kNN over the mesh)")
+
 # ── 5. checkpoint / resume ───────────────────────────────────────────────
 # Orbax writes each shard from its owning host (multi-host safe); restore
 # places the label array straight onto the mesh sharding — no host bounce.

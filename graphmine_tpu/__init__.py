@@ -43,6 +43,9 @@ from graphmine_tpu.ops.scc import strongly_connected_components
 from graphmine_tpu.ops.aggregate import aggregate_messages, pregel
 from graphmine_tpu.ops.motifs import find as find_motifs
 from graphmine_tpu.ops.streaming_lof import StreamingLOF, fit_lof, score_lof
+from graphmine_tpu.ops.features import standardize, vertex_features
+from graphmine_tpu.ops.knn import knn
+from graphmine_tpu.ops.lof import lof_scores
 from graphmine_tpu.ops.triangles import triangle_count, clustering_coefficient
 from graphmine_tpu.ops.kcore import core_numbers
 from graphmine_tpu.ops.mis import greedy_color, maximal_independent_set
@@ -95,6 +98,10 @@ __all__ = [
     "find_motifs",
     "StreamingLOF",
     "fit_lof",
+    "standardize",
+    "vertex_features",
+    "knn",
+    "lof_scores",
     "score_lof",
     "triangle_count",
     "clustering_coefficient",
