@@ -119,21 +119,27 @@ def load_sharded(checkpoint_dir: str, tag: str = "lpa", sharding=None):
     path = os.path.abspath(os.path.join(checkpoint_dir, f"{tag}_orbax"))
     if not os.path.exists(path):
         return None
-    with ocp.StandardCheckpointer() as ckptr:
-        if sharding is None:
-            state = ckptr.restore(path)
-        else:
-            import jax
+    import jax
 
-            meta = ckptr.metadata(path)
-            # StandardCheckpointer.metadata returns StepMetadata in newer
-            # orbax (tree under .item_metadata) and the raw tree in older.
-            meta = getattr(meta, "item_metadata", meta)["labels"]
-            tpl = {
+    with ocp.StandardCheckpointer() as ckptr:
+        # StandardCheckpointer.metadata returns StepMetadata in newer
+        # orbax (tree under .item_metadata) and the raw tree in older.
+        meta = ckptr.metadata(path)
+        meta = getattr(meta, "item_metadata", meta)
+        if sharding is None:
+            # Restore into a host-numpy skeleton built from the saved
+            # metadata: orbax then validates the topology instead of
+            # warning that targetless restores are unsafe.
+            target = jax.tree.map(
+                lambda m: np.zeros(m.shape, m.dtype), dict(meta)
+            )
+        else:
+            lbl = meta["labels"]
+            target = {
                 "labels": jax.ShapeDtypeStruct(
-                    meta.shape, meta.dtype, sharding=sharding
+                    lbl.shape, lbl.dtype, sharding=sharding
                 ),
                 "iteration": 0,
             }
-            state = ckptr.restore(path, tpl)
+        state = ckptr.restore(path, target)
     return state["labels"], int(state["iteration"])
