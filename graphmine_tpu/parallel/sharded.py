@@ -240,8 +240,9 @@ def _build_shard_bucket_plan(deg, send_pad, counts, chunk_size, d, w_pad=None):
         flat_idx = np.minimum(idx, max_idx).reshape(d, -1)
         gathered = np.take_along_axis(send_pad, flat_idx, 1).reshape(d, n_c, w)
         send_c = np.where(valid, gathered, sentinel_send).astype(np.int32)
-        # Padding rows get DISTINCT out-of-range targets (chunk_size + j):
-        # mode="drop" discards them, and unique_indices=True stays honest.
+        # Padding rows get DISTINCT targets chunk_size + j: the shard body
+        # scatters them into in-range scratch slots past the real chunk
+        # (sliced away), keeping unique_indices honest with no OOB index.
         tgt_c = np.where(row_valid, rows, chunk_size + j).astype(np.int32)
         bucket_send.append(send_c)
         bucket_target.append(tgt_c)
@@ -325,10 +326,11 @@ def _lpa_shard_body_bucketed(
     Same comms as :func:`_lpa_shard_body` (one tiled all_gather); the
     shard-local reduction swaps the global segment-mode sort for the
     bucketed plan (see ops/bucketed_mode.py — gather-bound analysis).
-    Padding rows gather the sentinel label and scatter to index
-    ``chunk_size``, which ``mode="drop"`` discards; vertices with no
-    messages are in no bucket and keep their label. ``bucket_weight``
-    (r2): slot-aligned weights switch the row modes to weighted argmax.
+    Padding rows gather the sentinel label and scatter to DISTINCT
+    in-range targets ``chunk_size + j`` of an extended scratch region
+    that is sliced away at the end; vertices with no messages are in no
+    bucket and keep their label. ``bucket_weight`` (r2): slot-aligned
+    weights switch the row modes to weighted argmax.
     """
     from graphmine_tpu.ops.bucketed_mode import (
         _SENTINEL,
@@ -341,14 +343,25 @@ def _lpa_shard_body_bucketed(
     )
     start = lax.axis_index(axes).astype(jnp.int32) * chunk_size
     own = lax.dynamic_slice(labels_full, (start,), (chunk_size,))
+    # Padding rows carry DISTINCT targets chunk_size + j (j < n_c): one
+    # scratch extension by the max class width keeps every scatter index
+    # in range and unique. Do NOT "optimize" this back to out-of-bounds
+    # indices with mode="drop" — under shard_map the XLA:CPU lowering of
+    # a unique_indices OOB scatter was observed corrupting the last
+    # in-range slot with a shifted read (caught by
+    # tools/consistency_sweep.py; see docs/DESIGN.md).
+    n_max = max((t.shape[-1] for t in bucket_target), default=0)
+    own = jnp.concatenate([own, jnp.zeros((n_max,), own.dtype)])
     wmats = bucket_weight or (None,) * len(bucket_send)
     for sidx, tgt, wmat in zip(bucket_send, bucket_target, wmats):
         mat = lbl_pad[sidx[0]]
-        mode = (
+        vals = (
             _bucket_mode(mat) if wmat is None else _bucket_wmode(mat, wmat[0])
         )
-        own = own.at[tgt[0]].set(mode, unique_indices=True, mode="drop")
-    return lax.all_gather(own.astype(jnp.int32), axes, tiled=True)
+        own = own.at[tgt[0]].set(vals, unique_indices=True)
+    return lax.all_gather(
+        own[:chunk_size].astype(jnp.int32), axes, tiled=True
+    )
 
 
 def _cc_shard_body(labels_full, recv_local, send, deg, *, chunk_size, axes):

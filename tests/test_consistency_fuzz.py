@@ -44,10 +44,17 @@ def _graphs():
     # self-loops + exact duplicates + isolates
     cases.append((np.array([1, 1, 1, 2, 5, 5], np.int32),
                   np.array([1, 2, 2, 3, 6, 6], np.int32), 9))
+    # regression (consistency_sweep seed 34): 3 edges over 51 vertices —
+    # most shards hold only bucket-plan padding rows, and the shard-body
+    # scatter of those rows must not disturb the isolated vertices at the
+    # ends of the chunks (an OOB drop-scatter under shard_map was observed
+    # corrupting them with shifted reads on XLA:CPU)
+    cases.append((np.array([44, 5, 12], np.int32),
+                  np.array([0, 33, 5], np.int32), 51))
     return cases
 
 
-@pytest.mark.parametrize("case", range(6))
+@pytest.mark.parametrize("case", range(7))
 def test_all_lpa_paths_agree(case, mesh8):
     from graphmine_tpu.ops.bucketed_mode import build_graph_and_plan, lpa_superstep_bucketed
     from graphmine_tpu.parallel.ring import ring_label_propagation
@@ -91,7 +98,7 @@ def test_all_lpa_paths_agree(case, mesh8):
     )
 
 
-@pytest.mark.parametrize("case", range(6))
+@pytest.mark.parametrize("case", range(7))
 def test_all_weighted_lpa_paths_agree(case, mesh8):
     """r2: weighted LPA has the same four execution paths; same one-answer
     invariant. Weights are multiples of 1/4 so per-label sums are exact in
@@ -144,7 +151,7 @@ def test_all_weighted_lpa_paths_agree(case, mesh8):
     )
 
 
-@pytest.mark.parametrize("case", range(6))
+@pytest.mark.parametrize("case", range(7))
 def test_cc_paths_agree_with_union_find(case, mesh8):
     from graphmine_tpu.parallel.ring import ring_connected_components
     from graphmine_tpu.parallel.sharded import (

@@ -1,0 +1,132 @@
+"""Extended cross-path consistency sweep (manual; heavier than CI's fuzz).
+
+Runs the one-answer invariant — every LPA/CC/PageRank execution path
+agrees — over many random graph shapes and seeds, unweighted AND
+weighted, on the virtual 8-device mesh. CI's ``test_consistency_fuzz``
+covers 6 pinned cases; this sweeps hundreds. Run before releases or
+after touching any superstep/plan/partition code:
+
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \\
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        PYTHONPATH=. python tools/consistency_sweep.py [num_seeds]
+
+Exits nonzero on the first disagreement with a full repro line.
+"""
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+import numpy as np
+
+
+def sweep(num_seeds: int = 30) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from graphmine_tpu.graph.container import build_graph
+    from graphmine_tpu.ops.bucketed_mode import (
+        build_graph_and_plan,
+        lpa_superstep_bucketed,
+    )
+    from graphmine_tpu.ops.cc import connected_components
+    from graphmine_tpu.ops.degrees import out_degrees, out_weights
+    from graphmine_tpu.ops.lpa import label_propagation
+    from graphmine_tpu.ops.pagerank import pagerank
+    from graphmine_tpu.parallel.mesh import make_mesh
+    from graphmine_tpu.parallel.ring import (
+        ring_connected_components,
+        ring_label_propagation,
+        ring_pagerank,
+    )
+    from graphmine_tpu.parallel.sharded import (
+        partition_graph,
+        shard_graph_arrays,
+        sharded_connected_components,
+        sharded_label_propagation,
+        sharded_pagerank,
+    )
+
+    d = min(8, len(jax.devices()))
+    mesh = make_mesh(d)
+    step = jax.jit(lpa_superstep_bucketed)
+    checked = 0
+    for seed in range(num_seeds):
+        rng = np.random.default_rng(seed)
+        v = int(rng.integers(8, 700))
+        e = int(rng.integers(1, 12 * v))
+        shape = rng.choice(["uniform", "powerlaw", "star", "chain"])
+        if shape == "uniform":
+            src = rng.integers(0, v, e).astype(np.int32)
+            dst = rng.integers(0, v, e).astype(np.int32)
+        elif shape == "powerlaw":
+            raw = rng.pareto(1.1, size=2 * e)
+            ids = np.minimum((raw * v / 15).astype(np.int64), v - 1).astype(np.int32)
+            src, dst = ids[:e], ids[e:]
+        elif shape == "star":
+            hub = int(rng.integers(0, v))
+            src = np.full(e, hub, np.int32)
+            dst = rng.integers(0, v, e).astype(np.int32)
+        else:  # chain + noise
+            base = np.arange(min(e, v - 1), dtype=np.int32)
+            extra = rng.integers(0, v, max(e - len(base), 0)).astype(np.int32)
+            src = np.concatenate([base, extra[: max(e - len(base), 0)]])
+            dst = np.concatenate([base + 1, rng.integers(0, v, len(src) - len(base)).astype(np.int32)])
+        it = int(rng.integers(1, 6))
+        weights = None
+        if rng.random() < 0.5:
+            weights = (rng.integers(1, 16, len(src)) / 4.0).astype(np.float32)
+
+        tag = f"seed={seed} v={v} e={len(src)} shape={shape} iters={it} weighted={weights is not None}"
+
+        g = build_graph(src, dst, num_vertices=v, edge_weights=weights)
+        want = np.asarray(label_propagation(g, max_iter=it, plan=None))
+
+        g2, plan = build_graph_and_plan(src, dst, num_vertices=v, edge_weights=weights)
+        lbl = jnp.arange(v, dtype=jnp.int32)
+        for _ in range(it):
+            lbl = step(lbl, g2, plan)
+        assert np.array_equal(want, np.asarray(lbl)), f"fused != sort: {tag}"
+
+        sgf = shard_graph_arrays(partition_graph(g, mesh=mesh, build_bucket_plan=True), mesh)
+        assert np.array_equal(
+            want, np.asarray(sharded_label_propagation(sgf, mesh, max_iter=it))
+        ), f"sharded bucketed != sort: {tag}"
+        sg = shard_graph_arrays(partition_graph(g, mesh=mesh), mesh)
+        assert np.array_equal(
+            want, np.asarray(sharded_label_propagation(sg, mesh, max_iter=it))
+        ), f"sharded sort != sort: {tag}"
+        assert np.array_equal(
+            want, np.asarray(ring_label_propagation(sg, mesh, max_iter=it))
+        ), f"ring != sort: {tag}"
+
+        cc = np.asarray(connected_components(g))
+        assert np.array_equal(cc, np.asarray(sharded_connected_components(sg, mesh))), f"sharded cc: {tag}"
+        assert np.array_equal(cc, np.asarray(ring_connected_components(sg, mesh))), f"ring cc: {tag}"
+
+        gd = build_graph(src, dst, num_vertices=v, symmetric=False, edge_weights=weights)
+        sgd = shard_graph_arrays(partition_graph(gd, mesh=mesh), mesh)
+        if weights is None:
+            pr_want = np.asarray(pagerank(gd, max_iter=40))
+            ow = out_degrees(gd)
+        else:
+            pr_want = np.asarray(pagerank(gd, max_iter=40, weights=jnp.asarray(weights)))
+            ow = out_weights(gd)
+        pr_s = np.asarray(sharded_pagerank(sgd, mesh, ow, max_iter=40))
+        pr_r = np.asarray(ring_pagerank(sgd, mesh, ow, max_iter=40))
+        assert np.allclose(pr_s, pr_want, rtol=3e-4, atol=1e-7), f"sharded pr: {tag}"
+        assert np.allclose(pr_r, pr_want, rtol=3e-4, atol=1e-7), f"ring pr: {tag}"
+
+        checked += 1
+        if checked % 10 == 0:
+            print(f"{checked}/{num_seeds} ok (last: {tag})", flush=True)
+    print(f"consistency sweep: all {checked} cases agree across every path")
+    return 0
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 30
+    sys.exit(sweep(n))
