@@ -23,7 +23,7 @@ if _REPO not in sys.path:
 import numpy as np
 
 
-def sweep(num_seeds: int = 30) -> int:
+def sweep(num_seeds: int = 30, first_seed: int = 0) -> int:
     import jax
     import jax.numpy as jnp
 
@@ -50,11 +50,17 @@ def sweep(num_seeds: int = 30) -> int:
         sharded_pagerank,
     )
 
+    from graphmine_tpu.ops.knn import knn
+    from graphmine_tpu.ops.lof import lof_scores
+    from graphmine_tpu.ops.pagerank import parallel_personalized_pagerank
+    from graphmine_tpu.parallel.knn import can_shard, sharded_knn, sharded_lof
+    from graphmine_tpu.parallel.ppr import sharded_personalized_pagerank
+
     d = min(8, len(jax.devices()))
     mesh = make_mesh(d)
     step = jax.jit(lpa_superstep_bucketed)
     checked = 0
-    for seed in range(num_seeds):
+    for seed in range(first_seed, first_seed + num_seeds):
         rng = np.random.default_rng(seed)
         v = int(rng.integers(8, 700))
         e = int(rng.integers(1, 12 * v))
@@ -120,6 +126,27 @@ def sweep(num_seeds: int = 30) -> int:
         assert np.allclose(pr_s, pr_want, rtol=3e-4, atol=1e-7), f"sharded pr: {tag}"
         assert np.allclose(pr_r, pr_want, rtol=3e-4, atol=1e-7), f"ring pr: {tag}"
 
+        # source-sharded PPR vs the single-device batched op
+        n_src = int(rng.integers(1, 12))
+        srcs = rng.integers(0, v, n_src).astype(np.int32)
+        ppr_want = np.asarray(parallel_personalized_pagerank(gd, srcs, max_iter=25))
+        ppr_got = np.asarray(sharded_personalized_pagerank(gd, srcs, mesh, max_iter=25))
+        assert np.allclose(ppr_got, ppr_want, rtol=3e-4, atol=1e-7), f"sharded ppr: {tag}"
+
+        # ring-sharded kNN/LOF vs single-device (random point clouds)
+        n_pts = int(rng.integers(d * 3, 400))
+        f_dim = int(rng.integers(2, 12))
+        k = int(rng.integers(2, min(16, -(-n_pts // d)) + 1))
+        if can_shard(n_pts, d, k):
+            pts = rng.normal(size=(n_pts, f_dim)).astype(np.float32)
+            kd, _ = knn(pts, k=k, impl="xla")
+            sd, _ = sharded_knn(pts, mesh, k=k, row_tile=32)
+            assert np.allclose(np.asarray(sd), np.asarray(kd),
+                               rtol=1e-5, atol=1e-5), f"sharded knn d2: {tag}"
+            lw = np.asarray(lof_scores(pts, k=k, impl="xla"))
+            lg = np.asarray(sharded_lof(pts, mesh, k=k, row_tile=32))
+            assert np.allclose(lg, lw, rtol=5e-3, atol=2e-3), f"sharded lof: {tag}"
+
         checked += 1
         if checked % 10 == 0:
             print(f"{checked}/{num_seeds} ok (last: {tag})", flush=True)
@@ -129,4 +156,5 @@ def sweep(num_seeds: int = 30) -> int:
 
 if __name__ == "__main__":
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 30
-    sys.exit(sweep(n))
+    first = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    sys.exit(sweep(n, first))
