@@ -118,6 +118,12 @@ def _batched_ppr(src, dst, v, sources, alpha, max_iter, tol,
         dangling_mass = jnp.sum(jnp.where(dangling[:, None], pr, 0.0), axis=0)
         new = alpha * (inflow + dangling_mass[None, :] * reset) + (1.0 - alpha) * reset
         delta = jnp.abs(new - pr).sum(axis=0).max()
+        if varying_axes:
+            # Couple the stopping rule across the mesh: every column chunk
+            # iterates until the globally slowest column converges —
+            # exactly the single-device batch's max-over-all-columns rule,
+            # so the sharded result matches it to float noise.
+            delta = lax.pmax(delta, varying_axes)
         return new, delta, it + 1
 
     def cond(state):
@@ -125,11 +131,11 @@ def _batched_ppr(src, dst, v, sources, alpha, max_iter, tol,
         return (delta > tol) & (it < max_iter)
 
     pr0 = jnp.full((v, s), 1.0 / v, jnp.float32)
-    delta0 = jnp.float32(1.0)
     if varying_axes:
+        # pr varies per device; delta stays replicated (the pmax in step
+        # produces the same coupled value everywhere).
         pr0 = lax.pcast(pr0, varying_axes, to="varying")
-        delta0 = lax.pcast(delta0, varying_axes, to="varying")
-    pr, _, _ = lax.while_loop(cond, step, (pr0, delta0, jnp.int32(0)))
+    pr, _, _ = lax.while_loop(cond, step, (pr0, jnp.float32(1.0), jnp.int32(0)))
     return pr
 
 

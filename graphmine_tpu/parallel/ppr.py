@@ -59,7 +59,14 @@ def sharded_personalized_pagerank(
 ) -> jax.Array:
     """``parallel_personalized_pagerank`` with sources sharded over the
     mesh. Returns ``[V, S]`` (columns sharded); parity with the
-    single-device op is asserted by the virtual-mesh tests."""
+    single-device op is asserted by the virtual-mesh tests.
+
+    Convergence matches the single-device batch exactly: the per-chunk
+    ``while_loop`` delta is ``pmax``-coupled across the mesh, so every
+    column iterates until the globally slowest column meets ``tol`` —
+    the same max-over-all-columns stopping rule as the batch, making the
+    two paths comparable at float-noise tolerance.
+    """
     from graphmine_tpu.ops.pagerank import _validate_sources
 
     v, d = graph.num_vertices, mesh.size

@@ -1,16 +1,25 @@
 """Extended cross-path consistency sweep (manual; heavier than CI's fuzz).
 
-Runs the one-answer invariant — every LPA/CC/PageRank execution path
-agrees — over many random graph shapes and seeds, unweighted AND
+Runs the one-answer invariant — every LPA/CC/PageRank/PPR/kNN execution
+path agrees — over many random graph shapes and seeds, unweighted AND
 weighted, on the virtual 8-device mesh. CI's ``test_consistency_fuzz``
-covers 6 pinned cases; this sweeps hundreds. Run before releases or
+covers 7 pinned cases; this sweeps hundreds. Run before releases or
 after touching any superstep/plan/partition code:
 
     env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \\
         XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
-        PYTHONPATH=. python tools/consistency_sweep.py [num_seeds]
+        PYTHONPATH=. python tools/consistency_sweep.py [num_seeds] [first_seed] [--big]
+
+``first_seed`` chunks long sweeps into fresh processes (XLA:CPU's LLVM
+JIT arena exhausts after ~50 unique-shape compilations per process).
+``--big`` switches to the big-graph tier: fewer, larger cases (2K-40K
+vertices) with injected mega-hubs (degree 2500-6000) so the histogram /
+wide bucket classes and large ring rotations are exercised.
 
 Exits nonzero on the first disagreement with a full repro line.
+This sweep caught a real shard_map scatter miscompile in round 2
+(docs/DESIGN.md) and the PPR convergence-coupling gap fixed by the
+pmax-coupled stopping rule.
 """
 
 import os
@@ -23,43 +32,8 @@ if _REPO not in sys.path:
 import numpy as np
 
 
-def sweep(num_seeds: int = 30, first_seed: int = 0) -> int:
-    import jax
-    import jax.numpy as jnp
-
-    from graphmine_tpu.graph.container import build_graph
-    from graphmine_tpu.ops.bucketed_mode import (
-        build_graph_and_plan,
-        lpa_superstep_bucketed,
-    )
-    from graphmine_tpu.ops.cc import connected_components
-    from graphmine_tpu.ops.degrees import out_degrees, out_weights
-    from graphmine_tpu.ops.lpa import label_propagation
-    from graphmine_tpu.ops.pagerank import pagerank
-    from graphmine_tpu.parallel.mesh import make_mesh
-    from graphmine_tpu.parallel.ring import (
-        ring_connected_components,
-        ring_label_propagation,
-        ring_pagerank,
-    )
-    from graphmine_tpu.parallel.sharded import (
-        partition_graph,
-        shard_graph_arrays,
-        sharded_connected_components,
-        sharded_label_propagation,
-        sharded_pagerank,
-    )
-
-    from graphmine_tpu.ops.knn import knn
-    from graphmine_tpu.ops.lof import lof_scores
-    from graphmine_tpu.ops.pagerank import parallel_personalized_pagerank
-    from graphmine_tpu.parallel.knn import can_shard, sharded_knn, sharded_lof
-    from graphmine_tpu.parallel.ppr import sharded_personalized_pagerank
-
-    d = min(8, len(jax.devices()))
-    mesh = make_mesh(d)
-    step = jax.jit(lpa_superstep_bucketed)
-    checked = 0
+def _cases(num_seeds: int, first_seed: int):
+    """Small-graph tier: many shapes, isolates, self-loops, duplicates."""
     for seed in range(first_seed, first_seed + num_seeds):
         rng = np.random.default_rng(seed)
         v = int(rng.integers(8, 700))
@@ -80,14 +54,77 @@ def sweep(num_seeds: int = 30, first_seed: int = 0) -> int:
             base = np.arange(min(e, v - 1), dtype=np.int32)
             extra = rng.integers(0, v, max(e - len(base), 0)).astype(np.int32)
             src = np.concatenate([base, extra[: max(e - len(base), 0)]])
-            dst = np.concatenate([base + 1, rng.integers(0, v, len(src) - len(base)).astype(np.int32)])
+            dst = np.concatenate(
+                [base + 1,
+                 rng.integers(0, v, len(src) - len(base)).astype(np.int32)]
+            )
         it = int(rng.integers(1, 6))
         weights = None
         if rng.random() < 0.5:
             weights = (rng.integers(1, 16, len(src)) / 4.0).astype(np.float32)
+        tag = (f"seed={seed} v={v} e={len(src)} shape={shape} iters={it} "
+               f"weighted={weights is not None}")
+        yield tag, src, dst, v, it, weights, rng
 
-        tag = f"seed={seed} v={v} e={len(src)} shape={shape} iters={it} weighted={weights is not None}"
 
+def _big_cases(num_seeds: int, first_seed: int):
+    """Mega-hub big-graph tier: histogram/wide bucket classes, big rings."""
+    for seed in range(first_seed, first_seed + num_seeds):
+        rng = np.random.default_rng(7000 + seed)
+        v = int(rng.integers(2000, 40000))
+        e = int(rng.integers(v, 8 * v))
+        hub = rng.integers(0, v, 3).astype(np.int32)
+        hub_e = int(rng.integers(2500, 6000))
+        src = np.concatenate(
+            [rng.integers(0, v, e), np.repeat(hub, hub_e)]
+        ).astype(np.int32)
+        dst = np.concatenate(
+            [rng.integers(0, v, e), rng.integers(0, v, 3 * hub_e)]
+        ).astype(np.int32)
+        weights = None
+        if seed % 2:
+            weights = (rng.integers(1, 16, len(src)) / 4.0).astype(np.float32)
+        tag = f"big seed={seed} v={v} e={len(src)} weighted={weights is not None}"
+        yield tag, src, dst, v, 3, weights, rng
+
+
+def sweep(num_seeds: int = 30, first_seed: int = 0, big: bool = False) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from graphmine_tpu.graph.container import build_graph
+    from graphmine_tpu.ops.bucketed_mode import (
+        build_graph_and_plan,
+        lpa_superstep_bucketed,
+    )
+    from graphmine_tpu.ops.cc import connected_components
+    from graphmine_tpu.ops.degrees import out_degrees, out_weights
+    from graphmine_tpu.ops.knn import knn
+    from graphmine_tpu.ops.lof import lof_scores
+    from graphmine_tpu.ops.lpa import label_propagation
+    from graphmine_tpu.ops.pagerank import pagerank, parallel_personalized_pagerank
+    from graphmine_tpu.parallel.knn import can_shard, sharded_knn, sharded_lof
+    from graphmine_tpu.parallel.mesh import make_mesh
+    from graphmine_tpu.parallel.ppr import sharded_personalized_pagerank
+    from graphmine_tpu.parallel.ring import (
+        ring_connected_components,
+        ring_label_propagation,
+        ring_pagerank,
+    )
+    from graphmine_tpu.parallel.sharded import (
+        partition_graph,
+        shard_graph_arrays,
+        sharded_connected_components,
+        sharded_label_propagation,
+        sharded_pagerank,
+    )
+
+    d = min(8, len(jax.devices()))
+    mesh = make_mesh(d)
+    step = jax.jit(lpa_superstep_bucketed)
+    gen = _big_cases(num_seeds, first_seed) if big else _cases(num_seeds, first_seed)
+    checked = 0
+    for tag, src, dst, v, it, weights, rng in gen:
         g = build_graph(src, dst, num_vertices=v, edge_weights=weights)
         want = np.asarray(label_propagation(g, max_iter=it, plan=None))
 
@@ -97,7 +134,9 @@ def sweep(num_seeds: int = 30, first_seed: int = 0) -> int:
             lbl = step(lbl, g2, plan)
         assert np.array_equal(want, np.asarray(lbl)), f"fused != sort: {tag}"
 
-        sgf = shard_graph_arrays(partition_graph(g, mesh=mesh, build_bucket_plan=True), mesh)
+        sgf = shard_graph_arrays(
+            partition_graph(g, mesh=mesh, build_bucket_plan=True), mesh
+        )
         assert np.array_equal(
             want, np.asarray(sharded_label_propagation(sgf, mesh, max_iter=it))
         ), f"sharded bucketed != sort: {tag}"
@@ -110,10 +149,15 @@ def sweep(num_seeds: int = 30, first_seed: int = 0) -> int:
         ), f"ring != sort: {tag}"
 
         cc = np.asarray(connected_components(g))
-        assert np.array_equal(cc, np.asarray(sharded_connected_components(sg, mesh))), f"sharded cc: {tag}"
-        assert np.array_equal(cc, np.asarray(ring_connected_components(sg, mesh))), f"ring cc: {tag}"
+        assert np.array_equal(
+            cc, np.asarray(sharded_connected_components(sg, mesh))
+        ), f"sharded cc: {tag}"
+        assert np.array_equal(
+            cc, np.asarray(ring_connected_components(sg, mesh))
+        ), f"ring cc: {tag}"
 
-        gd = build_graph(src, dst, num_vertices=v, symmetric=False, edge_weights=weights)
+        gd = build_graph(src, dst, num_vertices=v, symmetric=False,
+                         edge_weights=weights)
         sgd = shard_graph_arrays(partition_graph(gd, mesh=mesh), mesh)
         if weights is None:
             pr_want = np.asarray(pagerank(gd, max_iter=40))
@@ -126,35 +170,44 @@ def sweep(num_seeds: int = 30, first_seed: int = 0) -> int:
         assert np.allclose(pr_s, pr_want, rtol=3e-4, atol=1e-7), f"sharded pr: {tag}"
         assert np.allclose(pr_r, pr_want, rtol=3e-4, atol=1e-7), f"ring pr: {tag}"
 
-        # source-sharded PPR vs the single-device batched op
-        n_src = int(rng.integers(1, 12))
-        srcs = rng.integers(0, v, n_src).astype(np.int32)
-        ppr_want = np.asarray(parallel_personalized_pagerank(gd, srcs, max_iter=25))
-        ppr_got = np.asarray(sharded_personalized_pagerank(gd, srcs, mesh, max_iter=25))
-        assert np.allclose(ppr_got, ppr_want, rtol=3e-4, atol=1e-7), f"sharded ppr: {tag}"
+        if not big:
+            # source-sharded PPR vs the single-device batched op (the pmax
+            # coupling makes both iterate in lockstep — tight tolerance)
+            n_src = int(rng.integers(1, 12))
+            srcs = rng.integers(0, v, n_src).astype(np.int32)
+            ppr_want = np.asarray(parallel_personalized_pagerank(gd, srcs, max_iter=25))
+            ppr_got = np.asarray(
+                sharded_personalized_pagerank(gd, srcs, mesh, max_iter=25)
+            )
+            assert np.allclose(
+                ppr_got, ppr_want, rtol=3e-4, atol=1e-7
+            ), f"sharded ppr: {tag}"
 
-        # ring-sharded kNN/LOF vs single-device (random point clouds)
-        n_pts = int(rng.integers(d * 3, 400))
-        f_dim = int(rng.integers(2, 12))
-        k = int(rng.integers(2, min(16, -(-n_pts // d)) + 1))
-        if can_shard(n_pts, d, k):
-            pts = rng.normal(size=(n_pts, f_dim)).astype(np.float32)
-            kd, _ = knn(pts, k=k, impl="xla")
-            sd, _ = sharded_knn(pts, mesh, k=k, row_tile=32)
-            assert np.allclose(np.asarray(sd), np.asarray(kd),
-                               rtol=1e-5, atol=1e-5), f"sharded knn d2: {tag}"
-            lw = np.asarray(lof_scores(pts, k=k, impl="xla"))
-            lg = np.asarray(sharded_lof(pts, mesh, k=k, row_tile=32))
-            assert np.allclose(lg, lw, rtol=5e-3, atol=2e-3), f"sharded lof: {tag}"
+            # ring-sharded kNN/LOF vs single-device (random point clouds)
+            n_pts = int(rng.integers(d * 3, 400))
+            f_dim = int(rng.integers(2, 12))
+            k = int(rng.integers(2, min(16, -(-n_pts // d)) + 1))
+            if can_shard(n_pts, d, k):
+                pts = rng.normal(size=(n_pts, f_dim)).astype(np.float32)
+                kd, _ = knn(pts, k=k, impl="xla")
+                sd, _ = sharded_knn(pts, mesh, k=k, row_tile=32)
+                assert np.allclose(
+                    np.asarray(sd), np.asarray(kd), rtol=1e-5, atol=1e-5
+                ), f"sharded knn d2: {tag}"
+                lw = np.asarray(lof_scores(pts, k=k, impl="xla"))
+                lg = np.asarray(sharded_lof(pts, mesh, k=k, row_tile=32))
+                assert np.allclose(lg, lw, rtol=5e-3, atol=2e-3), f"sharded lof: {tag}"
 
         checked += 1
-        if checked % 10 == 0:
+        if checked % 10 == 0 or big:
             print(f"{checked}/{num_seeds} ok (last: {tag})", flush=True)
     print(f"consistency sweep: all {checked} cases agree across every path")
     return 0
 
 
 if __name__ == "__main__":
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 30
-    first = int(sys.argv[2]) if len(sys.argv) > 2 else 0
-    sys.exit(sweep(n, first))
+    args = [a for a in sys.argv[1:] if a != "--big"]
+    big = "--big" in sys.argv[1:]
+    n = int(args[0]) if args else 30
+    first = int(args[1]) if len(args) > 1 else 0
+    sys.exit(sweep(n, first, big))
