@@ -519,7 +519,17 @@ def lpa_superstep_bucketed(
                 hist = jnp.zeros((n_hist * plan.num_vertices,), jnp.int32)
                 hist = hist.at[flat].add(1, mode="drop")
             else:
-                hist = jnp.zeros((n_hist * plan.num_vertices,), jnp.float32)
+                # Weights may legally all be 0 for a hub (validation only
+                # requires >= 0); an all-zero histogram row would argmax to
+                # label 0 — possibly never received. Start every slot at
+                # -inf, raise *received* slots to 0.0 with a scatter-max,
+                # then accumulate: unreceived labels stay -inf and ties
+                # resolve to the smallest received label, matching
+                # segment_mode and the row-wise weighted paths
+                # (cross-path one-answer invariant), with no second buffer.
+                hist = jnp.full((n_hist * plan.num_vertices,), -jnp.inf,
+                                jnp.float32)
+                hist = hist.at[flat].max(0.0, mode="drop")
                 hist = hist.at[flat].add(plan.hist_weight, mode="drop")
             counts = hist.reshape(n_hist, plan.num_vertices)
             modes = jnp.argmax(counts, axis=1).astype(jnp.int32)

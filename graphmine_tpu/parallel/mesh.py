@@ -14,6 +14,8 @@ slice and only the reduced label vector crosses DCN.
 
 from __future__ import annotations
 
+from collections import OrderedDict
+
 import jax
 import numpy as np
 from jax.sharding import Mesh
@@ -106,7 +108,11 @@ def initialize_distributed(**kw) -> bool:
 # Shared compiled-program cache for jit(shard_map(...)) wrappers: a fresh
 # wrapper per call would re-trace the program every invocation. Callers key
 # on everything that shapes the program (mesh, static sizes) plus a tag.
-_SHARD_MAP_CACHE: dict = {}
+# Bounded LRU: sweep-style workloads (tools/consistency_sweep.py) visit many
+# distinct shapes, and each entry pins a compiled executable — unbounded
+# growth would retain one per shape for the life of the process.
+_SHARD_MAP_CACHE_MAX = 64
+_SHARD_MAP_CACHE: OrderedDict = OrderedDict()
 
 
 def cached_jit_shard_map(key, make):
@@ -115,9 +121,14 @@ def cached_jit_shard_map(key, make):
     ``make`` is a zero-arg callable producing the shard_map-wrapped body;
     ``key`` must be hashable and include a per-call-site tag so different
     ops never collide. Used by ``parallel/knn.py`` and ``parallel/ppr.py``.
+    Evicts least-recently-used entries past ``_SHARD_MAP_CACHE_MAX``.
     """
     fn = _SHARD_MAP_CACHE.get(key)
     if fn is None:
         fn = jax.jit(make())
         _SHARD_MAP_CACHE[key] = fn
+        while len(_SHARD_MAP_CACHE) > _SHARD_MAP_CACHE_MAX:
+            _SHARD_MAP_CACHE.popitem(last=False)
+    else:
+        _SHARD_MAP_CACHE.move_to_end(key)
     return fn

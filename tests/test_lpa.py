@@ -386,6 +386,50 @@ def test_weighted_bucketed_kernel_matches_sort_kernel(rng, monkeypatch):
     assert int(lbl[4]) == 3 and int(lbl[3]) == 4  # w=1 copies
 
 
+def test_weighted_hub_all_zero_weights_cross_path_agreement(monkeypatch):
+    """ADVICE r2: a mega-hub whose every incoming weight is exactly 0
+    (legal — validation only requires >= 0) must still adopt the smallest
+    *received* label, not label 0. The unmasked all-zero histogram row
+    argmaxed to 0 even when the hub never received label 0.
+
+    Own-seed rng (not the session fixture): cross-path equality tests must
+    be order-independent — the r2 full-suite-only flakes came from shared
+    fixture state."""
+    import importlib
+
+    import jax
+
+    bm = importlib.import_module("graphmine_tpu.ops.bucketed_mode")
+
+    rng = np.random.default_rng(42)
+    v = 64
+    hub = 50  # hub id > all its neighbor labels, and != 0
+    deg = 20
+    # hub receives from vertices 5..24 with weight 0; plus background edges
+    src = np.concatenate([
+        np.arange(5, 5 + deg, dtype=np.int32),
+        rng.integers(30, hub, 40).astype(np.int32),
+    ])
+    dst = np.concatenate([
+        np.full(deg, hub, np.int32),
+        rng.integers(30, hub, 40).astype(np.int32),
+    ])
+    w = np.concatenate([
+        np.zeros(deg, np.float32),
+        np.ones(40, np.float32),
+    ])
+    monkeypatch.setattr(bm, "_HIST_MIN_DEG", 8)
+    graph, plan = bm.build_graph_and_plan(src, dst, num_vertices=v, edge_weights=w)
+    assert plan.hist_vertex_ids is not None and hub in np.asarray(plan.hist_vertex_ids)
+
+    init = jnp.arange(v, dtype=jnp.int32)
+    got = jax.jit(bm.lpa_superstep_bucketed)(init, graph, plan)
+    want = lpa_superstep(init, graph)  # sort-based segment_mode reference
+    # the hub's messages all carry weight 0 -> smallest received label (5)
+    assert int(want[hub]) == 5
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
 def test_weighted_build_validation():
     import pytest
 

@@ -90,3 +90,29 @@ def test_sharded_knn_validates_k(mesh8):
         sharded_knn(pts, mesh8, k=5)  # chunk = 4 < k
     with pytest.raises(ValueError, match="must be <"):
         sharded_knn(r.normal(size=(8, 2)).astype(np.float32), mesh8, k=8)
+
+
+def test_shard_map_cache_bounded_lru():
+    """ADVICE r2: the compiled-program cache must not grow without bound —
+    sweep workloads visit many distinct shapes and each entry pins an
+    executable. LRU: recently-used keys survive, the oldest are evicted."""
+    from graphmine_tpu.parallel import mesh as mesh_mod
+
+    saved = dict(mesh_mod._SHARD_MAP_CACHE)
+    mesh_mod._SHARD_MAP_CACHE.clear()
+    try:
+        cap = mesh_mod._SHARD_MAP_CACHE_MAX
+        for i in range(cap + 10):
+            mesh_mod.cached_jit_shard_map(("t", i), lambda: (lambda x: x))
+            mesh_mod.cached_jit_shard_map(("t", 0), lambda: (lambda x: x))  # keep hot
+        assert len(mesh_mod._SHARD_MAP_CACHE) == cap
+        assert ("t", 0) in mesh_mod._SHARD_MAP_CACHE          # LRU-protected
+        assert ("t", 1) not in mesh_mod._SHARD_MAP_CACHE      # evicted
+        assert ("t", cap + 9) in mesh_mod._SHARD_MAP_CACHE    # newest kept
+        # a hit must not rebuild: identity is stable
+        f1 = mesh_mod.cached_jit_shard_map(("t", 0), lambda: (lambda x: x))
+        f2 = mesh_mod.cached_jit_shard_map(("t", 0), lambda: (lambda x: x))
+        assert f1 is f2
+    finally:
+        mesh_mod._SHARD_MAP_CACHE.clear()
+        mesh_mod._SHARD_MAP_CACHE.update(saved)
