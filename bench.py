@@ -528,6 +528,120 @@ def _run_chip_tier(weighted: bool) -> None:
     )
 
 
+def main_roofline() -> None:
+    """Roofline micro-tier (VERDICT r2 item 5): measure the primitive rates
+    the kernel design is built on (docs/DESIGN.md "measured hardware
+    model") on the *current* backend, and report model-vs-measured.
+
+    Primitives: random 1-D int32 gather (the LPA superstep's bottleneck),
+    scatter-add, row-wise bucket sort, segment-sum. Each timed loop feeds
+    its result back through the next iteration's operand so XLA cannot
+    hoist the loop-invariant work (DESIGN.md's microbenchmark warning).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    _setup_jax_cache()
+
+    # DESIGN.md model (r1 interactive measurements this tier validates):
+    # gather ~125M slots/s, scatter-add ~135M/s, row sort ~1.6G elem/s,
+    # segment/elementwise passes HBM-class.
+    model = {
+        "gather_slots_per_sec": 125e6,
+        "scatter_add_per_sec": 135e6,
+        "row_sort_elems_per_sec": 1.6e9,
+    }
+
+    v, m = 1 << 20, 1 << 23
+    iters = 10
+    if _CPU_FALLBACK:
+        v, m, iters = 1 << 17, 1 << 20, 5
+    rng = np.random.default_rng(5)
+    idx = jnp.asarray(rng.integers(0, v, m).astype(np.int32))
+    table0 = jnp.asarray(rng.integers(0, v, v).astype(np.int32))
+
+    def timed(step, x0, elems):
+        """Steady-state rate of ``step`` chained through its own output."""
+        x = step(x0)
+        np.asarray(jax.tree_util.tree_leaves(x)[0])[:1]  # compile + settle
+        x = x0
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            x = step(x)
+        np.asarray(jax.tree_util.tree_leaves(x)[0])[:1]  # completion fetch
+        return elems * iters / (time.perf_counter() - t0)
+
+    # Random gather: the checksum write into slot 0 makes iteration i+1's
+    # gather depend on iteration i's result.
+    gather = jax.jit(lambda t: t.at[0].set(t[idx].sum() & 0x7FFFFFF))
+    gather_rate = timed(gather, table0, m)
+
+    # Scatter-add into a [V] accumulator, feedback via the accumulator.
+    scatter = jax.jit(lambda acc: acc.at[idx].add(1))
+    scatter_rate = timed(scatter, jnp.zeros((v,), jnp.int32), m)
+
+    # Row-wise sort of [n, w] buckets (the LPA mode kernel's width-class
+    # shape). XOR re-scrambles each round so every sort does real work.
+    rows = jnp.asarray(
+        rng.integers(0, 1 << 30, (m // 128, 128)).astype(np.int32)
+    )
+    row_sort = jax.jit(lambda x: jnp.sort(x ^ jnp.int32(0x5A5A5A5A), axis=-1))
+    sort_rate = timed(row_sort, rows, m)
+
+    # Segment-sum over sorted ids (the census/reduce primitive).
+    seg = jnp.sort(idx)
+    data0 = jnp.asarray(rng.integers(0, 100, m).astype(np.int32))
+    segsum = jax.jit(
+        lambda d: d.at[0].set(
+            jax.ops.segment_sum(d, seg, num_segments=v).sum() & 0x7FFFFFF
+        )
+    )
+    seg_rate = timed(segsum, data0, m)
+
+    measured = {
+        "gather_slots_per_sec": round(gather_rate),
+        "scatter_add_per_sec": round(scatter_rate),
+        "row_sort_elems_per_sec": round(sort_rate),
+        "segment_sum_elems_per_sec": round(seg_rate),
+    }
+    # The fused bucketed kernel gathers ~2.37 slots/edge on the bench graph
+    # (19.9M slots / 8.4M edges, DESIGN.md) — the gather roofline implies
+    # this ceiling on the chip tier's edges/s/chip number.
+    slots_per_edge = 19.9e6 / 8.39e6
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "roofline_gather_slots_per_sec_cpu_fallback"
+                    if _CPU_FALLBACK else "roofline_gather_slots_per_sec"
+                ),
+                "value": round(gather_rate),
+                "unit": "slots/s",
+                # ratio vs the DESIGN.md model this tier exists to validate;
+                # CPU fallback rates say nothing about the TPU model.
+                "vs_baseline": 0.0 if _CPU_FALLBACK else round(
+                    gather_rate / model["gather_slots_per_sec"], 3
+                ),
+                "detail": {
+                    "measured": measured,
+                    "model": model,
+                    "measured_vs_model": {
+                        k: round(measured[k] / model[k], 3)
+                        for k in model
+                    },
+                    "implied_lpa_ceiling_edges_per_sec": round(
+                        gather_rate / slots_per_edge
+                    ),
+                    "gather_table_elems": v,
+                    "gather_slots": m,
+                    "iters": iters,
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
 def main() -> None:
     _run_chip_tier(weighted=False)
 
@@ -543,20 +657,31 @@ def main_weighted() -> None:
 #
 # Round-1 postmortem (VERDICT.md): the driver's bench invocation produced no
 # artifact twice — once rc=1 on a flaky axon init, once a >9-minute silent
-# hang. The measurement itself is fine; the capture path wasn't. So the
-# measurement now always runs in a CHILD process under a watchdog:
+# hang. Round 2 fixed the capture path (child watchdogs, retry, scrubbed CPU
+# fallback) but captured only ONE tier and gave up probing after two
+# back-to-back attempts — so a tunnel that flapped up mid-budget was missed
+# (VERDICT r2 weak 1-2). Round 3:
 #
-#   probe TPU init (bounded) -> run tier child (bounded) -> retry once
-#   -> else scrubbed-CPU fallback at reduced scale (bounded)
-#   -> else a one-line JSON error record.
+#   * no-args `python bench.py` = --tier all: on a healthy TPU it runs EVERY
+#     tier (chip first so the driver-parsed line is always the headline),
+#     one JSON line per tier, each child bounded;
+#   * probing is SPACED across the budget (default every 3 min inside a
+#     probe window) with a timestamped reachability trace recorded in
+#     detail.capture.trace — a dead-all-round tunnel leaves proof that the
+#     environment, not the code, was the blocker;
+#   * tunnel dead: reduced-scale scrubbed-CPU fallback records for all
+#     tiers (chip first — same driver-parsed record as before).
 #
-# Every path prints exactly ONE parseable JSON line on stdout.
+# Every path prints at least one parseable JSON line on stdout, and each
+# tier's line is flushed the moment it exists (a mid-run kill loses only
+# later tiers).
 # ---------------------------------------------------------------------------
 
 _REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 
 _CHILD_TIMEOUT_S = {
     "chip": 900.0,
+    "roofline": 900.0,
     "northstar": 2700.0,
     "lof": 1200.0,
     "snap": 2400.0,
@@ -564,6 +689,22 @@ _CHILD_TIMEOUT_S = {
     "weighted": 900.0,
     "stream": 1200.0,
 }
+
+# Healthy-TPU capture order: chip first (the driver parses the first line),
+# roofline second (validates the hardware model right next to the chip
+# number), then the remaining tiers by evidence value.
+_TIER_ORDER = [
+    "chip", "roofline", "northstar", "lof", "snap", "quality", "weighted",
+    "stream",
+]
+# Dead-tunnel fallback order: every tier has a reduced-scale CPU variant
+# except roofline (CPU primitive rates say nothing about the TPU model).
+_FALLBACK_TIERS = [
+    "chip", "northstar", "lof", "snap", "quality", "weighted", "stream",
+]
+
+# Indirection so orchestration tests can stub the inter-probe wait.
+_sleep = time.sleep
 
 
 def _virtual_cpu_env(n_devices):
@@ -662,110 +803,246 @@ def _run_backend_audit(timeout_s=300.0):
     return f"rc={p.returncode}: {tail[0][:200] if tail else 'no output'}"
 
 
+def _print_record(record):
+    print(json.dumps(record), flush=True)
+
+
+def _print_error_record(tier, reasons):
+    _print_record({
+        "metric": f"bench_{tier}_capture_failed",
+        "value": 0.0,
+        "unit": "error",
+        "vs_baseline": 0.0,
+        "error": "; ".join(reasons)[:800],
+    })
+
+
 def orchestrate(tier):
-    timeout_s = _CHILD_TIMEOUT_S.get(tier, 900.0)
-    # Overall wall-clock budget: the capture must terminate well inside any
-    # external driver deadline even in the worst retry sequence. Defaults
-    # to the tier's own timeout plus room for probes + the CPU fallback
-    # (which always has ~300s reserved at the end).
-    budget_s = float(
-        os.environ.get("GRAPHMINE_BENCH_BUDGET", str(timeout_s + 900.0))
-    )
+    """Capture driver. ``tier`` is a tier name or ``"all"`` (the no-args
+    default): all-tiers on a healthy TPU, all-tiers reduced-scale CPU
+    fallback on a dead tunnel. Returns 0 if at least one real measurement
+    record was printed."""
+    all_mode = tier == "all"
+    if all_mode:
+        # Healthy-TPU tiers are minutes each (persistent compile cache);
+        # the budget covers the realistic sum, not the worst-case child
+        # timeouts. Each tier's line flushes on completion, so even an
+        # external kill mid-run keeps everything captured so far.
+        budget_s = float(os.environ.get("GRAPHMINE_BENCH_BUDGET", "5400"))
+        fallback_reserve = 1500.0
+    else:
+        timeout_s = _CHILD_TIMEOUT_S.get(tier, 900.0)
+        budget_s = float(
+            os.environ.get("GRAPHMINE_BENCH_BUDGET", str(timeout_s + 900.0))
+        )
+        fallback_reserve = 420.0
     t_start = time.perf_counter()
 
-    def remaining(reserve=300.0):
-        return budget_s - reserve - (time.perf_counter() - t_start)
+    def elapsed():
+        return time.perf_counter() - t_start
 
-    reasons = []
-    record = None
-    attempts = 0
+    def remaining(reserve=0.0):
+        return budget_s - reserve - elapsed()
+
+    # --- reachability: spaced probes across the window (VERDICT r2 #2) ---
+    trace = []
+
+    def probe_and_log():
+        ok, platform, info = _probe_tpu()
+        trace.append({
+            "t": round(elapsed(), 1),
+            "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "ok": ok,
+            "info": info,
+        })
+        return ok, platform, info
+
+    probe_interval = max(1.0, float(
+        os.environ.get("GRAPHMINE_BENCH_PROBE_INTERVAL", "180")
+    ))
+    probe_timeout = float(
+        os.environ.get("GRAPHMINE_BENCH_PROBE_TIMEOUT", "120")
+    )
+    probe_window = float(os.environ.get(
+        "GRAPHMINE_BENCH_PROBE_WINDOW",
+        str(min(1380.0, max(0.0, budget_s - fallback_reserve))),
+    ))
+    max_probes = max(1, int(probe_window / probe_interval) + 1)
+
+    probe_reasons = []
+    ok = False
     platform = None
     tpu_info = None
-    for attempt in (1, 2):
-        if remaining() < 60.0:
-            reasons.append(f"attempt{attempt}: skipped, budget exhausted")
-            break
-        ok, platform, info = _probe_tpu()
-        if not ok:
-            reasons.append(f"probe{attempt}: {info}")
+    if remaining(fallback_reserve) < 60.0:
+        probe_reasons.append("probe: skipped, budget exhausted")
+    else:
+        for n in range(max_probes):
+            t_probe = elapsed()
+            ok, platform, info = probe_and_log()
+            if ok:
+                tpu_info = info
+                break
+            probe_reasons.append(f"probe{n + 1}@{int(t_probe)}s: {info}")
+            next_start = t_probe + probe_interval
+            if (
+                next_start + probe_timeout > probe_window
+                or remaining(fallback_reserve) < probe_interval + probe_timeout
+            ):
+                break
+            _sleep(max(0.0, next_start - elapsed()))
+
+    printed_real = 0
+
+    def finish_capture(first, fallback, failures):
+        """Capture annotation for one tier's record. Only the FIRST record
+        carries the probe trace and probe-phase failures; later tiers
+        report their own failures only (clean tiers report none)."""
+        cap = {
+            "attempts": 0,
+            "platform": platform,
+            "tpu_probe": tpu_info,
+            "cpu_fallback": fallback,
+            "failures": (probe_reasons + failures if first else failures)
+            or None,
+        }
+        if first:
+            cap["trace"] = trace
+        return cap
+
+    # --- healthy-TPU path: every tier, chip first ------------------------
+    if ok and platform == "tpu":
+        backend_dead = False
+        tiers = _TIER_ORDER if all_mode else [tier]
+        for i, t in enumerate(tiers):
+            first = i == 0
+            t_timeout = _CHILD_TIMEOUT_S.get(t, 900.0)
+            if backend_dead:
+                _print_error_record(
+                    t, ["skipped: backend unreachable mid-capture"]
+                )
+                continue
+            if remaining() < 120.0:
+                _print_error_record(t, ["skipped: budget exhausted"])
+                continue
+            tier_reasons = []
+            record = None
+            attempts = 0
+            for attempt in (1, 2):
+                if attempt == 2:
+                    # Re-probe before burning another child timeout: a
+                    # tunnel that died mid-capture fails fast here and
+                    # marks the remaining tiers skipped instead of each
+                    # eating its own timeout.
+                    ok2, plat2, info2 = probe_and_log()
+                    if not ok2 or plat2 != "tpu":
+                        tier_reasons.append(f"reprobe: {info2}")
+                        backend_dead = True
+                        break
+                attempts = attempt
+                record, err = _run_child(
+                    t, dict(os.environ),
+                    min(t_timeout, max(remaining(60.0), 60.0)),
+                )
+                if record is not None:
+                    break
+                tier_reasons.append(f"run{attempt}: {err}")
+            fallback = None
+            if record is None and first:
+                # The driver parses the FIRST line: guarantee it exists via
+                # the scrubbed reduced-scale CPU fallback (r2 behavior).
+                env = _virtual_cpu_env(1)
+                env["GRAPHMINE_BENCH_CPU_FALLBACK"] = "1"
+                record, err = _run_child(
+                    t, env, min(t_timeout, max(remaining(), 120.0))
+                )
+                if record is not None:
+                    fallback = (
+                        "; ".join(probe_reasons + tier_reasons)
+                        or "tpu unreachable"
+                    )
+                else:
+                    tier_reasons.append(f"cpu-fallback: {err}")
+            if record is None:
+                # Even a dead FIRST tier must not abort the suite: the
+                # backend is up and later tiers may still capture — the
+                # driver-parsed first line is then this error record.
+                _print_error_record(
+                    t,
+                    (probe_reasons + tier_reasons if first else tier_reasons)
+                    or ["no record"],
+                )
+                continue
+            cap = finish_capture(first, fallback, tier_reasons)
+            cap["attempts"] = attempts
+            # Cross-backend numerical audit rides the healthy chip capture
+            # (a CPU fallback would vacuously compare CPU against itself).
+            if (
+                t == "chip"
+                and fallback is None
+                and os.environ.get("GRAPHMINE_BENCH_AUDIT", "1") != "0"
+                and remaining() > 330.0
+            ):
+                cap["backend_audit"] = _run_backend_audit(
+                    timeout_s=min(300.0, remaining() - 30.0)
+                )
+            record.setdefault("detail", {})["capture"] = cap
+            _print_record(record)
+            printed_real += 1
+        return 0 if printed_real else 1
+
+    # --- dead tunnel / CPU-only environment: reduced-scale fallback ------
+    if ok and platform != "tpu":
+        # No accelerator here: don't run full-scale tiers under the TPU
+        # metric names (and don't burn the budget on e.g. a 100M-edge CPU
+        # northstar) — go straight to honest reduced-scale records.
+        probe_reasons.append(f"probe: default backend is '{platform}', not tpu")
+    env = _virtual_cpu_env(1)
+    env["GRAPHMINE_BENCH_CPU_FALLBACK"] = "1"
+    fb_tiers = _FALLBACK_TIERS if all_mode else [tier]
+    fallback_msg = "; ".join(probe_reasons) or "tpu unreachable"
+    for i, t in enumerate(fb_tiers):
+        first = i == 0
+        t_timeout = _CHILD_TIMEOUT_S.get(t, 900.0)
+        if not first and remaining() < 180.0:
+            _print_error_record(t, ["skipped: budget exhausted"])
             continue
-        tpu_info = info
-        if platform != "tpu":
-            # No accelerator in this environment: don't run the full-scale
-            # tier under the TPU metric name (and don't burn the budget on
-            # e.g. a 100M-edge CPU northstar) — go straight to the honest
-            # reduced-scale CPU-fallback record.
-            reasons.append(f"probe{attempt}: default backend is "
-                           f"'{platform}', not tpu")
-            break
-        attempts = attempt
         record, err = _run_child(
-            tier, dict(os.environ), min(timeout_s, max(remaining(), 60.0))
+            t, env, min(t_timeout, max(remaining(), 120.0))
         )
-        if record is not None:
-            break
-        reasons.append(f"run{attempt}: {err}")
-
-    fallback = None
-    if record is None:
-        # Degraded capture on a scrubbed single-device CPU: a smaller but
-        # real measurement with the failure reasons attached beats rc=124
-        # with no artifact (round-1's outcome).
-        env = _virtual_cpu_env(1)
-        env["GRAPHMINE_BENCH_CPU_FALLBACK"] = "1"
-        record, err = _run_child(
-            tier, env, min(timeout_s, max(remaining(reserve=0.0), 120.0))
+        if record is None:
+            # A dead first fallback tier still must not abort the suite:
+            # later reduced-scale tiers may succeed on their own.
+            _print_error_record(
+                t,
+                (probe_reasons + [f"cpu-fallback: {err}"]) if first
+                else [f"cpu-fallback: {err}"],
+            )
+            continue
+        record.setdefault("detail", {})["capture"] = finish_capture(
+            first, fallback_msg, []
         )
-        if record is not None:
-            fallback = "; ".join(reasons) or "tpu unreachable"
-        else:
-            reasons.append(f"cpu-fallback: {err}")
-
-    if record is None:
-        print(json.dumps({
-            "metric": f"bench_{tier}_capture_failed",
-            "value": 0.0,
-            "unit": "error",
-            "vs_baseline": 0.0,
-            "error": "; ".join(reasons)[:800],
-        }))
-        return 1
-
-    capture = {
-        "attempts": attempts,
-        "platform": platform,
-        "tpu_probe": tpu_info,
-        "cpu_fallback": fallback,
-        "failures": reasons or None,
-    }
-    # Cross-backend audit: only on a capture whose default backend really
-    # is the TPU (vs CPU the audit would vacuously compare CPU against
-    # itself) and with wall-clock budget left for its ~300s worst case.
-    if (
-        fallback is None
-        and platform == "tpu"
-        and tier == "chip"
-        and os.environ.get("GRAPHMINE_BENCH_AUDIT", "1") != "0"
-        and remaining(reserve=0.0) > 330.0
-    ):
-        capture["backend_audit"] = _run_backend_audit(
-            timeout_s=min(300.0, remaining(reserve=0.0) - 30.0)
-        )
-    record.setdefault("detail", {})["capture"] = capture
-    print(json.dumps(record))
-    return 0
+        _print_record(record)
+        printed_real += 1
+    return 0 if printed_real else 1
 
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--tier",
-        choices=["chip", "northstar", "lof", "snap", "quality", "weighted", "stream"],
-        default="chip",
+        choices=[
+            "all", "chip", "roofline", "northstar", "lof", "snap", "quality",
+            "weighted", "stream",
+        ],
+        # No-args (the driver's invocation) = the full evidence suite: one
+        # healthy TPU window turns every README performance claim into a
+        # driver-captured record (VERDICT r2 item 1).
+        default="all",
     )
     args = ap.parse_args()
     _TIERS = {
         "chip": main,
+        "roofline": main_roofline,
         "northstar": main_northstar,
         "lof": main_lof,
         "snap": main_snap,
@@ -774,6 +1051,14 @@ if __name__ == "__main__":
         "stream": main_stream,
     }
     if os.environ.get("_GRAPHMINE_BENCH_CHILD") == "1":
-        _TIERS[args.tier]()
+        fn = _TIERS.get(args.tier)
+        if fn is None:
+            # A leaked _GRAPHMINE_BENCH_CHILD with the "all" default must
+            # still print a parseable line, not die on a KeyError.
+            _print_error_record(
+                args.tier, [f"tier {args.tier!r} is not a measurement tier"]
+            )
+            sys.exit(2)
+        fn()
     else:
         sys.exit(orchestrate(args.tier))

@@ -25,12 +25,15 @@ class PipelineConfig:
     # engine (the plugin boundary from BASELINE.json)
     backend: str = "jax"  # jax | graphframes
     num_devices: int | None = None  # None = all visible (local[*] parity, :12)
-    # Multi-device LPA schedule: "replicated" gathers the full V-length
-    # label vector per superstep (fastest to ~100M vertices); "ring" keeps
-    # labels fully sharded and rotates chunks over ICI via ppermute —
-    # O(V/D + M/D) memory per device, the scalable path for graphs whose
-    # label vector doesn't fit replicated (parallel/ring.py).
-    schedule: str = "replicated"  # replicated | ring
+    # Multi-device LPA schedule: "auto" (default, r3) consults the memory
+    # planner (pipeline/planner.py) and picks the fastest schedule that
+    # fits per-device HBM — single-device fused kernel, else "replicated"
+    # (gathers the full V-length label vector per superstep; fastest to
+    # ~100M vertices), else "ring" (labels stay sharded, chunks rotate
+    # over ICI via ppermute — O(V/D + M/D) per device). Explicit
+    # "replicated"/"ring" are honored but still planner-checked: an
+    # impossible config fails loudly at plan time, not inside XLA.
+    schedule: str = "auto"  # auto | replicated | ring
     # community detection
     community_method: str = "lpa"  # lpa (Graphframes.py:81 parity) | louvain | leiden
     max_iter: int = 5  # Graphframes.py:81
@@ -50,6 +53,11 @@ class PipelineConfig:
     profile_dir: str | None = None  # jax.profiler trace output
     # checkpoint / resume
     checkpoint_dir: str | None = None
+    # Save every N supersteps (plus always the final one). 1 = every
+    # superstep — right for maxIter=5 parity runs; long billion-edge runs
+    # (the case checkpointing exists for, SURVEY §5) should raise it: at
+    # north-star scale each save is a ~64 MB npz.
+    checkpoint_every: int = 1
     resume: bool = False
 
     def validate(self) -> "PipelineConfig":
@@ -57,7 +65,7 @@ class PipelineConfig:
             raise ValueError(f"unknown data_format {self.data_format!r}")
         if self.backend not in ("jax", "graphframes"):
             raise ValueError(f"unknown backend {self.backend!r}")
-        if self.schedule not in ("replicated", "ring"):
+        if self.schedule not in ("auto", "replicated", "ring"):
             raise ValueError(f"unknown schedule {self.schedule!r}")
         if self.outlier_method not in ("recursive_lpa", "lof", "both", "none"):
             raise ValueError(f"unknown outlier_method {self.outlier_method!r}")
@@ -83,6 +91,8 @@ class PipelineConfig:
             )
         if not 0 < self.decile < 1:
             raise ValueError("decile must be in (0, 1)")
+        if self.checkpoint_every < 1:
+            raise ValueError("checkpoint_every must be >= 1")
         return self
 
 

@@ -65,17 +65,35 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
     )
 
     # ---- CS-2 graph construction ---------------------------------------
+    # Schedule resolution happens HERE, before any device allocation: the
+    # memory planner (pipeline/planner.py) models per-device HBM for each
+    # schedule and either picks one ("auto") or validates the requested
+    # one — an impossible config raises PlanError with the numbers now,
+    # instead of OOMing deep inside XLA after minutes of graph build.
+    n_dev = config.num_devices or _visible_devices()
+    run_plan = None
+    if config.community_method == "lpa" and config.backend != "graphframes":
+        from graphmine_tpu.pipeline.planner import plan_run
+
+        run_plan = plan_run(
+            table.num_vertices,
+            table.num_edges,
+            n_dev,
+            weighted=table.weights is not None,
+            requested=config.schedule,
+        )
+        m.emit(
+            "plan",
+            schedule=run_plan.schedule,
+            bytes_per_device=run_plan.bytes_per_device,
+            hbm_budget=run_plan.hbm_bytes,
+            reason=run_plan.reason,
+        )
     # The fused LPA plan is only consumed by the single-device jax LPA
     # path; build it (from the same message-CSR pass as the Graph) only
     # when that path will run — it is pure HBM/host waste for louvain,
-    # graphframes, and sharded runs. n_dev is resolved once here and passed
-    # to _run_lpa so the build-plan and use-plan predicates cannot diverge.
-    n_dev = config.num_devices or _visible_devices()
-    wants_plan = (
-        config.community_method == "lpa"
-        and config.backend != "graphframes"
-        and n_dev <= 1
-    )
+    # graphframes, and sharded runs.
+    wants_plan = run_plan is not None and run_plan.schedule == "single"
     with m.timed("build_graph"):
         if wants_plan:
             from graphmine_tpu.ops.bucketed_mode import build_graph_and_plan
@@ -99,7 +117,7 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
         with m.timed(config.community_method, gamma=config.gamma):
             labels, q = algo(graph, gamma=config.gamma)
     else:
-        labels = _run_lpa(config, table, graph, m, mode_plan, n_dev)
+        labels = _run_lpa(config, table, graph, m, mode_plan, n_dev, run_plan)
         q = None
 
     # ---- CS-4 census ----------------------------------------------------
@@ -171,7 +189,7 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
 
 def _run_lpa(
     config: PipelineConfig, table: EdgeTable, graph: Graph, m: MetricsSink,
-    mode_plan, n_dev: int,
+    mode_plan, n_dev: int, run_plan,
 ):
     """Community detection with backend dispatch, checkpointing and
     per-iteration metrics. Runs iterations one jit call at a time so the
@@ -218,11 +236,12 @@ def _run_lpa(
             labels = jnp.asarray(saved_labels, dtype=jnp.int32)
             m.emit("resume", iteration=start_iter)
 
-    use_sharded = n_dev > 1
-    if config.schedule == "ring" and not use_sharded:
+    # Dispatch on the planner-resolved schedule (plan_run maps an explicit
+    # "ring"/"replicated" request on one device to "single").
+    if config.schedule == "ring" and run_plan.schedule == "single":
         m.emit("warning", message="schedule='ring' needs >1 device; "
                "running the single-device fused kernel instead")
-    if use_sharded and config.schedule == "ring":
+    if run_plan.schedule == "ring":
         # Memory-scalable schedule: labels stay sharded, chunks rotate
         # over ICI (parallel/ring.py). Uses the sort-body message CSR.
         from graphmine_tpu.parallel.ring import ring_label_propagation
@@ -234,13 +253,13 @@ def _run_lpa(
         def one_iter(lbl):
             return ring_label_propagation(sg, mesh, max_iter=1, init_labels=lbl)
 
-    elif use_sharded:
+    elif run_plan.schedule == "replicated":
         mesh = make_mesh(n_dev)
         with m.timed("partition", shards=n_dev, schedule="replicated"):
             sg = shard_graph_arrays(
                 partition_graph(graph, mesh=mesh, build_bucket_plan=True),
                 mesh,
-                lpa_only=True,
+                lpa_only=run_plan.lpa_only,
             )
 
         def one_iter(lbl):
@@ -271,7 +290,12 @@ def _run_lpa(
             changed = int((new != labels).sum())
             labels = new
             m.lpa_iteration(it + 1, changed, graph.num_edges, dt, chips)
-            if config.checkpoint_dir:
+            # Cadence (r3): every Nth superstep, plus always the final one
+            # so a completed run's checkpoint is never stale.
+            if config.checkpoint_dir and (
+                (it + 1) % config.checkpoint_every == 0
+                or it + 1 == config.max_iter
+            ):
                 ckpt.save_labels(
                     config.checkpoint_dir, labels, it + 1, fingerprint=fingerprint
                 )

@@ -187,16 +187,109 @@ def test_orchestrate_cpu_platform_goes_straight_to_fallback(monkeypatch, capsys)
 
 
 def test_orchestrate_total_failure_emits_error_record(monkeypatch, capsys):
+    """All probes and the fallback dead: spaced re-probes burn the probe
+    window (with inter-probe sleeps) and the error record still prints."""
     def always_timeout(*a, **k):
         raise subprocess.TimeoutExpired(cmd="x", timeout=1)
 
+    sleeps = []
     monkeypatch.setattr(bench.subprocess, "run", always_timeout)
+    monkeypatch.setattr(bench, "_sleep", sleeps.append)
     monkeypatch.delenv("GRAPHMINE_BENCH_BUDGET", raising=False)
     rc = bench.orchestrate("chip")
     assert rc == 1
     rec = json.loads(capsys.readouterr().out.strip())
     assert rec["metric"] == "bench_chip_capture_failed"
     assert rec["value"] == 0.0 and "error" in rec
+    # spaced probing actually happened: multiple probes, sleeps between
+    assert len(sleeps) >= 2 and all(0 <= s <= 180 for s in sleeps)
+    assert rec["error"].count("probe") >= 3
+
+
+def test_orchestrate_all_healthy_prints_every_tier_chip_first(
+    monkeypatch, capsys
+):
+    """A healthy TPU window captures the whole evidence suite: one JSON
+    line per tier, chip first (the driver parses the first line), full
+    reachability trace + audit attached to the chip record only."""
+    script = [_probe_ok()]
+    for t in bench._TIER_ORDER:
+        script.append(_Proc(stdout=_record(f"{t}_result") + "\n"))
+    # audit runs after the chip child, before the chip record prints
+    script.insert(2, _Proc(returncode=0, stdout="all backends agree\n"))
+    run, calls = _fake_runner(script)
+    monkeypatch.setattr(bench.subprocess, "run", run)
+    monkeypatch.delenv("GRAPHMINE_BENCH_AUDIT", raising=False)
+    monkeypatch.delenv("GRAPHMINE_BENCH_BUDGET", raising=False)
+    rc = bench.orchestrate("all")
+    assert rc == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    recs = [json.loads(l) for l in lines]
+    assert [r["metric"] for r in recs] == [
+        f"{t}_result" for t in bench._TIER_ORDER
+    ]
+    chip_cap = recs[0]["detail"]["capture"]
+    assert chip_cap["backend_audit"] == "agree"
+    assert chip_cap["trace"] and chip_cap["trace"][0]["ok"]
+    assert "utc" in chip_cap["trace"][0]
+    for r in recs[1:]:
+        cap = r["detail"]["capture"]
+        assert cap["platform"] == "tpu" and "trace" not in cap
+
+
+def test_orchestrate_all_dead_tunnel_fallback_all_tiers(monkeypatch, capsys):
+    """Tunnel dead all round: reduced-scale CPU fallback records for every
+    fallback tier, chip first, with the probe trace proving the
+    environment (not the code) was the blocker."""
+    script = ["timeout"]  # single probe (window shrunk below)
+    for t in bench._FALLBACK_TIERS:
+        script.append(_Proc(stdout=_record(f"{t}_fb") + "\n"))
+    run, calls = _fake_runner(script)
+    monkeypatch.setattr(bench.subprocess, "run", run)
+    monkeypatch.setattr(bench, "_sleep", lambda s: None)
+    monkeypatch.setenv("GRAPHMINE_BENCH_PROBE_WINDOW", "0")
+    monkeypatch.delenv("GRAPHMINE_BENCH_BUDGET", raising=False)
+    rc = bench.orchestrate("all")
+    assert rc == 0
+    recs = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert [r["metric"] for r in recs] == [
+        f"{t}_fb" for t in bench._FALLBACK_TIERS
+    ]
+    cap = recs[0]["detail"]["capture"]
+    assert cap["cpu_fallback"] and "timed out" in cap["cpu_fallback"]
+    assert cap["trace"] and not cap["trace"][0]["ok"]
+    # roofline is TPU-model validation: absent from the fallback suite
+    assert not any("roofline" in r["metric"] for r in recs)
+    # every fallback child ran scrubbed with the reduced-scale flag
+    for _, env in calls[1:]:
+        assert env["GRAPHMINE_BENCH_CPU_FALLBACK"] == "1"
+
+
+def test_orchestrate_all_backend_death_mid_capture_skips_rest(
+    monkeypatch, capsys
+):
+    """Tunnel dies between tiers: the failing tier re-probes, detects the
+    dead backend fast, and the remaining tiers are marked skipped instead
+    of each eating its own child timeout."""
+    script = [
+        _probe_ok(),
+        _Proc(stdout=_record("chip_ok") + "\n"),       # chip
+        "timeout",                                     # roofline run1
+        "timeout",                                     # reprobe -> dead
+    ]
+    run, calls = _fake_runner(script)
+    monkeypatch.setattr(bench.subprocess, "run", run)
+    monkeypatch.setenv("GRAPHMINE_BENCH_AUDIT", "0")
+    monkeypatch.delenv("GRAPHMINE_BENCH_BUDGET", raising=False)
+    rc = bench.orchestrate("all")
+    assert rc == 0  # chip's real record landed
+    recs = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert recs[0]["metric"] == "chip_ok"
+    assert recs[1]["metric"] == "bench_roofline_capture_failed"
+    for r, t in zip(recs[2:], bench._TIER_ORDER[2:]):
+        assert r["metric"] == f"bench_{t}_capture_failed"
+        assert "unreachable mid-capture" in r["error"]
+    assert len(recs) == len(bench._TIER_ORDER)
 
 
 def test_orchestrate_budget_skips_attempts(monkeypatch, capsys):
@@ -213,3 +306,61 @@ def test_orchestrate_budget_skips_attempts(monkeypatch, capsys):
     cap = rec["detail"]["capture"]
     assert any("budget exhausted" in f for f in cap["failures"])
     assert len(calls) == 1  # no probes, straight to fallback
+
+
+def test_orchestrate_all_first_tier_total_failure_does_not_abort_suite(
+    monkeypatch, capsys
+):
+    """Healthy backend but the chip tier is broken (both attempts + CPU
+    fallback): the suite must continue — the driver-parsed first line is
+    the chip error record, and every later tier still captures."""
+    script = [
+        _probe_ok(),
+        _Proc(returncode=1),   # chip run1
+        _probe_ok(),           # reprobe before retry
+        _Proc(returncode=1),   # chip run2
+        _Proc(returncode=1),   # chip cpu fallback
+    ]
+    for t in bench._TIER_ORDER[1:]:
+        script.append(_Proc(stdout=_record(f"{t}_result") + "\n"))
+    run, calls = _fake_runner(script)
+    monkeypatch.setattr(bench.subprocess, "run", run)
+    monkeypatch.setenv("GRAPHMINE_BENCH_AUDIT", "0")
+    monkeypatch.delenv("GRAPHMINE_BENCH_BUDGET", raising=False)
+    rc = bench.orchestrate("all")
+    assert rc == 0  # later tiers produced real records
+    recs = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert recs[0]["metric"] == "bench_chip_capture_failed"
+    assert "run1" in recs[0]["error"] and "cpu-fallback" in recs[0]["error"]
+    assert [r["metric"] for r in recs[1:]] == [
+        f"{t}_result" for t in bench._TIER_ORDER[1:]
+    ]
+
+
+def test_orchestrate_all_clean_tiers_do_not_inherit_failures(
+    monkeypatch, capsys
+):
+    """A retry on one tier must not annotate every later clean tier's
+    capture.failures (the failure list is per-tier, probe-phase reasons
+    ride only the first record)."""
+    script = [
+        _probe_ok(),
+        _Proc(returncode=1),                          # chip run1 fails
+        _probe_ok(),                                  # reprobe
+        _Proc(stdout=_record("chip_ok") + "\n"),      # chip run2 succeeds
+    ]
+    for t in bench._TIER_ORDER[1:]:
+        script.append(_Proc(stdout=_record(f"{t}_result") + "\n"))
+    run, calls = _fake_runner(script)
+    monkeypatch.setattr(bench.subprocess, "run", run)
+    monkeypatch.setenv("GRAPHMINE_BENCH_AUDIT", "0")
+    monkeypatch.delenv("GRAPHMINE_BENCH_BUDGET", raising=False)
+    rc = bench.orchestrate("all")
+    assert rc == 0
+    recs = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert recs[0]["metric"] == "chip_ok"
+    assert recs[0]["detail"]["capture"]["failures"] == [
+        "run1: measurement child rc=1"
+    ]
+    for r in recs[1:]:
+        assert r["detail"]["capture"]["failures"] is None
