@@ -200,6 +200,125 @@ def main_lof() -> None:
     )
 
 
+def _run_snap_rung(
+    name, data_dir, max_scale, build_graph_and_plan, lpa_superstep_bucketed
+):
+    """Measure one ladder rung; returns its record dict.
+
+    Schedules via the memory planner: small rungs run the single-device
+    fused kernel; a rung too big for one chip (the Twitter-2010 top rung)
+    dispatches to the planner-selected replicated/ring schedule over the
+    visible mesh — the same dispatch the pipeline driver uses — and a rung
+    no schedule fits gets a numeric ``skipped`` record, never a crash."""
+    import jax
+    import jax.numpy as jnp
+
+    from graphmine_tpu.datasets import load, snap_path
+    from graphmine_tpu.ops.cc import connected_components
+    from graphmine_tpu.ops.louvain import louvain
+    from graphmine_tpu.ops.lpa import num_communities
+    from graphmine_tpu.pipeline.planner import PlanError, plan_run
+
+    real = snap_path(name, data_dir) is not None
+    et = load(name, data_dir=data_dir, max_scale=max_scale)
+    v, e = et.num_vertices, int(len(et.src))
+    base = {
+        "rung": name,
+        "source": "snap" if real else "rmat-standin",
+        "vertices": v,
+        "edges": e,
+    }
+
+    try:
+        rp = plan_run(v, e, len(jax.devices()))
+    except PlanError as ex:
+        return dict(base, skipped=str(ex)[:400])
+
+    if rp.schedule != "single":
+        # Multi-device rung: planner-selected replicated/ring schedule.
+        # EVERY per-rung op stays distributed (LPA *and* CC) — the planner
+        # just said the unsharded graph does not fit one device, so the
+        # single-device connected_components below would OOM after a
+        # successful LPA. Keeps the full shard set (no lpa_only trimming):
+        # the sharded CC bodies read the sort-body message CSR.
+        from graphmine_tpu.graph.container import build_graph
+        from graphmine_tpu.parallel.mesh import make_mesh
+        from graphmine_tpu.parallel.ring import (
+            ring_connected_components,
+            ring_label_propagation,
+        )
+        from graphmine_tpu.parallel.sharded import (
+            partition_graph,
+            shard_graph_arrays,
+            sharded_connected_components,
+            sharded_label_propagation,
+        )
+
+        t0 = time.perf_counter()
+        graph = build_graph(et.src, et.dst, num_vertices=v)
+        mesh = make_mesh()
+        sg = shard_graph_arrays(
+            partition_graph(
+                graph, mesh=mesh,
+                build_bucket_plan=rp.schedule == "replicated",
+            ),
+            mesh,
+        )
+        t_build = time.perf_counter() - t0
+        ring = rp.schedule == "ring"
+        lp = ring_label_propagation if ring else sharded_label_propagation
+        cc_fn = (
+            ring_connected_components if ring else sharded_connected_components
+        )
+        labels = lp(sg, mesh, max_iter=1)  # compile + settle
+        np.asarray(labels[:4])
+        t0 = time.perf_counter()
+        labels = lp(sg, mesh, max_iter=5)
+        np.asarray(labels[:4])
+        t_lpa = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        cc = cc_fn(sg, mesh)
+        n_cc = int(num_communities(cc))
+        t_cc = time.perf_counter() - t0
+    else:
+        t0 = time.perf_counter()
+        graph, plan = build_graph_and_plan(et.src, et.dst, num_vertices=v)
+        t_build = time.perf_counter() - t0
+
+        step = jax.jit(lpa_superstep_bucketed)
+        labels = step(jnp.arange(v, dtype=jnp.int32), graph, plan)
+        np.asarray(labels[:4])  # compile + settle
+        labels = jnp.arange(v, dtype=jnp.int32)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            labels = step(labels, graph, plan)
+        np.asarray(labels[:4])
+        t_lpa = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        cc = connected_components(graph)
+        n_cc = int(num_communities(cc))
+        t_cc = time.perf_counter() - t0
+
+    rec = dict(
+        base,
+        schedule=rp.schedule,
+        build_seconds=round(t_build, 2),
+        lpa5_seconds=round(t_lpa, 3),
+        lpa_edges_per_sec=round(e * 5 / t_lpa),
+        lpa_communities=int(num_communities(labels)),
+        cc_seconds=round(t_cc, 2),
+        components=n_cc,
+    )
+    if e <= 2_000_000:
+        t0 = time.perf_counter()
+        _, q = louvain(graph)
+        rec["louvain_seconds"] = round(time.perf_counter() - t0, 2)
+        rec["louvain_modularity"] = round(float(q), 4)
+    return rec
+
+
 def main_snap() -> None:
     """SNAP ladder tier (BASELINE.json "configs"; VERDICT r1 item 4).
 
@@ -229,52 +348,37 @@ def main_snap() -> None:
     if _CPU_FALLBACK:
         rungs = rungs[:2]
         max_scale = 16
+    elif snap_path("twitter-2010", data_dir) is not None:
+        # Top rung (r3): Twitter-2010 (1.4B edges) runs end-to-end when the
+        # real file is present — streaming native ingestion (io/edges.py
+        # chunked parse), then planner-dispatched LPA (single chip cannot
+        # hold 1.4B edges; the planner routes to replicated/ring over the
+        # visible mesh or records a numeric rejection). Never synthesized:
+        # an R-MAT stand-in at this scale would claim top-rung evidence
+        # the hardware didn't produce.
+        rungs.append("twitter-2010")
     out = []
     for name in rungs:
-        real = snap_path(name, data_dir) is not None
-        et = load(name, data_dir=data_dir, max_scale=max_scale)
-        v, e = et.num_vertices, int(len(et.src))
-
-        t0 = time.perf_counter()
-        graph, plan = build_graph_and_plan(et.src, et.dst, num_vertices=v)
-        t_build = time.perf_counter() - t0
-
-        step = jax.jit(lpa_superstep_bucketed)
-        labels = step(jnp.arange(v, dtype=jnp.int32), graph, plan)
-        np.asarray(labels[:4])  # compile + settle
-        labels = jnp.arange(v, dtype=jnp.int32)
-        t0 = time.perf_counter()
-        for _ in range(5):
-            labels = step(labels, graph, plan)
-        np.asarray(labels[:4])
-        t_lpa = time.perf_counter() - t0
-
-        t0 = time.perf_counter()
-        cc = connected_components(graph)
-        n_cc = int(num_communities(cc))
-        t_cc = time.perf_counter() - t0
-
-        rec = {
-            "rung": name,
-            "source": "snap" if real else "rmat-standin",
-            "vertices": v,
-            "edges": e,
-            "build_seconds": round(t_build, 2),
-            "lpa5_seconds": round(t_lpa, 3),
-            "lpa_edges_per_sec": round(e * 5 / t_lpa),
-            "lpa_communities": int(num_communities(labels)),
-            "cc_seconds": round(t_cc, 2),
-            "components": n_cc,
-        }
-        if e <= 2_000_000:
-            t0 = time.perf_counter()
-            _, q = louvain(graph)
-            rec["louvain_seconds"] = round(time.perf_counter() - t0, 2)
-            rec["louvain_modularity"] = round(float(q), 4)
+        rec = _run_snap_rung(
+            name, data_dir, max_scale, build_graph_and_plan,
+            lpa_superstep_bucketed,
+        )
         out.append(rec)
         print(json.dumps({"progress": rec}), file=sys.stderr, flush=True)
 
-    top = out[-1]
+    measured = [r for r in out if "lpa_edges_per_sec" in r]
+    if not measured:
+        # Every rung planner-skipped (e.g. a tiny GRAPHMINE_HBM_BYTES):
+        # still print a parseable record carrying the numeric reasons.
+        print(json.dumps({
+            "metric": "snap_ladder_all_rungs_skipped",
+            "value": 0.0,
+            "unit": "edges/s",
+            "vs_baseline": 0.0,
+            "detail": {"rungs": out, "data_dir": data_dir},
+        }))
+        return
+    top = measured[-1]  # a planner-skipped top rung never carries the headline
     eps = top["lpa_edges_per_sec"]
     print(
         json.dumps(
