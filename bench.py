@@ -270,7 +270,11 @@ def _run_snap_rung(
         cc_fn = (
             ring_connected_components if ring else sharded_connected_components
         )
-        labels = lp(sg, mesh, max_iter=1)  # compile + settle
+        # Warm up with the SAME static signature as the timed call:
+        # max_iter is a static argument of the jitted scan program, so a
+        # max_iter=1 warm-up would leave the max_iter=5 compile inside the
+        # timed region.
+        labels = lp(sg, mesh, max_iter=5)
         np.asarray(labels[:4])
         t0 = time.perf_counter()
         labels = lp(sg, mesh, max_iter=5)

@@ -63,6 +63,25 @@ def _isnull(col: np.ndarray) -> np.ndarray:
     return np.zeros(len(col), dtype=bool)
 
 
+def edge_table_from_parts(
+    src_parts, dst_parts, names, num_rows_raw, w_parts=None
+) -> EdgeTable:
+    """Assemble an EdgeTable from per-chunk/per-batch part lists — the one
+    owner of the concat/empty-dtype/weights-or-None tail shared by every
+    streaming ingestion path (parquet batches, native chunked parse,
+    chunked NumPy fallback)."""
+    cat = lambda parts, dt: (
+        np.concatenate(parts) if parts else np.empty(0, dt)
+    )
+    return EdgeTable(
+        src=cat(src_parts, np.int32),
+        dst=cat(dst_parts, np.int32),
+        names=np.asarray(names),
+        num_rows_raw=num_rows_raw,
+        weights=None if w_parts is None else cat(w_parts, np.float32),
+    )
+
+
 def load_parquet_edges(path: str, batch_rows: int | None = None) -> EdgeTable:
     """Read a parquet file/dir/glob of outlinks and build the edge table.
 
@@ -123,10 +142,8 @@ def _load_parquet_edges_streaming(path: str, batch_rows: int) -> EdgeTable:
             child = batch.column(1).to_numpy(zero_copy_only=False)
             src_parts.append(interner.add(parent))
             dst_parts.append(interner.add(child))
-    src = np.concatenate(src_parts) if src_parts else np.empty(0, np.int32)
-    dst = np.concatenate(dst_parts) if dst_parts else np.empty(0, np.int32)
-    return EdgeTable(
-        src=src, dst=dst, names=interner.names(), num_rows_raw=num_rows_raw
+    return edge_table_from_parts(
+        src_parts, dst_parts, interner.names(), num_rows_raw
     )
 
 
@@ -214,7 +231,7 @@ def load_edge_list(path: str, comments: str = "#", use_native: bool = True,
                 return et
     big = (
         os.path.exists(path)
-        and os.path.getsize(path) > (chunk_bytes or _AUTO_STREAM_BYTES)
+        and os.path.getsize(path) > _AUTO_STREAM_BYTES
     )
     if chunk_bytes is not None or big:
         return _load_edge_list_numpy_chunked(
@@ -270,15 +287,9 @@ def _load_edge_list_numpy_chunked(
                     f"a {raw.shape[1]}-column edge list"
                 )
             w_parts.append(raw[:, weight_col].astype(np.float32))
-    cat = lambda parts, dt: (
-        np.concatenate(parts) if parts else np.empty(0, dt)
-    )
-    return EdgeTable(
-        src=cat(src_parts, np.int32),
-        dst=cat(dst_parts, np.int32),
-        names=interner.names(),
-        num_rows_raw=num_rows,
-        weights=cat(w_parts, np.float32) if weight_col is not None else None,
+    return edge_table_from_parts(
+        src_parts, dst_parts, interner.names(), num_rows,
+        w_parts if weight_col is not None else None,
     )
 
 

@@ -177,7 +177,7 @@ def load_edge_list_chunked(path: str, comments: str = "#",
         or not os.path.exists(path)
     ):
         return None
-    from graphmine_tpu.io.edges import EdgeTable, iter_line_chunks
+    from graphmine_tpu.io.edges import edge_table_from_parts, iter_line_chunks
 
     comment = comments[:1].encode() or b"#"
     wcol = -1 if weight_col is None else int(weight_col)
@@ -232,15 +232,9 @@ def load_edge_list_chunked(path: str, comments: str = "#",
             lib.gb_free_names(names_p, nv)
     finally:
         lib.gb_interner_free(it)
-    cat = lambda parts, dt: (
-        np.concatenate(parts) if parts else np.empty(0, dt)
-    )
-    return EdgeTable(
-        src=cat(src_parts, np.int32),
-        dst=cat(dst_parts, np.int32),
-        names=names,
-        num_rows_raw=num_rows,
-        weights=cat(w_parts, np.float32) if wcol >= 0 else None,
+    return edge_table_from_parts(
+        src_parts, dst_parts, names, num_rows,
+        w_parts if wcol >= 0 else None,
     )
 
 

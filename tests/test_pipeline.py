@@ -279,3 +279,35 @@ def test_checkpoint_fingerprint_guards_resume(tmp_path):
     # legacy checkpoints (no fingerprint recorded) still load
     save_labels(str(tmp_path), np.arange(3, dtype=np.int32), 1, tag="old")
     assert load_labels(str(tmp_path), tag="old", fingerprint=fp)[1] == 1
+
+
+def test_spark_crosscheck_skips_cleanly_without_pyspark():
+    """tools/spark_crosscheck.py (r3): in this no-JVM sandbox it must exit
+    3 with a parseable skip record; in a pyspark+graphframes environment it
+    runs the real JVM labelPropagation through backends.lpa_graphframes and
+    asserts canonical-partition agreement within the tie envelope."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "spark_crosscheck.py")],
+        capture_output=True, text=True, timeout=120,
+    )
+    try:
+        import graphframes  # noqa: F401
+        import pyspark  # noqa: F401
+
+        have_spark = True
+    except ImportError:
+        have_spark = False
+    if have_spark:
+        assert p.returncode == 0, p.stdout + p.stderr
+        rec = json.loads(p.stdout.strip().splitlines()[-1])
+        assert rec["crosscheck"] == "agree"
+    else:
+        assert p.returncode == 3, p.stdout + p.stderr
+        rec = json.loads(p.stdout.strip().splitlines()[-1])
+        assert rec["crosscheck"] == "skipped" and "pyspark" in rec["reason"]

@@ -67,14 +67,22 @@ def generate(path: str, rows: int, vertices: int, weighted: bool,
 def ingest_child(path: str, weight_col: int | None) -> None:
     """Runs in the measured child: ingest + report RSS on stdout."""
     sys.path.insert(0, _REPO)
+    from graphmine_tpu.io import native
     from graphmine_tpu.io.edges import load_edge_list
 
     # Import baseline (the package pulls jax): recorded separately so the
     # ceiling attributable to INGESTION is readable from the record.
     baseline = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
     t0 = time.perf_counter()
-    et = load_edge_list(path, weight_col=weight_col)
+    # chunk_bytes is passed EXPLICITLY so the measurement is always the
+    # streaming path — small files would otherwise take the bulk path and
+    # misattribute a bulk-load RSS number as streaming evidence.
+    et = load_edge_list(path, weight_col=weight_col, chunk_bytes=64 << 20)
     dt = time.perf_counter() - t0
+    ingest_path = (
+        "native-chunked" if native.chunked_parse_available()
+        else "numpy-chunked"
+    )
     peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
     edges_bytes = et.src.nbytes + et.dst.nbytes + (
         et.weights.nbytes if et.weights is not None else 0
@@ -89,6 +97,7 @@ def ingest_child(path: str, weight_col: int | None) -> None:
         "ingest_rss_over_edges": round(
             (peak - baseline) / max(edges_bytes, 1), 2
         ),
+        "path": ingest_path,
     }))
 
 
@@ -130,21 +139,12 @@ def main() -> int:
             "gen_seconds": round(gen_s, 1),
             "weighted": args.weighted,
             "rows_per_sec": round(args.rows / max(rec["seconds"], 1e-3)),
-            "path": "native-chunked" if _native_available()
-            else "numpy-chunked",
         })
         print(json.dumps(rec))
         return 0
     finally:
         if not args.keep and os.path.exists(path) and args.path is None:
             os.unlink(path)
-
-
-def _native_available() -> bool:
-    sys.path.insert(0, _REPO)
-    from graphmine_tpu.io import native
-
-    return native.chunked_parse_available()
 
 
 if __name__ == "__main__":

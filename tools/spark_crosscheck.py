@@ -1,0 +1,134 @@
+"""Portable GraphFrames crosscheck (VERDICT r2 item 6).
+
+Closes the north-star clause "matching GraphFrames community IDs on
+bundled data" (BASELINE.json; call site ``Graphframes.py:78-81``) in ANY
+environment that has the reference's stack installed:
+
+    pip install pyspark graphframes   # (or the reference README's pins)
+    python tools/spark_crosscheck.py
+
+What it does:
+  1. loads the bundled parquet (or ``--data`` / an edge list),
+  2. runs the REAL JVM ``GraphFrame.labelPropagation`` through the
+     pipeline's plugin boundary (``pipeline/backends.py:lpa_graphframes``
+     — this is the path that has never executed in the no-JVM sandbox),
+  3. runs this engine's LPA and the GraphX-structure oracle,
+  4. compares canonical partitions (``ops/lpa.py:canonicalize`` — SURVEY
+     §6: validate partitions, not raw label values).
+
+Pass criterion: exact canonical-partition agreement, OR agreement within
+the measured tie-sensitivity envelope — GraphX's own tie-break is
+machine-dependent (``oracle.py`` module docstring), so the oracle's
+smallest-vs-largest tie extremes bound how far two legitimate runs of the
+*reference stack itself* can diverge; the JVM-vs-engine ARI must be >=
+that envelope's ARI.
+
+Exit codes: 0 = agree (within envelope), 1 = disagreement beyond the tie
+envelope, 3 = pyspark/graphframes not installed (CI skip).
+
+Prints one JSON line either way.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+_DEFAULT_DATA = "/root/reference/CommunityDetection/data/outlinks_pq"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default=_DEFAULT_DATA,
+                    help="parquet dir/glob or .txt edge list")
+    ap.add_argument("--max-iter", type=int, default=5)
+    args = ap.parse_args()
+
+    try:
+        import pyspark  # noqa: F401
+        from graphframes import GraphFrame  # noqa: F401
+    except ImportError:
+        print(json.dumps({
+            "crosscheck": "skipped",
+            "reason": "pyspark/graphframes not installed "
+                      "(pip install pyspark graphframes)",
+        }))
+        return 3
+
+    if not os.path.exists(args.data):
+        # The default points at the reference checkout's bundled parquet;
+        # in another environment, pass --data <parquet dir or .txt edge
+        # list>. A missing DEFAULT is a clean skip (same CI semantics as
+        # no-JVM); an explicitly passed path that is absent is an error.
+        explicit = args.data != _DEFAULT_DATA
+        print(json.dumps({
+            "crosscheck": "skipped" if not explicit else "error",
+            "reason": f"data not found at {args.data!r}"
+                      + ("" if explicit else
+                         " — pass --data <bundled outlinks parquet or"
+                         " edge list>"),
+        }))
+        return 1 if explicit else 3
+
+    from graphmine_tpu.graph.container import build_graph
+    from graphmine_tpu.io.edges import load_edge_list, load_parquet_edges
+    from graphmine_tpu.ops.cluster_metrics import adjusted_rand_index
+    from graphmine_tpu.ops.lpa import canonicalize, label_propagation
+    from graphmine_tpu.oracle import canonical_partition, graphx_label_propagation
+    from graphmine_tpu.pipeline.backends import lpa_graphframes
+
+    if args.data.endswith(".txt"):
+        et = load_edge_list(args.data)
+    else:
+        et = load_parquet_edges(args.data)
+
+    # 1. the real JVM engine, through the plugin boundary
+    jvm_labels = lpa_graphframes(et, args.max_iter)
+
+    # 2. this engine
+    g = build_graph(et.src, et.dst, num_vertices=et.num_vertices)
+    eng_labels = np.asarray(
+        canonicalize(label_propagation(g, max_iter=args.max_iter))
+    )
+
+    # 3. oracle tie-sensitivity envelope: how far can two legitimate runs
+    # of the reference stack itself diverge on this graph?
+    lo = graphx_label_propagation(
+        et.src, et.dst, et.num_vertices, args.max_iter, tie="smallest"
+    )
+    hi = graphx_label_propagation(
+        et.src, et.dst, et.num_vertices, args.max_iter, tie="largest"
+    )
+    envelope_ari = float(adjusted_rand_index(
+        canonical_partition(lo), canonical_partition(hi)
+    ))
+
+    jvm_canon = canonical_partition(jvm_labels)
+    exact = bool(np.array_equal(jvm_canon, eng_labels))
+    ari = float(adjusted_rand_index(jvm_canon, eng_labels))
+    ok = exact or ari >= envelope_ari
+
+    print(json.dumps({
+        "crosscheck": "agree" if ok else "DISAGREE",
+        "exact_canonical_match": exact,
+        "ari_jvm_vs_engine": round(ari, 6),
+        "tie_envelope_ari": round(envelope_ari, 6),
+        "jvm_communities": int(len(np.unique(jvm_labels))),
+        "engine_communities": int(len(np.unique(eng_labels))),
+        "vertices": et.num_vertices,
+        "edges": et.num_edges,
+        "max_iter": args.max_iter,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
