@@ -255,7 +255,10 @@ def _run_snap_rung(
         )
 
         t0 = time.perf_counter()
-        graph = build_graph(et.src, et.dst, num_vertices=v)
+        # Host-resident build: the planner just said the unsharded graph
+        # exceeds one device — partitioning slices host arrays straight
+        # onto the mesh (same discipline as the driver's scale-out mode).
+        graph = build_graph(et.src, et.dst, num_vertices=v, to_device=False)
         mesh = make_mesh()
         sg = shard_graph_arrays(
             partition_graph(

@@ -125,7 +125,7 @@ def _message_csr(src, dst, num_vertices, symmetric, use_native=True, weights=Non
 
 def build_graph(
     src, dst, num_vertices: int | None = None, symmetric: bool = True,
-    use_native: bool = True, edge_weights=None,
+    use_native: bool = True, edge_weights=None, to_device: bool = True,
 ) -> Graph:
     """Build a :class:`Graph` from endpoint arrays (host-side).
 
@@ -139,12 +139,26 @@ def build_graph(
     both message directions of an edge carry its weight, and weighted LPA
     (:func:`~graphmine_tpu.ops.lpa.label_propagation`) argmaxes weight
     sums instead of counts.
+
+    ``to_device=False`` keeps every array as host NumPy (r3): the layout
+    for graphs that exist only to be PARTITIONED over a mesh — the memory
+    planner may have just determined the whole graph cannot fit one
+    device, so materializing it there before sharding would OOM the exact
+    configs the ring schedule exists for. Host graphs work with
+    ``partition_graph`` and the host paths of ``census_table``/degree
+    helpers; device supersteps require ``to_device=True``.
     """
     src, dst, num_vertices = _prepare_edges(src, dst, num_vertices)
     w = _prepare_weights(edge_weights, src)
     ptr, recv, send, w_sorted = _message_csr(
         src, dst, num_vertices, symmetric, use_native, weights=w
     )
+    if not to_device:
+        return Graph(
+            src=src, dst=dst, msg_recv=recv, msg_send=send,
+            msg_ptr=ptr.astype(np.int32), num_vertices=num_vertices,
+            symmetric=symmetric, msg_weight=w_sorted,
+        )
     return _graph_from_csr(
         src, dst, ptr, recv, send, num_vertices, symmetric, msg_weight=w_sorted
     )
@@ -190,13 +204,17 @@ def _graph_from_csr(
     )
 
 
-def graph_from_edge_table(table, symmetric: bool = True) -> Graph:
+def graph_from_edge_table(
+    table, symmetric: bool = True, to_device: bool = True
+) -> Graph:
     """Build a graph from an :class:`graphmine_tpu.io.edges.EdgeTable`;
     the table's optional per-edge ``weights`` carry through to weighted
-    message flow (``load_edge_list(weight_col=...)``)."""
+    message flow (``load_edge_list(weight_col=...)``). ``to_device=False``
+    keeps host NumPy arrays (see :func:`build_graph`)."""
     return build_graph(
         table.src, table.dst, num_vertices=table.num_vertices,
         symmetric=symmetric, edge_weights=getattr(table, "weights", None),
+        to_device=to_device,
     )
 
 

@@ -49,11 +49,22 @@ def community_edge_counts(labels: jax.Array, graph: Graph) -> jax.Array:
 def census_table(labels: jax.Array, graph: Graph):
     """Host-friendly summary: (label values, vertex counts, intra-edge counts),
     dense arrays over present labels only — the structured replacement for the
-    reference's print-per-community loop (``Graphframes.py:100-120``)."""
+    reference's print-per-community loop (``Graphframes.py:100-120``).
+
+    Host graphs (``build_graph(to_device=False)``, r3) compute with NumPy
+    bincounts — no O(E) device transfer for graphs the memory planner kept
+    off-device; identical results (tested)."""
     import numpy as np
 
     labels_np = np.asarray(labels)
-    sizes = np.asarray(community_sizes(labels))
-    edges = np.asarray(community_edge_counts(labels, graph))
+    if isinstance(graph.src, np.ndarray):
+        v = labels_np.shape[0]
+        sizes = np.bincount(labels_np, minlength=v)
+        src = graph.src
+        mask = labels_np[src] == labels_np[graph.dst]
+        edges = np.bincount(labels_np[src[mask]], minlength=v)
+    else:
+        sizes = np.asarray(community_sizes(labels))
+        edges = np.asarray(community_edge_counts(labels, graph))
     present = np.flatnonzero(sizes > 0)
     return present, sizes[present], edges[present]

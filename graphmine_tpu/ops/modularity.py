@@ -79,9 +79,48 @@ def message_weights(graph: Graph) -> tuple[jax.Array, jax.Array]:
 def modularity(labels: jax.Array, graph: Graph, gamma: float = 1.0) -> jax.Array:
     """Modularity of ``labels`` on a :class:`Graph` — per-edge weights when
     the graph carries them (``build_graph(edge_weights=...)``), else unit
-    weights; duplicate edges counted with multiplicity, self-loops handled."""
+    weights; duplicate edges counted with multiplicity, self-loops handled.
+
+    Host graphs (``build_graph(to_device=False)``, r3) dispatch to a NumPy
+    twin with identical conventions — no O(E) device transfer for graphs
+    the memory planner kept off-device."""
+    import numpy as np
+
+    if isinstance(graph.msg_recv, np.ndarray):
+        return _modularity_host(labels, graph, gamma)
     w, self_w = message_weights(graph)
     return modularity_weighted(
         labels, graph.msg_recv, graph.msg_send, w, self_w,
         graph.num_vertices, gamma,
     )
+
+
+def _modularity_host(labels, graph: Graph, gamma: float):
+    """NumPy twin of ``modularity_weighted`` + ``message_weights`` (same
+    self-loop and weight conventions; float64 accumulation)."""
+    import numpy as np
+
+    if not graph.symmetric:
+        raise ValueError(
+            "the message-weight decomposition needs the symmetric message "
+            "list (both edge directions); rebuild with symmetric=True"
+        )
+    v = graph.num_vertices
+    recv = graph.msg_recv
+    send = graph.msg_send
+    labels = np.asarray(labels)
+    base = (
+        np.ones(len(recv), np.float64) if graph.msg_weight is None
+        else np.asarray(graph.msg_weight, np.float64)
+    )
+    is_self = recv == send
+    w = np.where(is_self, 0.0, base)
+    self_w = np.bincount(
+        recv, weights=np.where(is_self, 0.5 * base, 0.0), minlength=v
+    )
+    k = np.bincount(recv, weights=w, minlength=v) + 2.0 * self_w
+    two_m = max(float(k.sum()), 1e-12)
+    intra = float(w[labels[recv] == labels[send]].sum())
+    sigma_in = intra + 2.0 * float(self_w.sum())
+    sigma_tot = np.bincount(labels, weights=k, minlength=v)
+    return sigma_in / two_m - gamma * float(np.sum((sigma_tot / two_m) ** 2))

@@ -119,7 +119,12 @@ def partition_graph(
         raise ValueError("pass num_shards or mesh")
     if not isinstance(graph_or_src, Graph):
         # One source of truth for message-CSR construction semantics.
-        graph_or_src = build_graph(graph_or_src, dst, num_vertices=num_vertices)
+        # Host-side (r3): this graph exists only to be sliced into shards
+        # below — materializing it on one device first would OOM exactly
+        # the configs the multi-device schedules are for.
+        graph_or_src = build_graph(
+            graph_or_src, dst, num_vertices=num_vertices, to_device=False
+        )
     g = graph_or_src
     recv = np.asarray(g.msg_recv)
     send = np.asarray(g.msg_send)

@@ -94,6 +94,19 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
     # when that path will run — it is pure HBM/host waste for louvain,
     # graphframes, and sharded runs.
     wants_plan = run_plan is not None and run_plan.schedule == "single"
+    # Scale-out mode (r3): when the planner chose a distributed schedule
+    # AND the whole graph cannot also fit one device, the full Graph stays
+    # HOST-side NumPy — partitioning slices it onto the mesh, and the
+    # census/modularity phases dispatch to their NumPy twins. Building it
+    # device-resident here would OOM device 0 before LPA ever ran.
+    scale_out = (
+        run_plan is not None
+        and run_plan.schedule != "single"
+        and run_plan.estimates.get("single", 0) > run_plan.hbm_bytes
+    )
+    if scale_out:
+        m.emit("scale_out", message="full graph exceeds one device: host-"
+               "resident graph; device-resident outlier phases gated")
     with m.timed("build_graph"):
         if wants_plan:
             from graphmine_tpu.ops.bucketed_mode import build_graph_and_plan
@@ -103,7 +116,7 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
                 edge_weights=table.weights,
             )
         else:
-            graph = graph_from_edge_table(table)
+            graph = graph_from_edge_table(table, to_device=not scale_out)
             mode_plan = None
 
     # ---- CS-3 community detection --------------------------------------
@@ -143,6 +156,21 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
     )
 
     # ---- CS-5 outliers --------------------------------------------------
+    if scale_out and config.outlier_method != "none":
+        # The outlier feature/subgraph builds are device-resident over the
+        # full graph, which the planner just determined does not fit one
+        # device. Skipping loudly beats an XLA OOM after a successful LPA;
+        # labels + census above are complete either way.
+        m.emit(
+            "warning",
+            message=f"outlier_method={config.outlier_method!r} skipped in "
+            "scale-out mode: the full graph exceeds one device "
+            f"({run_plan.estimates['single']:,} modeled bytes vs "
+            f"{run_plan.hbm_bytes:,} budget); run outliers where the graph "
+            "fits a single device, or use sharded_lof on precomputed "
+            "features",
+        )
+        return result
     if config.outlier_method in ("recursive_lpa", "both"):
         from graphmine_tpu.ops.outliers import recursive_lpa_outliers
 

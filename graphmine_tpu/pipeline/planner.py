@@ -129,11 +129,14 @@ def plan_run(
     candidates = (
         ["single"] if num_devices == 1 else ["replicated", "ring"]
     )
+    # estimates always include "single" (even for D > 1): the driver uses
+    # it to decide whether the FULL graph may also live on one device for
+    # the census/outlier phases, or must stay host-side (scale-out mode).
     est = {
         s: estimate_bytes_per_device(
             s, num_vertices, num_edges, num_devices, weighted
         )
-        for s in candidates
+        for s in dict.fromkeys(candidates + ["single"])
     }
 
     def _gb(b):

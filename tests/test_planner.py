@@ -159,3 +159,51 @@ def test_checkpoint_cadence(tmp_path, monkeypatch):
 def test_checkpoint_every_validation():
     with pytest.raises(ValueError, match="checkpoint_every"):
         _tiny_config(checkpoint_every=0).validate()
+
+
+def test_scale_out_mode_host_graph_pipeline(monkeypatch):
+    """r3 scale-out: when the planner picks a distributed schedule AND the
+    full graph cannot also fit one device, the pipeline keeps the graph
+    host-side (census/modularity via NumPy twins), produces identical
+    labels/census to the device path, and gates the device-resident
+    outlier phases with a loud warning instead of OOMing."""
+    import numpy as np
+
+    from graphmine_tpu.pipeline.driver import run_pipeline
+
+    # reference run: plenty of budget, device graph, same 8-device mesh
+    ref = run_pipeline(_tiny_config(num_devices=8, max_iter=3))
+
+    # bundled graph models: single ~699 KB, replicated ~157 KB/device,
+    # ring ~97 KB/device. 0.9 * 300000 = 270 KB -> replicated fits,
+    # single does not => scale-out with the replicated schedule.
+    monkeypatch.setenv("GRAPHMINE_HBM_BYTES", "300000")
+    res = run_pipeline(_tiny_config(
+        num_devices=8, max_iter=3, outlier_method="both",
+    ))
+    plans = [r for r in res.metrics.records if r.get("phase") == "plan"]
+    assert plans[0]["schedule"] == "replicated"
+    assert any(r.get("phase") == "scale_out" for r in res.metrics.records)
+    np.testing.assert_array_equal(res.labels, ref.labels)
+    p0, s0, e0 = ref.community_table
+    p1, s1, e1 = res.community_table
+    np.testing.assert_array_equal(p0, p1)
+    np.testing.assert_array_equal(s0, s1)
+    np.testing.assert_array_equal(e0, e1)
+    # host graph really is host-resident numpy
+    assert isinstance(res.graph.src, np.ndarray)
+    # outliers gated, not crashed
+    assert res.outliers is None and res.lof is None
+    warns = [r for r in res.metrics.records if r.get("phase") == "warning"]
+    assert any("scale-out" in w["message"] for w in warns)
+    # modularity host twin agrees with the device value
+    comm = [r for r in res.metrics.records if r.get("phase") == "communities"][0]
+    ref_comm = [r for r in ref.metrics.records if r.get("phase") == "communities"][0]
+    assert abs(comm["modularity"] - ref_comm["modularity"]) < 1e-4
+
+    # 0.9 * 120000 = 108 KB -> only ring fits; same labels again
+    monkeypatch.setenv("GRAPHMINE_HBM_BYTES", "120000")
+    res_ring = run_pipeline(_tiny_config(num_devices=8, max_iter=3))
+    plans = [r for r in res_ring.metrics.records if r.get("phase") == "plan"]
+    assert plans[0]["schedule"] == "ring"
+    np.testing.assert_array_equal(res_ring.labels, ref.labels)

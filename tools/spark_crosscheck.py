@@ -24,7 +24,9 @@ smallest-vs-largest tie extremes bound how far two legitimate runs of the
 that envelope's ARI.
 
 Exit codes: 0 = agree (within envelope), 1 = disagreement beyond the tie
-envelope, 3 = pyspark/graphframes not installed (CI skip).
+envelope, 2 = config error (an explicitly passed --data path is absent),
+3 = skipped (pyspark/graphframes not installed, or the DEFAULT data path
+is absent — both CI-skippable).
 
 Prints one JSON line either way.
 """
@@ -76,7 +78,7 @@ def main() -> int:
                          " — pass --data <bundled outlinks parquet or"
                          " edge list>"),
         }))
-        return 1 if explicit else 3
+        return 2 if explicit else 3
 
     from graphmine_tpu.graph.container import build_graph
     from graphmine_tpu.io.edges import load_edge_list, load_parquet_edges
