@@ -60,11 +60,7 @@ def message_weights(graph: Graph) -> tuple[jax.Array, jax.Array]:
     self-loop of weight x adds 2x to its vertex's degree). Per-edge
     weights come from ``graph.msg_weight`` when present, else 1.
     """
-    if not graph.symmetric:
-        raise ValueError(
-            "the message-weight decomposition needs the symmetric message "
-            "list (both edge directions); rebuild with symmetric=True"
-        )
+    _require_symmetric(graph)
     v = graph.num_vertices
     is_self = graph.msg_recv == graph.msg_send
     base = 1.0 if graph.msg_weight is None else graph.msg_weight.astype(jnp.float32)
@@ -95,16 +91,22 @@ def modularity(labels: jax.Array, graph: Graph, gamma: float = 1.0) -> jax.Array
     )
 
 
-def _modularity_host(labels, graph: Graph, gamma: float):
-    """NumPy twin of ``modularity_weighted`` + ``message_weights`` (same
-    self-loop and weight conventions; float64 accumulation)."""
-    import numpy as np
-
+def _require_symmetric(graph: Graph) -> None:
+    """Shared guard: both modularity paths read the symmetric message
+    list."""
     if not graph.symmetric:
         raise ValueError(
             "the message-weight decomposition needs the symmetric message "
             "list (both edge directions); rebuild with symmetric=True"
         )
+
+
+def _modularity_host(labels, graph: Graph, gamma: float):
+    """NumPy twin of ``modularity_weighted`` + ``message_weights`` (same
+    self-loop and weight conventions; float64 accumulation)."""
+    import numpy as np
+
+    _require_symmetric(graph)
     v = graph.num_vertices
     recv = graph.msg_recv
     send = graph.msg_send

@@ -156,22 +156,21 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
     )
 
     # ---- CS-5 outliers --------------------------------------------------
-    if scale_out and config.outlier_method != "none":
-        # The outlier feature/subgraph builds are device-resident over the
+    if scale_out and config.outlier_method in ("recursive_lpa", "both"):
+        # The recursive-LPA subgraph build is device-resident over the
         # full graph, which the planner just determined does not fit one
-        # device. Skipping loudly beats an XLA OOM after a successful LPA;
-        # labels + census above are complete either way.
+        # device. Skipping loudly beats an XLA OOM after a successful LPA.
+        lof_note = (
+            "; LOF will attempt host features + the sharded scorer"
+            if config.outlier_method == "both" else ""
+        )
         m.emit(
             "warning",
-            message=f"outlier_method={config.outlier_method!r} skipped in "
-            "scale-out mode: the full graph exceeds one device "
-            f"({run_plan.estimates['single']:,} modeled bytes vs "
-            f"{run_plan.hbm_bytes:,} budget); run outliers where the graph "
-            "fits a single device, or use sharded_lof on precomputed "
-            "features",
+            message="recursive_lpa outliers skipped in scale-out mode: the "
+            f"full graph exceeds one device ({run_plan.estimates['single']:,}"
+            f" modeled bytes vs {run_plan.hbm_bytes:,} budget)" + lof_note,
         )
-        return result
-    if config.outlier_method in ("recursive_lpa", "both"):
+    if config.outlier_method in ("recursive_lpa", "both") and not scale_out:
         from graphmine_tpu.ops.outliers import recursive_lpa_outliers
 
         with m.timed("outliers_recursive_lpa"):
@@ -185,16 +184,38 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
             sub_communities=len(result.outliers.sub_sizes),
         )
     if config.outlier_method in ("lof", "both"):
-        from graphmine_tpu.ops.features import standardize, vertex_features
+        from graphmine_tpu.ops.features import (
+            standardize,
+            vertex_features,
+            vertex_features_host,
+        )
         from graphmine_tpu.ops.lof import lof_scores
 
         from graphmine_tpu.parallel.knn import can_shard
 
         k = min(config.lof_k, graph.num_vertices - 1)
         use_sharded_lof = n_dev > 1 and can_shard(graph.num_vertices, n_dev, k)
+        if scale_out and not use_sharded_lof:
+            m.emit(
+                "warning",
+                message="lof skipped in scale-out mode: the all-pairs "
+                "single-device scorer cannot hold a graph this size; add "
+                "devices so the sharded kNN/LOF path can run",
+            )
+            return result
         with m.timed("outliers_lof", k=config.lof_k,
-                     devices=n_dev if use_sharded_lof else 1):
-            feats = standardize(vertex_features(graph, labels))
+                     devices=n_dev if use_sharded_lof else 1,
+                     features="host-7" if scale_out else "device-8"):
+            if scale_out:
+                # Host feature twin (no O(E) device transfer); the
+                # clustering-coefficient column is omitted at this scale —
+                # the wedge pass is infeasible exactly when the graph
+                # exceeds one device (ops/features.py docstring).
+                feats = standardize(vertex_features_host(
+                    graph, labels, include_clustering=False
+                ))
+            else:
+                feats = standardize(vertex_features(graph, labels))
             if use_sharded_lof:
                 # Multi-device: ring-sharded kNN + distributed LOF — the
                 # O(V^2) distance work is scheduled over the mesh with no

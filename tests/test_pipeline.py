@@ -306,6 +306,9 @@ def test_spark_crosscheck_skips_cleanly_without_pyspark():
     have_data = os.path.exists(
         "/root/reference/CommunityDetection/data/outlinks_pq"
     )
+    # returncode first: a crash must surface the captured output, not an
+    # IndexError/JSONDecodeError from parsing empty stdout
+    assert p.returncode in (0, 3), p.stdout + p.stderr
     rec = json.loads(p.stdout.strip().splitlines()[-1])
     if have_spark and have_data:
         assert p.returncode == 0, p.stdout + p.stderr

@@ -192,10 +192,14 @@ def test_scale_out_mode_host_graph_pipeline(monkeypatch):
     np.testing.assert_array_equal(e0, e1)
     # host graph really is host-resident numpy
     assert isinstance(res.graph.src, np.ndarray)
-    # outliers gated, not crashed
-    assert res.outliers is None and res.lof is None
+    # recursive-LPA outliers gated with a warning; LOF still runs via the
+    # host feature twin + sharded scorer
+    assert res.outliers is None
+    assert res.lof is not None and res.lof.shape == (res.graph.num_vertices,)
     warns = [r for r in res.metrics.records if r.get("phase") == "warning"]
     assert any("scale-out" in w["message"] for w in warns)
+    lof_rec = [r for r in res.metrics.records if r.get("phase") == "outliers_lof"]
+    assert lof_rec and lof_rec[0]["features"] == "host-7"
     # modularity host twin agrees with the device value
     comm = [r for r in res.metrics.records if r.get("phase") == "communities"][0]
     ref_comm = [r for r in ref.metrics.records if r.get("phase") == "communities"][0]
@@ -207,3 +211,28 @@ def test_scale_out_mode_host_graph_pipeline(monkeypatch):
     plans = [r for r in res_ring.metrics.records if r.get("phase") == "plan"]
     assert plans[0]["schedule"] == "ring"
     np.testing.assert_array_equal(res_ring.labels, ref.labels)
+
+
+def test_vertex_features_host_parity(bundled_graph):
+    """The NumPy feature twin matches the device feature matrix within
+    float32 rounding when the clustering column is included."""
+    import numpy as np
+
+    from graphmine_tpu.graph.container import build_graph
+    from graphmine_tpu.ops.features import vertex_features, vertex_features_host
+    from graphmine_tpu.ops.lpa import label_propagation
+
+    g = bundled_graph
+    labels = np.asarray(label_propagation(g, max_iter=3))
+    want = np.asarray(vertex_features(g, labels))
+    host_g = build_graph(
+        np.asarray(g.src), np.asarray(g.dst),
+        num_vertices=g.num_vertices, to_device=False,
+    )
+    got = vertex_features_host(host_g, labels, include_clustering=True)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+    # clustering omitted -> same first 7 columns, zero last column
+    got7 = vertex_features_host(host_g, labels, include_clustering=False)
+    np.testing.assert_allclose(got7[:, :7], want[:, :7], rtol=2e-5, atol=2e-6)
+    assert not got7[:, 7].any()
