@@ -238,6 +238,13 @@ def load_edge_list(path: str, comments: str = "#", use_native: bool = True,
             path, comments, weight_col, chunk_bytes or _DEFAULT_CHUNK_BYTES
         )
     raw = np.loadtxt(path, comments=comments, dtype=str, ndmin=2)
+    if len(raw) == 0:
+        # no data rows (comment/blank-only file): an empty table, matching
+        # the streaming paths (which cannot distinguish this from EOF)
+        return edge_table_from_parts(
+            [], [], np.empty(0, dtype=object), 0,
+            [] if weight_col is not None else None,
+        )
     if raw.shape[1] < 2:
         raise ValueError(f"edge list {path!r} needs >= 2 columns")
     weights = None

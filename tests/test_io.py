@@ -208,3 +208,59 @@ def test_chunked_edge_cases(tmp_path):
     if native.chunked_parse_available():
         with pytest.raises(ValueError, match="weight_col"):
             native.load_edge_list_chunked(str(bad), weight_col=2)
+
+
+def test_ingestion_paths_fuzz_agreement(tmp_path):
+    """Property fuzz over the three edge-list ingestion paths (bulk NumPy,
+    chunked NumPy, chunked native): random content — random whitespace
+    runs, CRLF mixes, comments, blank lines, missing final newline,
+    string and integer ids, weighted and not, including comment-only
+    files (empty table on every path) — must produce the same name-keyed
+    edge multiset in the same order, for adversarial chunk sizes that
+    split lines anywhere."""
+    from graphmine_tpu.io import native
+    from graphmine_tpu.io.edges import load_edge_list
+
+    rng = np.random.default_rng(123)
+    for trial in range(8):
+        weighted = bool(trial % 2)
+        n = int(rng.integers(1, 120))
+        lines = []
+        for _ in range(n):
+            if rng.random() < 0.1:
+                lines.append(b"# comment " + str(rng.integers(99)).encode())
+                continue
+            if rng.random() < 0.1:
+                lines.append(b"" if rng.random() < 0.5 else b"   \t ")
+                continue
+            a = (f"v{rng.integers(20)}" if rng.random() < 0.5
+                 else str(rng.integers(50)))
+            b = (f"n{rng.integers(20)}" if rng.random() < 0.5
+                 else str(rng.integers(50)))
+            sep = b" " if rng.random() < 0.5 else b"\t  "
+            line = a.encode() + sep + b.encode()
+            if weighted:
+                line += sep + str(rng.integers(1, 32) / 4.0).encode()
+            lines.append(line)
+        eol = b"\r\n" if rng.random() < 0.3 else b"\n"
+        body = eol.join(lines)
+        if rng.random() < 0.5:
+            body += eol  # sometimes a final newline, sometimes not
+        path = str(tmp_path / f"fuzz_{trial}.txt")
+        with open(path, "wb") as f:
+            f.write(body)
+
+        wc = 2 if weighted else None
+        # the generator emits only well-formed data lines, so every path
+        # must accept (incl. comment-only files -> empty tables)
+        bulk = load_edge_list(path, use_native=False, weight_col=wc)
+        chunk = int(rng.integers(3, 40))
+        np_chunked = load_edge_list(
+            path, use_native=False, weight_col=wc, chunk_bytes=chunk
+        )
+        _assert_same_named_edges(np_chunked, bulk, weights=weighted)
+        if native.chunked_parse_available():
+            nat = native.load_edge_list_chunked(
+                path, weight_col=wc, chunk_bytes=chunk
+            )
+            _assert_same_named_edges(nat, bulk, weights=weighted)
