@@ -61,7 +61,10 @@ def _cases(num_seeds: int, first_seed: int):
         it = int(rng.integers(1, 6))
         weights = None
         if rng.random() < 0.5:
-            weights = (rng.integers(1, 16, len(src)) / 4.0).astype(np.float32)
+            # ZERO weights included (r3): weights >= 0 are legal, and the
+            # all-zero-hub argmax bug (ADVICE r2) lived exactly in the
+            # region the old 1/4..15/4 draw never reached.
+            weights = (rng.integers(0, 16, len(src)) / 4.0).astype(np.float32)
         tag = (f"seed={seed} v={v} e={len(src)} shape={shape} iters={it} "
                f"weighted={weights is not None}")
         yield tag, src, dst, v, it, weights, rng
@@ -83,7 +86,9 @@ def _big_cases(num_seeds: int, first_seed: int):
         ).astype(np.int32)
         weights = None
         if seed % 2:
-            weights = (rng.integers(1, 16, len(src)) / 4.0).astype(np.float32)
+            # zero weights included — mega-hubs with all-zero incoming
+            # weight exercise the masked histogram argmax (ADVICE r2)
+            weights = (rng.integers(0, 16, len(src)) / 4.0).astype(np.float32)
         tag = f"big seed={seed} v={v} e={len(src)} weighted={weights is not None}"
         yield tag, src, dst, v, 3, weights, rng
 
@@ -98,7 +103,13 @@ def sweep(num_seeds: int = 30, first_seed: int = 0, big: bool = False) -> int:
         lpa_superstep_bucketed,
     )
     from graphmine_tpu.ops.cc import connected_components
+    from graphmine_tpu.ops.census import census_table
     from graphmine_tpu.ops.degrees import out_degrees, out_weights
+    from graphmine_tpu.ops.features import (
+        vertex_features,
+        vertex_features_host,
+    )
+    from graphmine_tpu.ops.modularity import modularity
     from graphmine_tpu.ops.knn import knn
     from graphmine_tpu.ops.lof import lof_scores
     from graphmine_tpu.ops.lpa import label_propagation
@@ -155,6 +166,22 @@ def sweep(num_seeds: int = 30, first_seed: int = 0, big: bool = False) -> int:
         assert np.array_equal(
             cc, np.asarray(ring_connected_components(sg, mesh))
         ), f"ring cc: {tag}"
+
+        # r3 host twins (scale-out mode's paths): census / modularity /
+        # features on a host-resident graph must match the device ops.
+        gh = build_graph(src, dst, num_vertices=v, edge_weights=weights,
+                         to_device=False)
+        for a, b in zip(census_table(want, g), census_table(want, gh)):
+            assert np.array_equal(a, b), f"host census: {tag}"
+        q0 = float(modularity(jnp.asarray(want), g))
+        q1 = float(modularity(want, gh))
+        assert abs(q0 - q1) < 2e-4, f"host modularity {q0} vs {q1}: {tag}"
+        if not big:
+            f0 = np.asarray(vertex_features(g, jnp.asarray(want)))
+            f1 = vertex_features_host(gh, want, include_clustering=True)
+            assert np.allclose(f0, f1, rtol=2e-4, atol=2e-5), (
+                f"host features: {tag}"
+            )
 
         gd = build_graph(src, dst, num_vertices=v, symmetric=False,
                          edge_weights=weights)
