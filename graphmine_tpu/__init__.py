@@ -43,7 +43,11 @@ from graphmine_tpu.ops.scc import strongly_connected_components
 from graphmine_tpu.ops.aggregate import aggregate_messages, pregel
 from graphmine_tpu.ops.motifs import find as find_motifs
 from graphmine_tpu.ops.streaming_lof import StreamingLOF, fit_lof, score_lof
-from graphmine_tpu.ops.features import standardize, vertex_features
+from graphmine_tpu.ops.features import (
+    standardize,
+    vertex_features,
+    vertex_features_host,
+)
 from graphmine_tpu.ops.knn import knn
 from graphmine_tpu.ops.lof import lof_scores
 from graphmine_tpu.ops.triangles import triangle_count, clustering_coefficient
@@ -65,9 +69,14 @@ from graphmine_tpu.table import Table, read_parquet
 from graphmine_tpu.ops.svdpp import svd_plus_plus, svdpp_predict
 from graphmine_tpu.interop import from_networkx, graph_from_networkx, to_networkx
 from graphmine_tpu.oracle import graphx_label_propagation
+from graphmine_tpu.pipeline.planner import PlanError, RunPlan, plan_run
 
 __all__ = [
     "graphx_label_propagation",
+    "plan_run",
+    "RunPlan",
+    "PlanError",
+    "vertex_features_host",
     "Graph",
     "GraphFrame",
     "build_graph",
