@@ -217,7 +217,12 @@ def _run_snap_rung(
     from graphmine_tpu.ops.cc import connected_components
     from graphmine_tpu.ops.louvain import louvain
     from graphmine_tpu.ops.lpa import num_communities
-    from graphmine_tpu.pipeline.planner import PlanError, plan_run
+    from graphmine_tpu.pipeline.driver import device_hbm_bytes
+    from graphmine_tpu.pipeline.planner import (
+        PlanError,
+        hbm_bytes_per_device,
+        plan_run,
+    )
 
     real = snap_path(name, data_dir) is not None
     et = load(name, data_dir=data_dir, max_scale=max_scale)
@@ -230,7 +235,13 @@ def _run_snap_rung(
     }
 
     try:
-        rp = plan_run(v, e, len(jax.devices()))
+        # Same budget chain as the driver: env → device memory_stats
+        # (lazy: skipped when the env override wins) → 16 GiB default
+        # (VERDICT r3 item 3).
+        rp = plan_run(
+            v, e, len(jax.devices()),
+            hbm=hbm_bytes_per_device(device_hbm_bytes),
+        )
     except PlanError as ex:
         return dict(base, skipped=str(ex)[:400])
 
@@ -318,7 +329,17 @@ def _run_snap_rung(
         cc_seconds=round(t_cc, 2),
         components=n_cc,
     )
-    if e <= 2_000_000:
+    if e <= 2_000_000 and (
+        rp.schedule == "single"
+        or rp.estimates["single"] <= rp.hbm_bytes
+    ):
+        # Louvain is single-device only. On a multi-device rung the graph
+        # is host-resident; running louvain implicitly materializes it on
+        # device 0 — fine exactly when the planner's always-computed
+        # single-device estimate fits the budget, and the OOM the branch
+        # exists to avoid otherwise (ADVICE r3; the schedule alone is the
+        # wrong gate — plan_run never returns "single" for D > 1 even
+        # when the graph trivially fits one device, code-review r4).
         t0 = time.perf_counter()
         _, q = louvain(graph)
         rec["louvain_seconds"] = round(time.perf_counter() - t0, 2)
@@ -774,8 +795,7 @@ def main_weighted() -> None:
 # (VERDICT r2 weak 1-2). Round 3:
 #
 #   * no-args `python bench.py` = --tier all: on a healthy TPU it runs EVERY
-#     tier (chip first so the driver-parsed line is always the headline),
-#     one JSON line per tier, each child bounded;
+#     tier, one JSON line per tier, each child bounded;
 #   * probing is SPACED across the budget (default every 3 min inside a
 #     probe window) with a timestamped reachability trace recorded in
 #     detail.capture.trace — a dead-all-round tunnel leaves proof that the
@@ -785,7 +805,11 @@ def main_weighted() -> None:
 #
 # Every path prints at least one parseable JSON line on stdout, and each
 # tier's line is flushed the moment it exists (a mid-run kill loses only
-# later tiers).
+# later tiers). Round 4: the LAST line of every orchestrated run is a
+# compact suite-summary record (<1600 chars, `_suite_summary`) — the r3
+# artifact proved the driver keeps a ~2000-char stdout *tail* and parses
+# the LAST record, so BENCH_r03.json's headline was the stream tier and
+# the chip number scrolled out of the artifact entirely.
 # ---------------------------------------------------------------------------
 
 _REPO_DIR = os.path.dirname(os.path.abspath(__file__))
@@ -801,7 +825,9 @@ _CHILD_TIMEOUT_S = {
     "stream": 1200.0,
 }
 
-# Healthy-TPU capture order: chip first (the driver parses the first line),
+# Healthy-TPU capture order: chip first (its number headlines the final
+# suite-summary record — the LAST line, which is what the driver's
+# 2000-char-tail artifact actually parses; r3 learned this the hard way),
 # roofline second (validates the hardware model right next to the chip
 # number), then the remaining tiers by evidence value.
 _TIER_ORDER = [
@@ -918,14 +944,95 @@ def _print_record(record):
     print(json.dumps(record), flush=True)
 
 
-def _print_error_record(tier, reasons):
-    _print_record({
+def _error_record(tier, reasons):
+    return {
         "metric": f"bench_{tier}_capture_failed",
         "value": 0.0,
         "unit": "error",
         "vs_baseline": 0.0,
         "error": "; ".join(reasons)[:800],
-    })
+    }
+
+
+def _print_error_record(tier, reasons):
+    rec = _error_record(tier, reasons)
+    _print_record(rec)
+    return rec
+
+
+def _suite_summary(suite, platform, tpu_info, trace):
+    """The compact suite-summary record printed as the LAST stdout line of
+    every orchestrated run (VERDICT r3 item 1).
+
+    The driver's artifact keeps a ~2000-char stdout *tail* and parses the
+    LAST JSON record — BENCH_r03.json proved it: chip was printed first
+    "for the driver" and scrolled out; the parsed headline was the stream
+    tier. This one bounded line therefore carries the whole round:
+
+      * headline fields (metric/value/unit/vs_baseline) copied verbatim
+        from the chip record when it produced a real measurement (else the
+        first real tier record, else the first error record) — so the
+        driver-parsed number IS the chip edges/s figure;
+      * ``suite.tiers``: per-tier {m,v,u,vs} (or a truncated ``err``);
+      * ``suite.platform`` + ``suite.probes``: first/last probe + counts,
+        a digest of the full trace that rides the first tier record.
+
+    ``suite`` is the ordered list of (tier, record) printed this run.
+    Everything is truncated to keep the line well inside the 2000-char
+    artifact tail (pinned <1600 in tests).
+    """
+    def is_real(rec):
+        return "error" not in rec
+
+    headline = None
+    for t, rec in suite:
+        if t == "chip" and is_real(rec):
+            headline = rec
+            break
+    if headline is None:
+        headline = next((r for _, r in suite if is_real(r)), None)
+    if headline is None:
+        headline = suite[0][1] if suite else _error_record(
+            "suite", ["no tier records"]
+        )
+
+    tiers = {}
+    for t, rec in suite:
+        if is_real(rec):
+            tiers[t] = {
+                "m": rec.get("metric"),
+                "v": rec.get("value"),
+                "u": rec.get("unit"),
+                "vs": rec.get("vs_baseline"),
+            }
+        else:
+            tiers[t] = {"err": str(rec.get("error", ""))[:80]}
+
+    def probe_digest(entry):
+        return {
+            "t": entry.get("t"),
+            "utc": entry.get("utc"),
+            "ok": entry.get("ok"),
+            "info": str(entry.get("info", ""))[:90],
+        }
+
+    probes = {"n": len(trace), "ok": sum(1 for e in trace if e.get("ok"))}
+    if trace:
+        probes["first"] = probe_digest(trace[0])
+        if len(trace) > 1:
+            probes["last"] = probe_digest(trace[-1])
+    return {
+        "metric": headline.get("metric"),
+        "value": headline.get("value"),
+        "unit": headline.get("unit"),
+        "vs_baseline": headline.get("vs_baseline"),
+        "suite": {
+            "tiers": tiers,
+            "platform": platform or "unreachable",
+            "tpu_probe": (tpu_info or "")[:90] or None,
+            "probes": probes,
+        },
+    }
 
 
 def orchestrate(tier):
@@ -1003,6 +1110,16 @@ def orchestrate(tier):
             _sleep(max(0.0, next_start - elapsed()))
 
     printed_real = 0
+    # Ordered (tier, record) pairs — every printed record, real or error —
+    # feeding the final suite-summary line (the record the driver parses).
+    suite = []
+
+    def finish_suite():
+        _print_record(_suite_summary(suite, platform, tpu_info, trace))
+        return 0 if printed_real else 1
+
+    def emit_error(t, reasons):
+        suite.append((t, _print_error_record(t, reasons)))
 
     def finish_capture(first, fallback, failures):
         """Capture annotation for one tier's record. Only the FIRST record
@@ -1028,12 +1145,10 @@ def orchestrate(tier):
             first = i == 0
             t_timeout = _CHILD_TIMEOUT_S.get(t, 900.0)
             if backend_dead:
-                _print_error_record(
-                    t, ["skipped: backend unreachable mid-capture"]
-                )
+                emit_error(t, ["skipped: backend unreachable mid-capture"])
                 continue
             if remaining() < 120.0:
-                _print_error_record(t, ["skipped: budget exhausted"])
+                emit_error(t, ["skipped: budget exhausted"])
                 continue
             tier_reasons = []
             record = None
@@ -1059,8 +1174,9 @@ def orchestrate(tier):
                 tier_reasons.append(f"run{attempt}: {err}")
             fallback = None
             if record is None and first:
-                # The driver parses the FIRST line: guarantee it exists via
-                # the scrubbed reduced-scale CPU fallback (r2 behavior).
+                # Give the suite-summary headline a real chip number via
+                # the scrubbed reduced-scale CPU fallback (r2 behavior;
+                # the driver parses the LAST line — the summary).
                 env = _virtual_cpu_env(1)
                 env["GRAPHMINE_BENCH_CPU_FALLBACK"] = "1"
                 record, err = _run_child(
@@ -1076,8 +1192,8 @@ def orchestrate(tier):
             if record is None:
                 # Even a dead FIRST tier must not abort the suite: the
                 # backend is up and later tiers may still capture — the
-                # driver-parsed first line is then this error record.
-                _print_error_record(
+                # summary headline then falls back to the first real tier.
+                emit_error(
                     t,
                     (probe_reasons + tier_reasons if first else tier_reasons)
                     or ["no record"],
@@ -1098,8 +1214,9 @@ def orchestrate(tier):
                 )
             record.setdefault("detail", {})["capture"] = cap
             _print_record(record)
+            suite.append((t, record))
             printed_real += 1
-        return 0 if printed_real else 1
+        return finish_suite()
 
     # --- dead tunnel / CPU-only environment: reduced-scale fallback ------
     if ok and platform != "tpu":
@@ -1115,7 +1232,7 @@ def orchestrate(tier):
         first = i == 0
         t_timeout = _CHILD_TIMEOUT_S.get(t, 900.0)
         if not first and remaining() < 180.0:
-            _print_error_record(t, ["skipped: budget exhausted"])
+            emit_error(t, ["skipped: budget exhausted"])
             continue
         record, err = _run_child(
             t, env, min(t_timeout, max(remaining(), 120.0))
@@ -1123,7 +1240,7 @@ def orchestrate(tier):
         if record is None:
             # A dead first fallback tier still must not abort the suite:
             # later reduced-scale tiers may succeed on their own.
-            _print_error_record(
+            emit_error(
                 t,
                 (probe_reasons + [f"cpu-fallback: {err}"]) if first
                 else [f"cpu-fallback: {err}"],
@@ -1133,8 +1250,9 @@ def orchestrate(tier):
             first, fallback_msg, []
         )
         _print_record(record)
+        suite.append((t, record))
         printed_real += 1
-    return 0 if printed_real else 1
+    return finish_suite()
 
 
 if __name__ == "__main__":

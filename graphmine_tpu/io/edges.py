@@ -274,6 +274,7 @@ def _load_edge_list_numpy_chunked(
     interner = IncrementalFactorizer()
     src_parts, dst_parts, w_parts = [], [], []
     num_rows = 0
+    ncols = None
     for buf in iter_line_chunks(path, chunk_bytes):
         if not buf.strip():
             continue
@@ -284,6 +285,16 @@ def _load_edge_list_numpy_chunked(
             continue
         if raw.shape[1] < 2:
             raise ValueError(f"edge list {path!r} needs >= 2 columns")
+        # loadtxt enforces rectangularity only WITHIN a chunk; a file
+        # whose column count changes across a chunk boundary must fail
+        # the same as the bulk path (code-review r4)
+        if ncols is None:
+            ncols = raw.shape[1]
+        elif raw.shape[1] != ncols:
+            raise ValueError(
+                f"edge list {path!r}: number of columns changed "
+                "between data lines"
+            )
         num_rows += len(raw)
         src_parts.append(interner.add(raw[:, 0]))
         dst_parts.append(interner.add(raw[:, 1]))

@@ -135,6 +135,12 @@ def load_edge_list_native(path: str, comments: str = "#"):
         path.encode(), comments[:1].encode() or b"#",
         ctypes.byref(src_p), ctypes.byref(dst_p), ctypes.byref(names_p), ctypes.byref(nv),
     )
+    if ne == -3:
+        raise ValueError(f"edge list {path!r} needs >= 2 columns")
+    if ne == -4:
+        raise ValueError(
+            f"edge list {path!r}: number of columns changed between data lines"
+        )
     if ne < 0:
         return None
     try:
@@ -144,7 +150,12 @@ def load_edge_list_native(path: str, comments: str = "#"):
         else:
             src = np.ctypeslib.as_array(src_p, shape=(ne,)).copy()
             dst = np.ctypeslib.as_array(dst_p, shape=(ne,)).copy()
-        names = np.array([names_p[i].decode() for i in range(nv.value)])
+        # object dtype on an empty vocabulary too (comment-only file),
+        # matching edges.py's empty-table path (ADVICE r3 / review r4)
+        names = (
+            np.array([names_p[i].decode() for i in range(nv.value)])
+            if nv.value else np.empty(0, dtype=object)
+        )
     finally:
         lib.gb_free(src_p)
         lib.gb_free(dst_p)
@@ -167,8 +178,8 @@ def load_edge_list_chunked(path: str, comments: str = "#",
     path for top-rung edge lists (VERDICT r2 item 4 / weak 5). Weighted
     columns parse natively here (no NumPy string detour). Returns an
     EdgeTable, or None when the library (or its chunk API) is absent.
-    Raises ValueError on a malformed weight column (parity with the NumPy
-    fallback's hard error).
+    Raises ValueError on a malformed weight column or a data line with
+    fewer than 2 tokens (parity with the NumPy fallback's hard errors).
     """
     lib = _lib()
     if (
@@ -201,6 +212,15 @@ def load_edge_list_chunked(path: str, comments: str = "#",
                     f"edge list {path!r}: weight_col={wcol} missing "
                     "on a data line or not parseable as a float"
                 )
+            if ne == -3:
+                # same hard errors (and messages) as the NumPy paths:
+                # which inputs parse must not depend on the .so (ADVICE r3)
+                raise ValueError(f"edge list {path!r} needs >= 2 columns")
+            if ne == -4:
+                raise ValueError(
+                    f"edge list {path!r}: number of columns changed "
+                    "between data lines"
+                )
             if ne < 0:
                 # allocation failure: the library freed/nulled its buffers
                 return None
@@ -227,7 +247,13 @@ def load_edge_list_chunked(path: str, comments: str = "#",
         if nv < 0:
             return None
         try:
-            names = np.array([names_p[i].decode() for i in range(nv)])
+            # dtype=object on nv == 0 too: a bare np.array([]) is float64,
+            # diverging from edges.py's empty-table path (np.empty(0,
+            # dtype=object)) for the same comment-only input (ADVICE r3).
+            names = (
+                np.array([names_p[i].decode() for i in range(nv)])
+                if nv else np.empty(0, dtype=object)
+            )
         finally:
             lib.gb_free_names(names_p, nv)
     finally:

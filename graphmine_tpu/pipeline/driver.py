@@ -33,6 +33,25 @@ def _visible_devices() -> int:
     return len(jax.devices())
 
 
+def device_hbm_bytes() -> int | None:
+    """Best-effort real per-device HBM via ``memory_stats()``.
+
+    Returns None when the backend doesn't report it (CPU returns None,
+    some tunneled runtimes raise) — the planner then falls back to its
+    16 GiB default. Queried here, not in the planner, so host-side
+    planning paths never import jax (planner.hbm_bytes_per_device)."""
+    import jax
+
+    try:
+        stats = jax.devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    limit = stats.get("bytes_limit")
+    return int(limit) if limit and limit > 0 else None
+
+
 @dataclass
 class PipelineResult:
     edge_table: EdgeTable
@@ -73,14 +92,22 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
     n_dev = config.num_devices or _visible_devices()
     run_plan = None
     if config.community_method == "lpa" and config.backend != "graphframes":
-        from graphmine_tpu.pipeline.planner import plan_run
+        from graphmine_tpu.pipeline.planner import (
+            hbm_bytes_per_device,
+            plan_run,
+        )
 
+        # Budget chain: env override → what THIS device actually reports
+        # (a v4/v5p part has 2-6x the v5e default) → 16 GiB. The callable
+        # keeps the device query lazy: an env-pinned budget never touches
+        # memory_stats.
         run_plan = plan_run(
             table.num_vertices,
             table.num_edges,
             n_dev,
             weighted=table.weights is not None,
             requested=config.schedule,
+            hbm=hbm_bytes_per_device(device_hbm_bytes),
         )
         m.emit(
             "plan",

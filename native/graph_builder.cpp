@@ -23,6 +23,11 @@ namespace {
 struct Interner {
   std::unordered_map<std::string, int32_t> map;
   std::vector<std::string> names;
+  // Column count of the first data line of a chunked parse session; later
+  // lines must match (np.loadtxt's rectangularity contract — the NumPy
+  // paths raise "number of columns changed"). Lives here because the
+  // interner IS the cross-chunk session state.
+  int32_t ncols = -1;
 
   int32_t intern(std::string_view s) {
     auto it = map.find(std::string(s));
@@ -57,9 +62,12 @@ extern "C" {
 // Parses a whitespace-separated edge list ("src dst" per line; lines whose
 // first non-space char equals `comment` are skipped). Returns the edge count
 // (>= 0) and malloc'd arrays the caller must free via gb_free/gb_free_names,
-// or -1 on I/O error. Endpoint tokens may be arbitrary strings; they are
-// interned to dense int32 ids in first-appearance order (matching the NumPy
-// fallback in graphmine_tpu/io/factorize.py).
+// -1 on I/O error, -3 when a non-comment data line has fewer than 2
+// tokens, or -4 when the column count changes between data lines (ADVICE
+// r3 / code-review r4: all ingestion paths reject malformed files the
+// same way np.loadtxt does). Endpoint tokens may be arbitrary strings;
+// they are interned to dense int32 ids in first-appearance order
+// (matching the NumPy fallback in graphmine_tpu/io/factorize.py).
 int64_t gb_load_edge_list(const char* path, char comment, int32_t** src_out,
                           int32_t** dst_out, char*** names_out,
                           int64_t* num_vertices) {
@@ -73,19 +81,47 @@ int64_t gb_load_edge_list(const char* path, char comment, int32_t** src_out,
   while (p < end) {
     const char* line_end = static_cast<const char*>(memchr(p, '\n', end - p));
     if (!line_end) line_end = end;
+    // Truncate at the comment char ANYWHERE in the line (np.loadtxt
+    // semantics, which every NumPy fallback path inherits): "a b # note"
+    // is an edge, "c # note" is a 1-token malformed line, a line whose
+    // first char is the comment becomes blank. Parsing must not depend
+    // on whether the .so is built.
+    const char* cpos =
+        static_cast<const char*>(memchr(p, comment, line_end - p));
+    const char* data_end = cpos ? cpos : line_end;
     const char* q = p;
-    while (q < line_end && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
-    if (q < line_end && *q != comment) {
+    while (q < data_end && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
+    if (q < data_end) {
       const char* t0 = q;
-      while (q < line_end && *q != ' ' && *q != '\t' && *q != '\r') ++q;
+      while (q < data_end && *q != ' ' && *q != '\t' && *q != '\r') ++q;
       const char* t0e = q;
-      while (q < line_end && (*q == ' ' || *q == '\t')) ++q;
+      while (q < data_end && (*q == ' ' || *q == '\t')) ++q;
       const char* t1 = q;
-      while (q < line_end && *q != ' ' && *q != '\t' && *q != '\r') ++q;
+      while (q < data_end && *q != ' ' && *q != '\t' && *q != '\r') ++q;
       const char* t1e = q;
       if (t0e > t0 && t1e > t1) {
+        // Count the remaining tokens: np.loadtxt rejects files whose
+        // data lines change column count ("number of columns changed"),
+        // and .so parity demands the same verdict (code-review r4).
+        int32_t tok = 2;
+        while (q < data_end) {
+          while (q < data_end && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
+          const char* s0 = q;
+          while (q < data_end && *q != ' ' && *q != '\t' && *q != '\r') ++q;
+          if (q > s0) ++tok;
+        }
+        if (interner.ncols < 0) {
+          interner.ncols = tok;
+        } else if (tok != interner.ncols) {
+          return -4;
+        }
         src.push_back(interner.intern({t0, size_t(t0e - t0)}));
         dst.push_back(interner.intern({t1, size_t(t1e - t1)}));
+      } else {
+        // A non-comment data line with fewer than 2 tokens: hard error
+        // (-3), matching the NumPy paths' "needs >= 2 columns" raise —
+        // silently dropping edges of a malformed file is the worse bug.
+        return -3;
       }
     }
     p = line_end + 1;
@@ -158,7 +194,10 @@ int64_t gb_interner_names(void* it, char*** names_out) {
 // index of a float weight (>= 2; tokens 0-1 are the endpoints). Returns the
 // edge count and malloc'd arrays (w_out only when weighted), -1 on
 // allocation failure, -2 when a data line lacks the weight token or it does
-// not parse as a float (matching the NumPy fallback's hard error).
+// not parse as a float, -3 when a non-comment data line has fewer than 2
+// tokens, -4 when the column count changes between data lines (all
+// matching the NumPy fallback's hard errors; -4 spans chunks via the
+// interner's ncols).
 int64_t gb_parse_edge_chunk(void* it, const char* buf, int64_t len,
                             char comment, int32_t weight_col,
                             int32_t** src_out, int32_t** dst_out,
@@ -171,9 +210,16 @@ int64_t gb_parse_edge_chunk(void* it, const char* buf, int64_t len,
   while (p < end) {
     const char* line_end = static_cast<const char*>(memchr(p, '\n', end - p));
     if (!line_end) line_end = end;
+    // Truncate at the comment char ANYWHERE in the line (np.loadtxt
+    // semantics, matching every NumPy fallback path): "a b # note" is an
+    // edge, "c # note" a 1-token malformed line, a leading-comment line
+    // blank. Parsing must not depend on whether the .so is built.
+    const char* cpos =
+        static_cast<const char*>(memchr(p, comment, line_end - p));
+    const char* data_end = cpos ? cpos : line_end;
     const char* q = p;
-    while (q < line_end && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
-    if (q < line_end && *q != comment) {
+    while (q < data_end && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
+    if (q < data_end) {
       // Tokenize; endpoints are tokens 0-1, the weight (if any) token
       // `weight_col`.
       const char* t[2] = {nullptr, nullptr};
@@ -181,9 +227,9 @@ int64_t gb_parse_edge_chunk(void* it, const char* buf, int64_t len,
       const char* wt = nullptr;
       const char* wte = nullptr;
       int32_t tok = 0;
-      while (q < line_end) {
+      while (q < data_end) {
         const char* s0 = q;
-        while (q < line_end && *q != ' ' && *q != '\t' && *q != '\r') ++q;
+        while (q < data_end && *q != ' ' && *q != '\t' && *q != '\r') ++q;
         if (q > s0) {
           if (tok < 2) {
             t[tok] = s0;
@@ -194,24 +240,36 @@ int64_t gb_parse_edge_chunk(void* it, const char* buf, int64_t len,
           }
           ++tok;
         }
-        while (q < line_end && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
+        while (q < data_end && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
       }
-      if (te[0] && te[1]) {
-        if (weight_col >= 0) {
-          if (!wt) return -2;
-          char tmp[64];
-          size_t n = static_cast<size_t>(wte - wt);
-          if (n >= sizeof(tmp)) return -2;
-          memcpy(tmp, wt, n);
-          tmp[n] = '\0';
-          char* parse_end = nullptr;
-          float val = strtof(tmp, &parse_end);
-          if (parse_end != tmp + n) return -2;
-          w.push_back(val);
-        }
-        src.push_back(interner->intern({t[0], size_t(te[0] - t[0])}));
-        dst.push_back(interner->intern({t[1], size_t(te[1] - t[1])}));
+      if (!te[1]) {
+        // Data line with < 2 tokens (te[0] is always set: the guard above
+        // saw a non-space data char). -3, the same hard error the NumPy
+        // paths raise as "needs >= 2 columns" (ADVICE r3).
+        return -3;
       }
+      if (interner->ncols < 0) {
+        interner->ncols = tok;
+      } else if (tok != interner->ncols) {
+        // np.loadtxt rectangularity: a file whose data lines change
+        // column count is rejected by the NumPy paths — .so parity
+        // demands the same verdict (code-review r4).
+        return -4;
+      }
+      if (weight_col >= 0) {
+        if (!wt) return -2;
+        char tmp[64];
+        size_t n = static_cast<size_t>(wte - wt);
+        if (n >= sizeof(tmp)) return -2;
+        memcpy(tmp, wt, n);
+        tmp[n] = '\0';
+        char* parse_end = nullptr;
+        float val = strtof(tmp, &parse_end);
+        if (parse_end != tmp + n) return -2;
+        w.push_back(val);
+      }
+      src.push_back(interner->intern({t[0], size_t(te[0] - t[0])}));
+      dst.push_back(interner->intern({t[1], size_t(te[1] - t[1])}));
     }
     p = line_end + 1;
   }

@@ -134,12 +134,16 @@ def test_orchestrate_happy_path_annotates_capture(monkeypatch, capsys):
     monkeypatch.delenv("GRAPHMINE_BENCH_BUDGET", raising=False)
     rc = bench.orchestrate("chip")
     assert rc == 0
-    rec = json.loads(capsys.readouterr().out.strip())
+    lines = capsys.readouterr().out.strip().splitlines()
+    rec = json.loads(lines[0])
     cap = rec["detail"]["capture"]
     assert rec["metric"] == "tpu_result"
     assert cap["attempts"] == 1 and cap["platform"] == "tpu"
     assert cap["cpu_fallback"] is None
     assert cap["backend_audit"] == "agree"
+    # every orchestrated run ends with the suite-summary record
+    summary = json.loads(lines[-1])
+    assert summary["metric"] == "tpu_result" and "suite" in summary
 
 
 def test_orchestrate_retries_then_falls_back(monkeypatch, capsys):
@@ -157,7 +161,7 @@ def test_orchestrate_retries_then_falls_back(monkeypatch, capsys):
     monkeypatch.delenv("GRAPHMINE_BENCH_BUDGET", raising=False)
     rc = bench.orchestrate("chip")
     assert rc == 0
-    rec = json.loads(capsys.readouterr().out.strip())
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[0])
     cap = rec["detail"]["capture"]
     assert rec["metric"] == "fallback_result"
     assert "run1" in cap["cpu_fallback"] and "run2" in cap["cpu_fallback"]
@@ -179,7 +183,7 @@ def test_orchestrate_cpu_platform_goes_straight_to_fallback(monkeypatch, capsys)
     monkeypatch.delenv("GRAPHMINE_BENCH_BUDGET", raising=False)
     rc = bench.orchestrate("chip")
     assert rc == 0
-    rec = json.loads(capsys.readouterr().out.strip())
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[0])
     cap = rec["detail"]["capture"]
     assert cap["cpu_fallback"] and "not tpu" in cap["cpu_fallback"]
     assert "backend_audit" not in cap
@@ -198,12 +202,18 @@ def test_orchestrate_total_failure_emits_error_record(monkeypatch, capsys):
     monkeypatch.delenv("GRAPHMINE_BENCH_BUDGET", raising=False)
     rc = bench.orchestrate("chip")
     assert rc == 1
-    rec = json.loads(capsys.readouterr().out.strip())
+    lines = capsys.readouterr().out.strip().splitlines()
+    rec = json.loads(lines[0])
     assert rec["metric"] == "bench_chip_capture_failed"
     assert rec["value"] == 0.0 and "error" in rec
     # spaced probing actually happened: multiple probes, sleeps between
     assert len(sleeps) >= 2 and all(0 <= s <= 180 for s in sleeps)
     assert rec["error"].count("probe") >= 3
+    # with no real record anywhere, the summary headline is the error
+    summary = json.loads(lines[-1])
+    assert summary["metric"] == "bench_chip_capture_failed"
+    assert summary["suite"]["probes"]["ok"] == 0
+    assert summary["suite"]["probes"]["n"] >= 3
 
 
 def test_orchestrate_all_healthy_prints_every_tier_chip_first(
@@ -225,16 +235,25 @@ def test_orchestrate_all_healthy_prints_every_tier_chip_first(
     assert rc == 0
     lines = capsys.readouterr().out.strip().splitlines()
     recs = [json.loads(l) for l in lines]
-    assert [r["metric"] for r in recs] == [
+    assert [r["metric"] for r in recs[:-1]] == [
         f"{t}_result" for t in bench._TIER_ORDER
     ]
     chip_cap = recs[0]["detail"]["capture"]
     assert chip_cap["backend_audit"] == "agree"
     assert chip_cap["trace"] and chip_cap["trace"][0]["ok"]
     assert "utc" in chip_cap["trace"][0]
-    for r in recs[1:]:
+    for r in recs[1:-1]:
         cap = r["detail"]["capture"]
         assert cap["platform"] == "tpu" and "trace" not in cap
+    # LAST line = suite summary: chip headline + every tier + probe digest,
+    # bounded well inside the driver artifact's 2000-char stdout tail
+    summary = recs[-1]
+    assert summary["metric"] == "chip_result"
+    assert summary["value"] == 1 and summary["unit"] == "u"
+    assert set(summary["suite"]["tiers"]) == set(bench._TIER_ORDER)
+    assert summary["suite"]["platform"] == "tpu"
+    assert summary["suite"]["probes"]["ok"] >= 1
+    assert len(lines[-1]) < 1600
 
 
 def test_orchestrate_all_dead_tunnel_fallback_all_tiers(monkeypatch, capsys):
@@ -251,8 +270,9 @@ def test_orchestrate_all_dead_tunnel_fallback_all_tiers(monkeypatch, capsys):
     monkeypatch.delenv("GRAPHMINE_BENCH_BUDGET", raising=False)
     rc = bench.orchestrate("all")
     assert rc == 0
-    recs = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
-    assert [r["metric"] for r in recs] == [
+    lines = capsys.readouterr().out.strip().splitlines()
+    recs = [json.loads(l) for l in lines]
+    assert [r["metric"] for r in recs[:-1]] == [
         f"{t}_fb" for t in bench._FALLBACK_TIERS
     ]
     cap = recs[0]["detail"]["capture"]
@@ -263,6 +283,16 @@ def test_orchestrate_all_dead_tunnel_fallback_all_tiers(monkeypatch, capsys):
     # every fallback child ran scrubbed with the reduced-scale flag
     for _, env in calls[1:]:
         assert env["GRAPHMINE_BENCH_CPU_FALLBACK"] == "1"
+    # the dead-tunnel rehearsal the r3 verdict asked for: the LAST record
+    # (what the driver artifact parses) carries the chip fallback number,
+    # every fallback tier's value, and the probe evidence
+    summary = recs[-1]
+    assert summary["metric"] == "chip_fb"
+    assert set(summary["suite"]["tiers"]) == set(bench._FALLBACK_TIERS)
+    assert summary["suite"]["platform"] == "unreachable"
+    assert summary["suite"]["probes"]["ok"] == 0
+    assert "timed out" in summary["suite"]["probes"]["first"]["info"]
+    assert len(lines[-1]) < 1600
 
 
 def test_orchestrate_all_backend_death_mid_capture_skips_rest(
@@ -286,10 +316,14 @@ def test_orchestrate_all_backend_death_mid_capture_skips_rest(
     recs = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
     assert recs[0]["metric"] == "chip_ok"
     assert recs[1]["metric"] == "bench_roofline_capture_failed"
-    for r, t in zip(recs[2:], bench._TIER_ORDER[2:]):
+    for r, t in zip(recs[2:-1], bench._TIER_ORDER[2:]):
         assert r["metric"] == f"bench_{t}_capture_failed"
         assert "unreachable mid-capture" in r["error"]
-    assert len(recs) == len(bench._TIER_ORDER)
+    assert len(recs) == len(bench._TIER_ORDER) + 1
+    # the summary still headlines the chip number and records the skips
+    summary = recs[-1]
+    assert summary["metric"] == "chip_ok"
+    assert "unreachable" in summary["suite"]["tiers"]["quality"]["err"]
 
 
 def test_orchestrate_budget_skips_attempts(monkeypatch, capsys):
@@ -302,7 +336,7 @@ def test_orchestrate_budget_skips_attempts(monkeypatch, capsys):
     monkeypatch.setenv("GRAPHMINE_BENCH_BUDGET", "100")  # < reserve + 60
     rc = bench.orchestrate("chip")
     assert rc == 0
-    rec = json.loads(capsys.readouterr().out.strip())
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[0])
     cap = rec["detail"]["capture"]
     assert any("budget exhausted" in f for f in cap["failures"])
     assert len(calls) == 1  # no probes, straight to fallback
@@ -332,9 +366,14 @@ def test_orchestrate_all_first_tier_total_failure_does_not_abort_suite(
     recs = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
     assert recs[0]["metric"] == "bench_chip_capture_failed"
     assert "run1" in recs[0]["error"] and "cpu-fallback" in recs[0]["error"]
-    assert [r["metric"] for r in recs[1:]] == [
+    assert [r["metric"] for r in recs[1:-1]] == [
         f"{t}_result" for t in bench._TIER_ORDER[1:]
     ]
+    # chip produced no real number: the summary headline falls back to the
+    # first real tier record instead of a 0.0 error line
+    summary = recs[-1]
+    assert summary["metric"] == "roofline_result"
+    assert "run1" in summary["suite"]["tiers"]["chip"]["err"]
 
 
 def test_orchestrate_all_clean_tiers_do_not_inherit_failures(
@@ -362,7 +401,7 @@ def test_orchestrate_all_clean_tiers_do_not_inherit_failures(
     assert recs[0]["detail"]["capture"]["failures"] == [
         "run1: measurement child rc=1"
     ]
-    for r in recs[1:]:
+    for r in recs[1:-1]:
         assert r["detail"]["capture"]["failures"] is None
 
 

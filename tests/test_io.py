@@ -210,6 +210,130 @@ def test_chunked_edge_cases(tmp_path):
             native.load_edge_list_chunked(str(bad), weight_col=2)
 
 
+def test_short_line_is_hard_error_on_every_path(tmp_path):
+    """ADVICE r3: a non-comment data line with < 2 tokens must be a hard
+    ValueError on EVERY ingestion path — which inputs parse must not
+    depend on whether the .so is built (the native chunk parser used to
+    silently drop such lines while the NumPy fallback raised)."""
+    import pytest
+
+    from graphmine_tpu.io import native
+    from graphmine_tpu.io.edges import load_edge_list
+
+    p = tmp_path / "short.txt"
+    p.write_bytes(b"a b\nlonely\nc d\n")
+    # NumPy bulk + NumPy chunked
+    with pytest.raises(ValueError):
+        load_edge_list(str(p), use_native=False)
+    with pytest.raises(ValueError):
+        load_edge_list(str(p), use_native=False, chunk_bytes=4)
+    # native chunked + native whole-file
+    if native.chunked_parse_available():
+        with pytest.raises(ValueError, match=">= 2 columns"):
+            native.load_edge_list_chunked(str(p))
+        # chunk boundaries must not change the verdict
+        with pytest.raises(ValueError, match=">= 2 columns"):
+            native.load_edge_list_chunked(str(p), chunk_bytes=3)
+    if native.available():
+        with pytest.raises(ValueError, match=">= 2 columns"):
+            native.load_edge_list_native(str(p))
+
+
+def test_inline_comment_parity_across_paths(tmp_path):
+    """np.loadtxt treats the comment char ANYWHERE in a line as starting a
+    comment — the native parsers must too (code-review r4 finding:
+    'a b # note' parsed to different graphs, and 'c # note' to different
+    verdicts, depending on whether the .so was built)."""
+    import pytest
+
+    from graphmine_tpu.io import native
+    from graphmine_tpu.io.edges import load_edge_list
+
+    ok = tmp_path / "trail.txt"
+    ok.write_bytes(b"a b # note\nc d\n")
+    bulk = load_edge_list(str(ok), use_native=False)
+    assert bulk.num_edges == 2 and sorted(bulk.names) == ["a", "b", "c", "d"]
+    for kw in (dict(), dict(use_native=False, chunk_bytes=4)):
+        et = load_edge_list(str(ok), **kw)
+        named = sorted(zip(et.names[et.src], et.names[et.dst]))
+        assert named == [("a", "b"), ("c", "d")], kw
+
+    bad = tmp_path / "inline.txt"
+    bad.write_bytes(b"a b\nc # note\n")  # strips to a 1-token line
+    with pytest.raises(ValueError):
+        load_edge_list(str(bad), use_native=False)
+    with pytest.raises(ValueError):
+        load_edge_list(str(bad), use_native=False, chunk_bytes=4)
+    if native.chunked_parse_available():
+        with pytest.raises(ValueError, match=">= 2 columns"):
+            native.load_edge_list_chunked(str(bad))
+    if native.available():
+        with pytest.raises(ValueError, match=">= 2 columns"):
+            native.load_edge_list_native(str(bad))
+
+
+def test_empty_vocab_names_dtype_matches_across_paths(tmp_path):
+    """ADVICE r3: a comment-only file yields the same (object-dtype) empty
+    names array on every path — the native chunked path used to produce a
+    float64 empty array."""
+    from graphmine_tpu.io import native
+    from graphmine_tpu.io.edges import load_edge_list
+
+    p = tmp_path / "comments.txt"
+    p.write_bytes(b"# only\n# comments\n")
+    bulk = load_edge_list(str(p), use_native=False)
+    assert bulk.num_edges == 0 and bulk.names.dtype == object
+    chunked_np = load_edge_list(str(p), use_native=False, chunk_bytes=5)
+    assert chunked_np.names.dtype == bulk.names.dtype
+    if native.chunked_parse_available():
+        et = native.load_edge_list_chunked(str(p))
+        assert et.num_edges == 0
+        assert et.names.dtype == bulk.names.dtype
+    if native.available():
+        # the whole-file native path (stale-.so fallback) too (review r4)
+        et = native.load_edge_list_native(str(p))
+        assert et.num_edges == 0
+        assert et.names.dtype == bulk.names.dtype
+
+
+def test_ragged_columns_rejected_on_every_path(tmp_path):
+    """np.loadtxt rejects files whose data lines change column count; the
+    native parsers and the NumPy chunked path (across chunk boundaries,
+    where per-chunk loadtxt can't see the change) must give the same
+    verdict (code-review r4 finding: 'a b c\\nd e # note' parsed natively
+    but raised in every NumPy path)."""
+    import pytest
+
+    from graphmine_tpu.io import native
+    from graphmine_tpu.io.edges import load_edge_list
+
+    p = tmp_path / "ragged.txt"
+    p.write_bytes(b"a b c\nd e # note\n")
+    with pytest.raises(ValueError):
+        load_edge_list(str(p), use_native=False)
+    # chunk split isolates each line in its own (rectangular) chunk —
+    # the cross-chunk ncols tracking must still reject
+    with pytest.raises(ValueError, match="columns changed"):
+        load_edge_list(str(p), use_native=False, chunk_bytes=6)
+    if native.chunked_parse_available():
+        for cb in (6, 1 << 20):
+            with pytest.raises(ValueError, match="columns changed"):
+                native.load_edge_list_chunked(str(p), chunk_bytes=cb)
+    if native.available():
+        with pytest.raises(ValueError, match="columns changed"):
+            native.load_edge_list_native(str(p))
+
+    # uniform extra columns stay accepted everywhere (loadtxt semantics:
+    # rectangular 3-column unweighted files parse; col 2 is ignored)
+    ok = tmp_path / "threecol.txt"
+    ok.write_bytes(b"a b 9\nc d 8\n")
+    for kw in (dict(), dict(use_native=False),
+               dict(use_native=False, chunk_bytes=6)):
+        et = load_edge_list(str(ok), **kw)
+        named = sorted(zip(et.names[et.src], et.names[et.dst]))
+        assert named == [("a", "b"), ("c", "d")], kw
+
+
 def test_ingestion_paths_fuzz_agreement(tmp_path):
     """Property fuzz over the three edge-list ingestion paths (bulk NumPy,
     chunked NumPy, chunked native): random content — random whitespace
@@ -241,6 +365,10 @@ def test_ingestion_paths_fuzz_agreement(tmp_path):
             line = a.encode() + sep + b.encode()
             if weighted:
                 line += sep + str(rng.integers(1, 32) / 4.0).encode()
+            if rng.random() < 0.15:
+                # trailing inline comment: loadtxt strips it; the native
+                # parsers must too (code-review r4 finding)
+                line += b" # trail " + str(rng.integers(99)).encode()
             lines.append(line)
         eol = b"\r\n" if rng.random() < 0.3 else b"\n"
         body = eol.join(lines)
@@ -264,3 +392,24 @@ def test_ingestion_paths_fuzz_agreement(tmp_path):
                 path, weight_col=wc, chunk_bytes=chunk
             )
             _assert_same_named_edges(nat, bulk, weights=weighted)
+
+        # malformed twin (ADVICE r3): inject a 1-token line at a random
+        # position — every path must now reject, at any chunk split
+        import pytest
+
+        bad_lines = list(lines)
+        bad_lines.insert(int(rng.integers(0, len(bad_lines) + 1)), b"stray")
+        bad_path = str(tmp_path / f"fuzz_{trial}_bad.txt")
+        with open(bad_path, "wb") as f:
+            f.write(eol.join(bad_lines) + eol)
+        with pytest.raises(ValueError):
+            load_edge_list(bad_path, use_native=False, weight_col=wc)
+        with pytest.raises(ValueError):
+            load_edge_list(
+                bad_path, use_native=False, weight_col=wc, chunk_bytes=chunk
+            )
+        if native.chunked_parse_available():
+            with pytest.raises(ValueError, match=">= 2 columns"):
+                native.load_edge_list_chunked(
+                    bad_path, weight_col=wc, chunk_bytes=chunk
+                )

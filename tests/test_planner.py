@@ -84,6 +84,95 @@ def test_hbm_env_override(monkeypatch):
     assert plan_run(v, e, num_devices=d).schedule == "replicated"
 
 
+def test_hbm_precedence_env_device_default(monkeypatch):
+    """VERDICT r3 item 3: env var → device-reported bytes → 16 GiB."""
+    from graphmine_tpu.pipeline.planner import hbm_bytes_per_device
+
+    monkeypatch.delenv("GRAPHMINE_HBM_BYTES", raising=False)
+    assert hbm_bytes_per_device() == 16 * GIB
+    # device-reported value (a v4 part) wins over the default
+    assert hbm_bytes_per_device(device_bytes=32 * GIB) == 32 * GIB
+    # env var wins over both
+    monkeypatch.setenv("GRAPHMINE_HBM_BYTES", str(2 * GIB))
+    assert hbm_bytes_per_device(device_bytes=32 * GIB) == 2 * GIB
+    # a zero/None device report falls through to the default
+    monkeypatch.delenv("GRAPHMINE_HBM_BYTES")
+    assert hbm_bytes_per_device(device_bytes=0) == 16 * GIB
+    assert hbm_bytes_per_device(device_bytes=None) == 16 * GIB
+    # lazy callable form: evaluated when env did not win...
+    assert hbm_bytes_per_device(device_bytes=lambda: 32 * GIB) == 32 * GIB
+    # ...and NEVER evaluated when it did (an env-pinned budget must not
+    # touch a flaky runtime's memory query — code-review r4)
+    monkeypatch.setenv("GRAPHMINE_HBM_BYTES", str(2 * GIB))
+
+    def boom():
+        raise AssertionError("device queried despite env override")
+
+    assert hbm_bytes_per_device(device_bytes=boom) == 2 * GIB
+
+
+def test_device_hbm_bytes_memory_stats_chain(monkeypatch):
+    """The driver's device query: bytes_limit when reported, None on CPU
+    (memory_stats() -> None), None when the runtime raises."""
+    import jax
+
+    from graphmine_tpu.pipeline import driver
+
+    class _Dev:
+        def __init__(self, stats=None, raise_=False):
+            self._stats, self._raise = stats, raise_
+
+        def memory_stats(self):
+            if self._raise:
+                raise RuntimeError("tunneled runtime")
+            return self._stats
+
+    def fake_devices(dev):
+        return lambda *a, **k: [dev]
+
+    # a v5p part reporting ~95 GiB
+    monkeypatch.setattr(
+        jax, "devices", fake_devices(_Dev({"bytes_limit": 95 * GIB}))
+    )
+    assert driver.device_hbm_bytes() == 95 * GIB
+    # CPU backend: memory_stats() is None (measured on this jax build)
+    monkeypatch.setattr(jax, "devices", fake_devices(_Dev(None)))
+    assert driver.device_hbm_bytes() is None
+    # stats dict without the key, or a raising runtime -> None
+    monkeypatch.setattr(jax, "devices", fake_devices(_Dev({"other": 1})))
+    assert driver.device_hbm_bytes() is None
+    monkeypatch.setattr(jax, "devices", fake_devices(_Dev(raise_=True)))
+    assert driver.device_hbm_bytes() is None
+
+
+def test_pipeline_plan_uses_device_reported_hbm(monkeypatch, tmp_path):
+    """End-to-end chain: with no env override, the driver budgets against
+    what the device reports — a mocked 1 MiB part forces the planner to
+    reject a graph the 16 GiB default would happily accept."""
+    import jax
+
+    from graphmine_tpu.pipeline import driver
+
+    rng = np.random.default_rng(0)
+    path = tmp_path / "edges.txt"
+    src = rng.integers(0, 2000, 30000)
+    dst = rng.integers(0, 2000, 30000)
+    path.write_text(
+        "\n".join(f"a{a} b{b}" for a, b in zip(src, dst)) + "\n"
+    )
+    monkeypatch.delenv("GRAPHMINE_HBM_BYTES", raising=False)
+
+    class _Tiny:
+        def memory_stats(self):
+            return {"bytes_limit": 1 << 20}
+
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: [_Tiny()])
+    with pytest.raises(PlanError, match="no LPA schedule fits"):
+        driver.run_pipeline(_tiny_config(
+            data_path=str(path), data_format="edgelist", num_devices=1,
+        ))
+
+
 # ---------------------------------------------------------------------------
 # driver wiring
 # ---------------------------------------------------------------------------
