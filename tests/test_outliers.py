@@ -71,6 +71,46 @@ def test_recursive_outliers_bundled(bundled_graph):
             assert report.sub_sizes[sub_index[s]] <= thr
 
 
+def test_recursive_outliers_sharded_matches_masked(bundled_graph):
+    """The scale-out composition (host intra-community edge filter →
+    distributed LPA → shared decile) reproduces the single-device masked
+    pass bit-for-bit on both distributed schedules (VERDICT r3 item 2)."""
+    from graphmine_tpu.ops.outliers import recursive_lpa_outliers_sharded
+    from graphmine_tpu.parallel.mesh import make_mesh
+
+    comm = label_propagation(bundled_graph, max_iter=5)
+    ref = recursive_lpa_outliers(bundled_graph, comm)
+    mesh = make_mesh(8)
+    for schedule in ("replicated", "ring"):
+        got = recursive_lpa_outliers_sharded(
+            bundled_graph, comm, mesh, schedule=schedule
+        )
+        np.testing.assert_array_equal(ref.sub_labels, got.sub_labels)
+        np.testing.assert_array_equal(ref.outlier_vertices, got.outlier_vertices)
+        np.testing.assert_array_equal(ref.sub_sizes, got.sub_sizes)
+        np.testing.assert_array_equal(ref.sub_parents, got.sub_parents)
+        assert ref.thresholds == got.thresholds
+
+
+def test_recursive_outliers_sharded_all_cross_community():
+    """Degenerate mask: every edge crosses communities, so the filtered
+    graph is empty and every vertex is its own sub-community — on the
+    distributed path too (empty-message partition)."""
+    from graphmine_tpu.ops.outliers import recursive_lpa_outliers_sharded
+    from graphmine_tpu.parallel.mesh import make_mesh
+
+    # bipartite edges, communities = the two sides
+    src = np.array([0, 1, 2, 3], np.int32)
+    dst = np.array([4, 5, 6, 7], np.int32)
+    g = build_graph(src, dst, num_vertices=8)
+    comm = jnp.array([0, 0, 0, 0, 1, 1, 1, 1], jnp.int32)
+    ref = recursive_lpa_outliers(g, comm)
+    got = recursive_lpa_outliers_sharded(g, comm, make_mesh(8))
+    np.testing.assert_array_equal(ref.sub_labels, got.sub_labels)
+    np.testing.assert_array_equal(got.sub_labels, np.arange(8, dtype=np.int32))
+    assert not got.outlier_vertices.any()
+
+
 def test_knn_matches_sklearn(rng):
     from sklearn.neighbors import NearestNeighbors
 
