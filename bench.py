@@ -524,19 +524,42 @@ def main_stream() -> None:
     from graphmine_tpu.ops.lof import auroc
     from graphmine_tpu.ops.streaming_lof import StreamingLOF
 
-    rng = np.random.default_rng(11)
+    rng = np.random.default_rng(
+        int(os.environ.get("GRAPHMINE_STREAM_SEED", "11"))
+    )
     n, f, chunk, cap = (1 << 20, 8, 1 << 14, 1 << 15)
     if _CPU_FALLBACK:
         # Scale EVERY dimension down — the window is the dominant cost
         # term (each re-fit is a cap x cap kNN).
         n, chunk, cap = 1 << 17, 1 << 12, 1 << 12
+    # CI band caps (the AUROC stability test runs the real body smaller).
+    n = int(os.environ.get("GRAPHMINE_STREAM_POINTS", n))
+    chunk = int(os.environ.get("GRAPHMINE_STREAM_CHUNK", chunk))
+    cap = int(os.environ.get("GRAPHMINE_STREAM_WINDOW", cap))
+    if n < 2 * chunk or n % chunk:
+        # the warmup consumes two full chunks and the timed loop assumes
+        # uniform chunk shapes (one compile for the whole stream)
+        raise ValueError(
+            f"stream sizes need n >= 2*chunk and chunk | n (n={n}, "
+            f"chunk={chunk}); fix the GRAPHMINE_STREAM_* overrides"
+        )
     k = 32
-    # stream: mixture-of-blobs inliers + 0.5% uniform-box outliers
+    # stream: mixture-of-blobs inliers + 0.5% shell outliers. Inlier radii
+    # around each center follow a chi(f=8) law (mean ~2.83, 99.9th pct
+    # ~4.4); outliers sit on a uniform [4, 6] radial shell JUST outside
+    # that envelope, so the detection axis is a real measurement — the
+    # old +/-12 uniform box saturated auroc_injected at exactly 1.0 and
+    # carried no information (VERDICT r3 item 6). Measured: ~0.986-0.989
+    # across seeds at both CPU-fallback and band-test scales.
     centers = rng.normal(size=(32, f)).astype(np.float32) * 4
     assign = rng.integers(0, 32, n)
     pts = (centers[assign] + rng.normal(size=(n, f)).astype(np.float32))
     is_out = rng.random(n) < 0.005
-    pts[is_out] = rng.uniform(-12, 12, (int(is_out.sum()), f)).astype(np.float32)
+    n_out = int(is_out.sum())
+    direction = rng.normal(size=(n_out, f)).astype(np.float32)
+    direction /= np.linalg.norm(direction, axis=1, keepdims=True)
+    radius = rng.uniform(4.0, 6.0, (n_out, 1)).astype(np.float32)
+    pts[is_out] = centers[assign[is_out]] + direction * radius
 
     # Warmup with identical shapes on a scratch instance: compiles the
     # bootstrap scorer, the cross-kNN scorer, and the window fit so the
@@ -688,6 +711,16 @@ def main_roofline() -> None:
     iters = 10
     if _CPU_FALLBACK:
         v, m, iters = 1 << 17, 1 << 20, 5
+    # CI smoke caps (VERDICT r3 item 4): the ACTUAL measurement body must
+    # be executable at tiny scale on CPU, so the tier can never fail its
+    # first contact inside a real TPU window
+    # (tests/test_bench_capture.py::test_roofline_body_cpu_smoke).
+    v = int(os.environ.get("GRAPHMINE_ROOFLINE_TABLE", v))
+    # round slots up to a whole number of 128-wide sort rows, so the
+    # row-sort rate divides by exactly the elements it sorted
+    m = int(os.environ.get("GRAPHMINE_ROOFLINE_SLOTS", m))
+    m = -(-max(m, 128) // 128) * 128
+    iters = int(os.environ.get("GRAPHMINE_ROOFLINE_ITERS", iters))
     rng = np.random.default_rng(5)
     idx = jnp.asarray(rng.integers(0, v, m).astype(np.int32))
     table0 = jnp.asarray(rng.integers(0, v, v).astype(np.int32))
