@@ -147,3 +147,87 @@ def clustering_coefficient(graph: Graph, _cached=None) -> jax.Array:
     deg = deg.astype(jnp.float32)
     wedges = deg * (deg - 1.0) / 2.0
     return jnp.where(wedges > 0, tri / jnp.maximum(wedges, 1.0), 0.0).astype(jnp.float32)
+
+
+def _splitmix64(x):
+    """Vectorized splitmix64 finalizer (uint64 in, uint64 out) — the
+    stateless per-(vertex, sample) RNG of the wedge sampler. uint64
+    wraparound is the intended modular arithmetic."""
+    with np.errstate(over="ignore"):
+        x = (x + np.uint64(0x9E3779B97F4A7C15)).astype(np.uint64)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return x ^ (x >> np.uint64(31))
+
+
+def _hash_u01(key, seed_mix):
+    """Hash uint64 keys + a pre-mixed seed to float64 uniforms in [0, 1)."""
+    with np.errstate(over="ignore"):
+        z = _splitmix64(key ^ seed_mix)
+    return (z >> np.uint64(11)).astype(np.float64) * (1.0 / (1 << 53))
+
+
+def sampled_clustering_coefficient(
+    graph: Graph, samples: int = 64, seed: int = 0, chunk_vertices: int = 1 << 20
+) -> np.ndarray:
+    """Wedge-sampled approximate local clustering coefficient ``[V]``
+    (float32, HOST NumPy) — the at-scale replacement for the exact wedge
+    pipeline (VERDICT r3 item 5).
+
+    For every vertex with simple-undirected degree >= 2, draws ``samples``
+    uniform unordered neighbor pairs (distinct within each pair, drawn
+    with replacement across pairs) and reports the closed fraction — an
+    unbiased estimator of the exact coefficient with binomial standard
+    error ``<= 1 / (2 * sqrt(samples))`` per vertex (~0.0625 at the
+    default 64; the error-bound test pins a 4-sigma envelope against the
+    exact pipeline). Work is O(V * samples * log E) membership binary
+    searches + one O(E log E) host CSR build — independent of the wedge
+    count, which is what makes the clustering feature (and therefore the
+    full 8-feature LOF set) survive at the scale where the exact
+    O(sum d+^2) wedge expansion is infeasible.
+
+    Processes vertices in ``chunk_vertices`` blocks so peak scratch memory
+    stays ~``chunk_vertices * samples`` words regardless of V. Draws are a
+    stateless splitmix64 hash of ``(seed, vertex, sample)``, so the result
+    is a pure function of the seed — changing ``chunk_vertices`` to fit
+    host RAM cannot change the estimates (pinned in tests).
+    """
+    v = graph.num_vertices
+    a, b = simple_undirected_edges(graph)
+    # full undirected adjacency CSR of the simple graph (both directions)
+    nodes = np.concatenate([a, b])
+    nbrs = np.concatenate([b, a])
+    order = np.argsort(nodes, kind="stable")
+    nbrs = nbrs[order]
+    deg = np.bincount(a, minlength=v) + np.bincount(b, minlength=v)
+    ptr = np.zeros(v + 1, np.int64)
+    np.cumsum(deg, out=ptr[1:])
+    # membership oracle: composite keys of the (a < b) edge list — already
+    # sorted by construction (simple_undirected_edges unpacks a sorted
+    # np.unique key array, and a*v+b reconstructs it exactly)
+    edge_keys = a.astype(np.int64) * v + b.astype(np.int64)
+
+    out = np.zeros(v, np.float32)
+    seed_mix = _splitmix64(np.uint64(seed & 0xFFFFFFFFFFFFFFFF))
+    active = np.flatnonzero(deg >= 2)
+    for lo in range(0, len(active), chunk_vertices):
+        vs = active[lo:lo + chunk_vertices]
+        d = deg[vs].astype(np.int64)[:, None]           # [c, 1]
+        # uniform unordered distinct pair (i, j) per sample: i uniform in
+        # [0, d), j = (i + 1 + uniform[0, d-1)) mod d
+        s_idx = np.arange(samples, dtype=np.uint64)[None, :]
+        key = vs.astype(np.uint64)[:, None] * np.uint64(2 * samples)
+        r1 = _hash_u01(key + 2 * s_idx, seed_mix)
+        r2 = _hash_u01(key + 2 * s_idx + np.uint64(1), seed_mix)
+        i = (r1 * d).astype(np.int64)
+        j = (i + 1 + (r2 * (d - 1)).astype(np.int64)) % d
+        base = ptr[vs][:, None]
+        n1 = nbrs[base + i].astype(np.int64)
+        n2 = nbrs[base + j].astype(np.int64)
+        key = np.minimum(n1, n2) * v + np.maximum(n1, n2)
+        pos = np.searchsorted(edge_keys, key)
+        closed = (pos < len(edge_keys)) & (
+            edge_keys[np.minimum(pos, len(edge_keys) - 1)] == key
+        )
+        out[vs] = closed.mean(axis=1, dtype=np.float64).astype(np.float32)
+    return out

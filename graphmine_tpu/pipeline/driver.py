@@ -238,14 +238,16 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
             return result
         with m.timed("outliers_lof", k=config.lof_k,
                      devices=n_dev if use_sharded_lof else 1,
-                     features="host-7" if scale_out else "device-8"):
+                     features="host-8-sampled" if scale_out else "device-8"):
             if scale_out:
-                # Host feature twin (no O(E) device transfer); the
-                # clustering-coefficient column is omitted at this scale —
-                # the wedge pass is infeasible exactly when the graph
-                # exceeds one device (ops/features.py docstring).
+                # Host feature twin (no O(E) device transfer). The exact
+                # wedge pipeline is infeasible exactly when the graph
+                # exceeds one device, so the clustering column comes from
+                # the wedge-SAMPLED estimator (r4): the full 8-feature
+                # set survives at scale with a bounded per-vertex error
+                # (ops/triangles.sampled_clustering_coefficient).
                 feats = standardize(vertex_features_host(
-                    graph, labels, include_clustering=False
+                    graph, labels, include_clustering="sampled"
                 ))
             else:
                 feats = standardize(vertex_features(graph, labels))

@@ -127,6 +127,42 @@ def test_triangle_free():
     np.testing.assert_array_equal(np.asarray(tri), 0)
 
 
+def test_sampled_clustering_tracks_exact(rng):
+    """The wedge-sampled estimator stays inside its binomial error bound
+    against the exact pipeline (VERDICT r3 item 5): per-vertex stderr is
+    sqrt(c(1-c)/S) <= 1/(2*sqrt(S)); we pin a 4.5-sigma worst-case
+    envelope plus a much tighter mean-error band, and exactness on
+    degenerate vertices (deg < 2 -> 0, cliques -> 1)."""
+    from graphmine_tpu.ops.triangles import sampled_clustering_coefficient
+
+    src = rng.integers(0, 200, 2000)
+    dst = rng.integers(0, 200, 2000)
+    g = build_graph(src, dst, num_vertices=200)
+    exact = np.asarray(clustering_coefficient(g))
+    s = 256
+    approx = sampled_clustering_coefficient(g, samples=s, seed=3)
+    err = np.abs(approx - exact)
+    assert err.max() <= 4.5 * 0.5 / np.sqrt(s) + 1e-6, err.max()
+    assert err.mean() <= 1.5 * 0.5 / np.sqrt(s), err.mean()
+    # determinism: same seed, same result — and because draws are a
+    # stateless hash of (seed, vertex, sample), the chunk_vertices memory
+    # knob CANNOT change the estimates
+    again = sampled_clustering_coefficient(g, samples=s, seed=3)
+    np.testing.assert_array_equal(approx, again)
+    chunked = sampled_clustering_coefficient(
+        g, samples=s, seed=3, chunk_vertices=17
+    )
+    np.testing.assert_array_equal(chunked, approx)
+    # a different seed draws different wedges
+    other = sampled_clustering_coefficient(g, samples=s, seed=4)
+    assert not np.array_equal(other, approx)
+
+    # exactly 0/1 where the estimator has no variance
+    tri_g = build_graph([0, 1, 2], [1, 2, 0], num_vertices=5)  # K3 + isolates
+    got = sampled_clustering_coefficient(tri_g, samples=8, seed=0)
+    np.testing.assert_array_equal(got, [1.0, 1.0, 1.0, 0.0, 0.0])
+
+
 def test_kcore_matches_networkx(rng):
     src, dst = _random_digraph(rng, v=60, e=400)
     g = build_graph(src, dst, num_vertices=60)

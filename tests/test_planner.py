@@ -303,7 +303,7 @@ def test_scale_out_mode_host_graph_pipeline(monkeypatch):
     # LOF still runs via the host feature twin + sharded scorer
     assert res.lof is not None and res.lof.shape == (res.graph.num_vertices,)
     lof_rec = [r for r in res.metrics.records if r.get("phase") == "outliers_lof"]
-    assert lof_rec and lof_rec[0]["features"] == "host-7"
+    assert lof_rec and lof_rec[0]["features"] == "host-8-sampled"
     # modularity host twin agrees with the device value
     comm = [r for r in res.metrics.records if r.get("phase") == "communities"][0]
     ref_comm = [r for r in ref.metrics.records if r.get("phase") == "communities"][0]
@@ -350,3 +350,13 @@ def test_vertex_features_host_parity(bundled_graph):
     got7 = vertex_features_host(host_g, labels, include_clustering=False)
     np.testing.assert_allclose(got7[:, :7], want[:, :7], rtol=2e-5, atol=2e-6)
     assert not got7[:, 7].any()
+
+    # sampled clustering (the r4 scale-out default): same first 7 columns,
+    # last column tracks the exact coefficient within the binomial bound
+    gots = vertex_features_host(
+        host_g, labels, include_clustering="sampled", clustering_samples=256
+    )
+    np.testing.assert_allclose(gots[:, :7], want[:, :7], rtol=2e-5, atol=2e-6)
+    err = np.abs(gots[:, 7] - want[:, 7])
+    assert err.max() <= 4.5 * 0.5 / np.sqrt(256) + 1e-6
+    assert err.mean() <= 1.5 * 0.5 / np.sqrt(256)

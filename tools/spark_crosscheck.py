@@ -47,6 +47,45 @@ if _REPO not in sys.path:
 _DEFAULT_DATA = "/root/reference/CommunityDetection/data/outlinks_pq"
 
 
+def evaluate_crosscheck(jvm_labels, eng_canonical, src, dst, num_vertices,
+                        max_iter):
+    """The pass criterion, factored out so it is TESTABLE without a JVM
+    (VERDICT r3 item 8): exact canonical-partition agreement, OR
+    JVM-vs-engine ARI >= the oracle's smallest-vs-largest tie-extreme ARI
+    (the envelope two legitimate runs of the reference stack itself can
+    span — GraphX's tie-break is machine-dependent, ``oracle.py``).
+
+    Validated in CI both ways (``tests/test_pipeline.py``): the oracle
+    under a seeded random-among-modes tie rule — a stand-in for any
+    legitimate JVM tie order — must be accepted across seeds, and a
+    label-shuffled broken engine must be rejected.
+
+    Returns ``(ok, result-fields dict)``.
+    """
+    from graphmine_tpu.ops.cluster_metrics import adjusted_rand_index
+    from graphmine_tpu.oracle import canonical_partition, graphx_label_propagation
+
+    lo = graphx_label_propagation(
+        src, dst, num_vertices, max_iter, tie="smallest"
+    )
+    hi = graphx_label_propagation(
+        src, dst, num_vertices, max_iter, tie="largest"
+    )
+    envelope_ari = float(adjusted_rand_index(
+        canonical_partition(lo), canonical_partition(hi)
+    ))
+
+    jvm_canon = canonical_partition(jvm_labels)
+    exact = bool(np.array_equal(jvm_canon, eng_canonical))
+    ari = float(adjusted_rand_index(jvm_canon, eng_canonical))
+    ok = exact or ari >= envelope_ari
+    return ok, {
+        "exact_canonical_match": exact,
+        "ari_jvm_vs_engine": round(ari, 6),
+        "tie_envelope_ari": round(envelope_ari, 6),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--data", default=_DEFAULT_DATA,
@@ -82,9 +121,7 @@ def main() -> int:
 
     from graphmine_tpu.graph.container import build_graph
     from graphmine_tpu.io.edges import load_edge_list, load_parquet_edges
-    from graphmine_tpu.ops.cluster_metrics import adjusted_rand_index
     from graphmine_tpu.ops.lpa import canonicalize, label_propagation
-    from graphmine_tpu.oracle import canonical_partition, graphx_label_propagation
     from graphmine_tpu.pipeline.backends import lpa_graphframes
 
     if args.data.endswith(".txt"):
@@ -101,28 +138,15 @@ def main() -> int:
         canonicalize(label_propagation(g, max_iter=args.max_iter))
     )
 
-    # 3. oracle tie-sensitivity envelope: how far can two legitimate runs
-    # of the reference stack itself diverge on this graph?
-    lo = graphx_label_propagation(
-        et.src, et.dst, et.num_vertices, args.max_iter, tie="smallest"
+    # 3. the CI-validated pass criterion (tie-sensitivity envelope)
+    ok, fields = evaluate_crosscheck(
+        jvm_labels, eng_labels, et.src, et.dst, et.num_vertices,
+        args.max_iter,
     )
-    hi = graphx_label_propagation(
-        et.src, et.dst, et.num_vertices, args.max_iter, tie="largest"
-    )
-    envelope_ari = float(adjusted_rand_index(
-        canonical_partition(lo), canonical_partition(hi)
-    ))
-
-    jvm_canon = canonical_partition(jvm_labels)
-    exact = bool(np.array_equal(jvm_canon, eng_labels))
-    ari = float(adjusted_rand_index(jvm_canon, eng_labels))
-    ok = exact or ari >= envelope_ari
 
     print(json.dumps({
         "crosscheck": "agree" if ok else "DISAGREE",
-        "exact_canonical_match": exact,
-        "ari_jvm_vs_engine": round(ari, 6),
-        "tie_envelope_ari": round(envelope_ari, 6),
+        **fields,
         "jvm_communities": int(len(np.unique(jvm_labels))),
         "engine_communities": int(len(np.unique(eng_labels))),
         "vertices": et.num_vertices,
