@@ -360,6 +360,35 @@ def _bucket_mode(mat: jax.Array) -> jax.Array:
     return _rowwise_mode(mat)
 
 
+def _segmented_row_cumsum(new_run: jax.Array, vals: jax.Array) -> jax.Array:
+    """Inclusive per-run cumulative sum along axis 1, reset where
+    ``new_run`` is set — an UNROLLED Hillis-Steele segmented scan
+    (log2(w) steps of static pad/slice + add/select).
+
+    Replaces ``lax.associative_scan`` with the same segmented-⊕ operator:
+    the generic scan's recursive odd/even splitting took the r4 weighted
+    chip tier past its 900 s child timeout on real TPU — minutes of
+    Mosaic compile PER width class (the same pathology
+    ``segment.py:segment_mode`` documents for 1-D scans, where the fix is
+    ``lax.cummax``; no native segmented-sum cumulative op exists, hence
+    the manual unroll here). Numerics match the scan: every within-run
+    prefix is a sum of that run's elements only — never differences of a
+    row-wide cumsum, whose float32 ulp at wide rows would misrank labels.
+    """
+    flag = new_run
+    val = vals
+    d = 1
+    w = vals.shape[1]
+    while d < w:
+        # combine x[p-d] into x[p]; identity (False, 0) pads the left edge
+        a_f = jnp.pad(flag[:, :-d], ((0, 0), (d, 0)), constant_values=False)
+        a_v = jnp.pad(val[:, :-d], ((0, 0), (d, 0)))
+        val = jnp.where(flag, val, a_v + val)
+        flag = flag | a_f
+        d *= 2
+    return val
+
+
 def _rowwise_wmode(lbl: jax.Array, wgt: jax.Array) -> jax.Array:
     """Weighted mode of each ``[n, w]`` row: argmax of per-label weight
     sums, ties toward the smallest label. Sentinel slots carry weight 0
@@ -381,13 +410,7 @@ def _rowwise_wmode(lbl: jax.Array, wgt: jax.Array) -> jax.Array:
     new_run = jnp.concatenate(
         [jnp.ones((s.shape[0], 1), jnp.bool_), s[:, 1:] != s[:, :-1]], axis=1
     )
-
-    def _seg_comb(a, b):
-        af, av = a
-        bf, bv = b
-        return af | bf, jnp.where(bf, bv, av + bv)
-
-    _, score = lax.associative_scan(_seg_comb, (new_run, ws), axis=1)
+    score = _segmented_row_cumsum(new_run, ws)
     score = jnp.where(s == _SENTINEL, -1.0, score)
     best = score.max(axis=1)
     cand = jnp.where(score == best[:, None], s, _SENTINEL)

@@ -82,7 +82,10 @@ def spectral_embedding(
     active = (deg > 0).astype(jnp.float32)[:, None]
 
     def deflate(x):
-        return (x - triv[:, None] * (triv @ x)[None, :]) * active
+        # true-f32 product: the MXU's default bf16 rounding is enough to
+        # perturb the deflation direction across backends (r4 audit class)
+        proj = jnp.matmul(triv, x, precision=lax.Precision.HIGHEST)
+        return (x - triv[:, None] * proj[None, :]) * active
 
     x0 = jax.random.normal(jax.random.PRNGKey(seed), (v, b), jnp.float32)
 

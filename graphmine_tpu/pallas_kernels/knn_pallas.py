@@ -53,10 +53,13 @@ def _knn_kernel(rows_ref, cols_ref, out_d_ref, out_i_ref, best_d, best_i,
     rows = rows_ref[:]                                   # [TM, F]
     cols = cols_ref[:]                                   # [TC, F]
     # d2[a, b] = |r_a|^2 - 2 r_a . c_b + |c_b|^2 — the matmul is the MXU op.
+    # precision=HIGHEST: match the XLA oracle's true-f32 products — the
+    # MXU's default bf16 rounding diverged ~1e-2 from CPU (r4 audit).
     cross = jax.lax.dot_general(
         rows, cols,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )                                                    # [TM, TC]
     row_sq = jnp.sum(rows * rows, axis=1, keepdims=True)
     col_sq = jnp.sum(cols * cols, axis=1)[None, :]
