@@ -50,7 +50,16 @@ from graphmine_tpu.ops.features import (
 )
 from graphmine_tpu.ops.knn import knn
 from graphmine_tpu.ops.lof import lof_scores
-from graphmine_tpu.ops.triangles import triangle_count, clustering_coefficient
+from graphmine_tpu.ops.outliers import (
+    masked_label_propagation,
+    recursive_lpa_outliers,
+    recursive_lpa_outliers_sharded,
+)
+from graphmine_tpu.ops.triangles import (
+    triangle_count,
+    clustering_coefficient,
+    sampled_clustering_coefficient,
+)
 from graphmine_tpu.ops.kcore import core_numbers
 from graphmine_tpu.ops.mis import greedy_color, maximal_independent_set
 from graphmine_tpu.ops.linkpred import link_prediction
@@ -114,6 +123,10 @@ __all__ = [
     "score_lof",
     "triangle_count",
     "clustering_coefficient",
+    "sampled_clustering_coefficient",
+    "masked_label_propagation",
+    "recursive_lpa_outliers",
+    "recursive_lpa_outliers_sharded",
     "core_numbers",
     "maximal_independent_set",
     "greedy_color",

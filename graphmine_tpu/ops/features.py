@@ -39,8 +39,10 @@ def vertex_features(
     from organically embedded hubs — raw degree cannot under a power-law
     degree distribution: legitimate hubs out-degree injected anomalies by
     orders of magnitude. Measured on the AUROC harness (`bench.py --tier
-    lof`, 3 seeds): 0.89–0.91 with the first six features, 0.91–0.93
-    with all eight. Degree-ish features are log-scaled to tame the power
+    lof`): r1 CPU-class measurements 0.89–0.91 with the first six
+    features, 0.91–0.93 with all eight; r4 real-TPU capture (after the
+    true-f32 distance fix, which alone moved the headline from 0.92 to
+    0.99) 0.9905 with all eight. Degree-ish features are log-scaled to tame the power
     law (max degree 1,223 at 4.6K vertices on the bundled data — SURVEY
     §7 hard part 3); fractions are already in [0, 1].
     """
@@ -128,11 +130,14 @@ def vertex_features_host(
       ``<= 1/(2*sqrt(clustering_samples))``), whose cost is independent
       of the wedge count — so the full 8-feature set survives at the
       scale where the exact O(sum d+^2) expansion is infeasible.
-    * ``False`` — zero the column (7 informative features). Measured on
-      the lof-tier AUROC harness (``bench.py --tier lof`` detail): the
-      7-feature config and the sampled-8 config are both scored next to
-      the exact-8 headline every run, so the as-deployed scale-out
-      quality is a recorded number, not a proxy band (VERDICT r3 item 5).
+    * ``False`` — zero the column (7 informative features). The lof-tier
+      AUROC harness (``bench.py --tier lof`` detail) scores the 7-feature
+      and sampled-8 configs next to the exact-8 headline every run, so
+      the as-deployed scale-out quality is a recorded number, not a
+      proxy band (VERDICT r3 item 5). r4 real-TPU capture (65K vertices,
+      64 injected anomalies, k=128, after the true-f32 distance fix):
+      exact-8 **0.9905**, host-7 **0.9940**, sampled-8 **0.9887** — all
+      three configs within ~0.005 of each other at this scale.
     """
     import numpy as np
 
