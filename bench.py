@@ -728,23 +728,25 @@ def main_roofline() -> None:
 
     _setup_jax_cache()
 
-    # DESIGN.md model. gather/scatter: r1 interactive measurements,
-    # confirmed by the r4 driver-captured run (0.88/0.92 of model on a
-    # real v5e). row sort: recalibrated in r4 — the r1 figure of 1.6G
-    # elem/s was polluted by exactly the loop-invariant hoisting this
-    # tier's feedback chaining exists to prevent (DESIGN.md's own
-    # microbenchmark warning); the honest steady-state rate of a [n, 128]
-    # bitonic row sort on v5e measured 40.0M elem/s (r4 capture), which
-    # is why the fused kernel replaces sorts with pairwise/histogram
-    # modes wherever it can.
+    # DESIGN.md model (r1 interactive measurements, all three REPRODUCED
+    # by the r4 robust loop on a real v5e: gather 131-135M, scatter
+    # ~141M, sort 1.85-2.6G — bench_r4_roofline_robust.log). Measurement
+    # provenance matters on this tunneled device: a naive loop reads the
+    # sort 10-40x LOW because per-iteration dispatch (~0.1 s) and a
+    # full-operand completion fetch (32 MB through the tunnel) swamp the
+    # ~4 ms of actual sort compute — hence timed() runs every iteration
+    # inside ONE fori_loop dispatch and fetches a device-side slice.
     model = {
         "gather_slots_per_sec": 125e6,
         "scatter_add_per_sec": 135e6,
-        "row_sort_elems_per_sec": 40e6,
+        "row_sort_elems_per_sec": 1.6e9,
     }
 
+    # 30 chained iterations inside one dispatch: the remote-tunnel fetch
+    # latency (~0.1 s) is a fixed tax on the timing window, so more
+    # device work per window tightens the estimate (~3 s per primitive).
     v, m = 1 << 20, 1 << 23
-    iters = 10
+    iters = 30
     if _CPU_FALLBACK:
         v, m, iters = 1 << 17, 1 << 20, 5
     # CI smoke caps (VERDICT r3 item 4): the ACTUAL measurement body must
@@ -762,15 +764,36 @@ def main_roofline() -> None:
     table0 = jnp.asarray(rng.integers(0, v, v).astype(np.int32))
 
     def timed(step, x0, elems):
-        """Steady-state rate of ``step`` chained through its own output."""
-        x = step(x0)
-        np.asarray(jax.tree_util.tree_leaves(x)[0])[:1]  # compile + settle
-        x = x0
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            x = step(x)
-        np.asarray(jax.tree_util.tree_leaves(x)[0])[:1]  # completion fetch
-        return elems * iters / (time.perf_counter() - t0)
+        """Steady-state rate of ``step`` chained through its own output.
+
+        All ``iters`` repetitions run inside ONE jitted ``fori_loop`` so
+        the window holds exactly one dispatch: per-call tunnel/host
+        latency (~100 ms on the axon TPU path) was large enough relative
+        to the ~100 ms compute of a 10-iteration Python loop to swing the
+        measured gather rate 110M→67M slots/s between otherwise identical
+        r4 runs. The data-dependence chaining (each iteration consumes
+        the previous result) still prevents hoisting."""
+        loop = jax.jit(
+            lambda x: jax.lax.fori_loop(0, iters, lambda i, y: step(y), x)
+        )
+
+        def fetch(x):
+            # completion signal: slice ON DEVICE, then pull ~bytes — a
+            # full-leaf np.asarray would drag the whole (up to 32 MB)
+            # operand through the tunnel inside the timing window
+            np.asarray(jax.tree_util.tree_leaves(x)[0][:1])
+
+        fetch(loop(x0))  # compile + settle
+        best = float("inf")
+        for _ in range(3):
+            # best-of-3 windows: the tunneled device's timing jitters
+            # ±20% between identical windows; the fastest window is the
+            # least-interrupted one (standard microbenchmark practice).
+            t0 = time.perf_counter()
+            x = loop(x0)
+            fetch(x)
+            best = min(best, time.perf_counter() - t0)
+        return elems * iters / best
 
     # Random gather: the checksum write into slot 0 makes iteration i+1's
     # gather depend on iteration i's result.
@@ -782,11 +805,20 @@ def main_roofline() -> None:
     scatter_rate = timed(scatter, jnp.zeros((v,), jnp.int32), m)
 
     # Row-wise sort of [n, w] buckets (the LPA mode kernel's width-class
-    # shape). XOR re-scrambles each round so every sort does real work.
+    # shape). The re-scramble between rounds is an odd-multiplier
+    # bijection (wraps mod 2^32): a plain XOR of the previous SORTED
+    # output leaves piecewise-sorted runs that an adaptive sort exploits
+    # unevenly — measured 26M-175M elem/s swings between identical runs —
+    # while the multiply destroys the order entirely, so every iteration
+    # sorts genuinely shuffled data.
     rows = jnp.asarray(
         rng.integers(0, 1 << 30, (m // 128, 128)).astype(np.int32)
     )
-    row_sort = jax.jit(lambda x: jnp.sort(x ^ jnp.int32(0x5A5A5A5A), axis=-1))
+    row_sort = jax.jit(
+        lambda x: jnp.sort(
+            x * jnp.int32(-1640531527) + jnp.int32(0x5A5A5A5A), axis=-1
+        )
+    )
     sort_rate = timed(row_sort, rows, m)
 
     # Segment-sum over sorted ids (the census/reduce primitive).
