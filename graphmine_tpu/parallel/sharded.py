@@ -191,7 +191,7 @@ def partition_graph(
 def _build_shard_bucket_plan(deg, send_pad, counts, chunk_size, d, w_pad=None):
     """Stacked per-shard degree-bucket plan with uniform shapes.
 
-    Every shard's owned vertices are bucketed on the shared 1.5x width
+    Every shard's owned vertices are bucketed on the shared 1.10x width
     ladder (``ops/bucketed_mode._extend_widths``); per class the row count
     is padded to the max across shards so one SPMD program serves all
     devices. No histogram path here — a per-shard [n, V] count matrix

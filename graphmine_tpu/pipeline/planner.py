@@ -22,7 +22,8 @@ count M = 2E for a directed edge list propagated both ways):
                   ≈2.5E + per-bucket gather transient ≈2.5E
     +  8 B/vertex labels in + out
     + 16 B/edge   when weighted (msg_weight 2E floats + slot-aligned
-                  weight matrices ≈2E after the 1.5x ladder)
+                  weight matrices ≈2E after the width ladder; the r4 1.10x
+                  ladder pads ~10%, so ≈2E stays conservative)
 
   replicated (parallel/sharded.py, lpa_only=True trimming)
       36 B/edge / D   the same O(E) arrays, vertex-range sharded
