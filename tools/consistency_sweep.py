@@ -10,8 +10,12 @@ after touching any superstep/plan/partition code:
         XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
         PYTHONPATH=. python tools/consistency_sweep.py [num_seeds] [first_seed] [--big]
 
-``first_seed`` chunks long sweeps into fresh processes (XLA:CPU's LLVM
-JIT arena exhausts after ~50 unique-shape compilations per process).
+Chunking into fresh processes is AUTOMATIC since r4 (XLA:CPU's LLVM JIT
+arena exhausts after a bounded number of unique-shape compilations per
+process — and the 1.10x width ladder's extra bucket classes dropped the
+per-process ceiling from ~50 to ~20 small-tier seeds): a parent re-execs
+the sweep in ``GRAPHMINE_SWEEP_CHUNK``-seed children (default 12 small /
+4 big). ``first_seed`` still works for manual ranges.
 ``--big`` switches to the big-graph tier: fewer, larger cases (2K-40K
 vertices) with injected mega-hubs (degree 2500-6000) so the histogram /
 wide bucket classes and large ring rotations are exercised.
@@ -23,6 +27,7 @@ pmax-coupled stopping rule.
 """
 
 import os
+import subprocess
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -232,9 +237,43 @@ def sweep(num_seeds: int = 30, first_seed: int = 0, big: bool = False) -> int:
     return 0
 
 
+def _chunk_size(big: bool) -> int:
+    """Seeds per child process (env-tunable, clamped >= 1 — a zero or
+    negative override must not spawn empty children forever)."""
+    return max(
+        int(os.environ.get("GRAPHMINE_SWEEP_CHUNK", "4" if big else "12")), 1
+    )
+
+
+def _chunked_main(n: int, first: int, big: bool) -> int:
+    """Self-chunking driver: re-exec the sweep in fresh child processes
+    every ``chunk`` seeds. XLA:CPU's LLVM JIT arena exhausts after a
+    bounded number of unique-shape compilations per process ("Cannot
+    allocate memory" from execution_engine.cc) — with the r4 1.10x width
+    ladder (~3.5x the populated bucket classes per graph) the ceiling
+    dropped from ~50 to ~20 small-tier seeds, so chunking is now
+    automatic instead of operator folklore."""
+    chunk = _chunk_size(big)
+    done = 0
+    while done < n:
+        take = min(chunk, n - done)
+        argv = [sys.executable, os.path.abspath(__file__),
+                str(take), str(first + done)] + (["--big"] if big else [])
+        rc = subprocess.run(argv).returncode
+        if rc != 0:
+            return rc
+        done += take
+    print(f"consistency sweep: all {n} cases agree across every path "
+          f"(chunked x{chunk})")
+    return 0
+
+
 if __name__ == "__main__":
     args = [a for a in sys.argv[1:] if a != "--big"]
     big = "--big" in sys.argv[1:]
     n = int(args[0]) if args else 30
     first = int(args[1]) if len(args) > 1 else 0
-    sys.exit(sweep(n, first, big))
+    if os.environ.get("_GRAPHMINE_SWEEP_CHILD") == "1" or n <= _chunk_size(big):
+        sys.exit(sweep(n, first, big))
+    os.environ["_GRAPHMINE_SWEEP_CHILD"] = "1"  # children run directly
+    sys.exit(_chunked_main(n, first, big))
