@@ -121,6 +121,27 @@ def test_bucketed_superstep_matches_sort_based(rng):
     np.testing.assert_array_equal(full, fast)
 
 
+def test_bucketed_plan_padding_stays_tight():
+    """Gathered-slots regression guard: the 1.10x width ladder (r4) holds
+    plan padding <= 10% on a power-law graph — the gather-bound superstep
+    pays wall-clock for every padded slot (the ladder refinement moved
+    the chip tier 54.2 -> 62.6M edges/s/chip, docs/DESIGN.md), so a
+    ladder change that quietly re-widens rows must fail here."""
+    from graphmine_tpu.ops.bucketed_mode import build_graph_and_plan
+
+    rng = np.random.default_rng(99)
+    v, e = 20_000, 200_000
+    raw = rng.pareto(1.2, size=2 * e)
+    ids = np.minimum((raw * v / 30).astype(np.int64), v - 1).astype(np.int32)
+    g, plan = build_graph_and_plan(ids[:e], ids[e:], num_vertices=v)
+    slots = sum(int(np.prod(m.shape)) for m in plan.send_idx)
+    if plan.hist_send is not None:
+        slots += int(plan.hist_send.shape[0])
+    messages = g.num_messages
+    assert slots >= messages  # padding can't be negative
+    assert slots <= 1.10 * messages, (slots, messages)
+
+
 def test_bucketed_plan_graph_mismatch_raises(rng):
     import jax.numpy as jnp
     import pytest
