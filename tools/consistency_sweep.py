@@ -221,14 +221,38 @@ def sweep(num_seeds: int = 30, first_seed: int = 0, big: bool = False) -> int:
             k = int(rng.integers(2, min(16, -(-n_pts // d)) + 1))
             if can_shard(n_pts, d, k):
                 pts = rng.normal(size=(n_pts, f_dim)).astype(np.float32)
-                kd, _ = knn(pts, k=k, impl="xla")
+                # one all-pairs pass at k+1: the first k columns are the
+                # k-NN answer (top-k prefixes are stable), the extra
+                # column feeds the boundary-tie mask below
+                kx = min(k + 1, n_pts - 1)
+                kd1, ki1 = knn(pts, k=kx, impl="xla")
+                kd1, ki1 = np.asarray(kd1), np.asarray(ki1)
                 sd, _ = sharded_knn(pts, mesh, k=k, row_tile=32)
                 assert np.allclose(
-                    np.asarray(sd), np.asarray(kd), rtol=1e-5, atol=1e-5
+                    np.asarray(sd), kd1[:, :k], rtol=1e-5, atol=1e-5
                 ), f"sharded knn d2: {tag}"
                 lw = np.asarray(lof_scores(pts, k=k, impl="xla"))
                 lg = np.asarray(sharded_lof(pts, mesh, k=k, row_tile=32))
-                assert np.allclose(lg, lw, rtol=5e-3, atol=2e-3), f"sharded lof: {tag}"
+                # LOF is only defined up to kNN tie-breaking: when a row's
+                # k-th and (k+1)-th neighbor distances coincide within the
+                # very tolerance this sweep grants the distances (seed
+                # 5018 found an exact float32 boundary tie in a random
+                # cloud), the two paths may legitimately keep different
+                # neighbor SETS, and the difference propagates two hops
+                # (k-distance -> neighbors' lrd -> LOF). Compare only rows
+                # outside that two-hop tie neighborhood — tightly.
+                ki = ki1[:, :k]
+                if kd1.shape[1] > k:
+                    gap = kd1[:, k] - kd1[:, k - 1]
+                    tie = gap <= 1e-5 * np.maximum(kd1[:, k - 1], 0.0) + 1e-5
+                else:
+                    tie = np.zeros(n_pts, bool)
+                amb = tie | tie[ki].any(1)
+                amb |= amb[ki].any(1)
+                assert np.allclose(
+                    lg[~amb], lw[~amb], rtol=5e-3, atol=2e-3
+                ), f"sharded lof: {tag}"
+                assert amb.mean() < 0.5, f"lof check vacuous: {tag}"
 
         checked += 1
         if checked % 10 == 0 or big:
