@@ -227,32 +227,50 @@ def sweep(num_seeds: int = 30, first_seed: int = 0, big: bool = False) -> int:
                 kx = min(k + 1, n_pts - 1)
                 kd1, ki1 = knn(pts, k=kx, impl="xla")
                 kd1, ki1 = np.asarray(kd1), np.asarray(ki1)
-                sd, _ = sharded_knn(pts, mesh, k=k, row_tile=32)
+                sd = np.asarray(sharded_knn(pts, mesh, k=k, row_tile=32)[0])
                 assert np.allclose(
-                    np.asarray(sd), kd1[:, :k], rtol=1e-5, atol=1e-5
+                    sd, kd1[:, :k], rtol=1e-5, atol=1e-5
                 ), f"sharded knn d2: {tag}"
                 lw = np.asarray(lof_scores(pts, k=k, impl="xla"))
                 lg = np.asarray(sharded_lof(pts, mesh, k=k, row_tile=32))
                 # LOF is only defined up to kNN tie-breaking: when a row's
                 # k-th and (k+1)-th neighbor distances coincide within the
-                # very tolerance this sweep grants the distances (seed
-                # 5018 found an exact float32 boundary tie in a random
-                # cloud), the two paths may legitimately keep different
-                # neighbor SETS, and the difference propagates two hops
-                # (k-distance -> neighbors' lrd -> LOF). Compare only rows
-                # outside that two-hop tie neighborhood — tightly.
-                ki = ki1[:, :k]
-                if kd1.shape[1] > k:
+                # paths' ACTUAL distance discrepancy (usually 0 or a few
+                # float32 ulps — seed 5018 found an exact boundary tie in
+                # a random cloud), the two paths may legitimately keep
+                # different neighbor SETS, and the difference propagates
+                # two hops (k-distance -> neighbors' lrd -> LOF). Tiered
+                # assert: every row must agree tightly UNLESS it sits in
+                # the two-hop neighborhood of a boundary tie — a
+                # disagreement anywhere else always fails, so the check
+                # cannot go vacuous even though one tie at k=14 blankets
+                # 2/3 of a 330-point cloud two hops out (seed 6009).
+                close = np.isclose(lg, lw, rtol=5e-3, atol=2e-3)
+                if not close.all() and kd1.shape[1] > k:
+                    ki = ki1[:, :k]
                     gap = kd1[:, k] - kd1[:, k - 1]
-                    tie = gap <= 1e-5 * np.maximum(kd1[:, k - 1], 0.0) + 1e-5
-                else:
-                    tie = np.zeros(n_pts, bool)
-                amb = tie | tie[ki].any(1)
-                amb |= amb[ki].any(1)
-                assert np.allclose(
-                    lg[~amb], lw[~amb], rtol=5e-3, atol=2e-3
-                ), f"sharded lof: {tag}"
-                assert amb.mean() < 0.5, f"lof check vacuous: {tag}"
+                    obs = float(np.abs(sd - kd1[:, :k]).max())
+                    # the excuse stays honest only while the tie window is
+                    # ulp-scale: if the paths' distances ever drift to the
+                    # magnitude the allclose above merely tolerates, a
+                    # window built on that drift could blanket every row
+                    # and excuse a real bug — fail LOUDLY on drift instead
+                    eps32 = np.finfo(np.float32).eps
+                    d2_scale = max(float(kd1[:, k - 1].max()), 1.0)
+                    assert obs <= 32 * eps32 * d2_scale, (
+                        f"sharded knn d2 drift {obs:.3g}: {tag}"
+                    )
+                    # 2*obs: the k-th and (k+1)-th candidates are each
+                    # independently perturbed (and the (k+1)-th column is
+                    # not in sd to measure)
+                    eps_row = 2 * obs + 8 * eps32 * (
+                        np.maximum(kd1[:, k - 1], 1e-30)
+                    )
+                    tie = gap <= eps_row
+                    amb = tie | tie[ki].any(1)
+                    amb |= amb[ki].any(1)
+                    close |= amb
+                assert close.all(), f"sharded lof: {tag}"
 
         checked += 1
         if checked % 10 == 0 or big:
