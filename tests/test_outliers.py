@@ -92,6 +92,29 @@ def test_recursive_outliers_sharded_matches_masked(bundled_graph):
         assert ref.thresholds == got.thresholds
 
 
+def test_recursive_outliers_sharded_ignores_weights_like_masked(rng):
+    """The recursive pass is unweighted by definition (parity with
+    masked_label_propagation, whose mode is a count) — on a WEIGHTED
+    graph the sharded composition must still match the masked pass
+    bit-for-bit, i.e. neither may let msg_weight leak into the
+    sub-community LPA."""
+    from graphmine_tpu.ops.outliers import recursive_lpa_outliers_sharded
+    from graphmine_tpu.parallel.mesh import make_mesh
+
+    src = rng.integers(0, 200, 1200).astype(np.int32)
+    dst = rng.integers(0, 200, 1200).astype(np.int32)
+    w = (rng.integers(1, 16, 1200) / 4.0).astype(np.float32)
+    g = build_graph(src, dst, num_vertices=200, edge_weights=w)
+    comm = label_propagation(g, max_iter=3)
+    ref = recursive_lpa_outliers(g, comm, max_iter=4)
+    got = recursive_lpa_outliers_sharded(
+        g, comm, make_mesh(8), max_iter=4, schedule="ring"
+    )
+    np.testing.assert_array_equal(ref.sub_labels, got.sub_labels)
+    np.testing.assert_array_equal(ref.outlier_vertices, got.outlier_vertices)
+    assert ref.thresholds == got.thresholds
+
+
 def test_recursive_outliers_sharded_all_cross_community():
     """Degenerate mask: every edge crosses communities, so the filtered
     graph is empty and every vertex is its own sub-community — on the
