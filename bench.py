@@ -190,6 +190,37 @@ def main_lof() -> None:
     auroc_8s = float(auroc(np.asarray(lof_scores(standardize(
         vertex_features_host(host_g, np_labels, include_clustering="sampled")
     ), k=128)), truth))
+
+    # Pallas-vs-XLA kNN on the SAME feature matrix this tier scores with
+    # (VERDICT r4 item 5): the r1-r4 auto-policy assumed Pallas wins on
+    # TPU for any k <= 128; the r5 silicon sweep measured XLA's tiled
+    # dot+top_k FASTER for every k > 8 (ops/knn.py provenance table), so
+    # impl="auto" now deploys XLA at this tier's k=128. This block
+    # regenerates both ends of that decision each capture: the deployed
+    # k=128 point and the k=8 crossover point where Pallas still wins.
+    # Timed on the real backend only (no Mosaic kernel on CPU fallback).
+    knn_timing = None
+    if not _CPU_FALLBACK and jax.default_backend() == "tpu":
+        from graphmine_tpu.ops.knn import knn as knn_fn
+
+        feats_dev = jax.device_put(np.asarray(feats))
+        knn_timing = {"points": int(feats_dev.shape[0]), "by_k": {}}
+        for kk in (8, 128):
+            row = {}
+            for impl in ("pallas", "xla"):
+                d2, _ = knn_fn(feats_dev, k=kk, impl=impl)
+                np.asarray(d2[:1])  # compile + settle
+                best = float("inf")
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    d2, _ = knn_fn(feats_dev, k=kk, impl=impl)
+                    np.asarray(d2[:1])
+                    best = min(best, time.perf_counter() - t0)
+                row[f"{impl}_seconds"] = round(best, 4)
+            row["pallas_speedup_vs_xla"] = round(
+                row["xla_seconds"] / row["pallas_seconds"], 3
+            )
+            knn_timing["by_k"][str(kk)] = row
     print(
         json.dumps(
             {
@@ -213,6 +244,10 @@ def main_lof() -> None:
                     # host-8 with sampled clustering (VERDICT r3 item 5)
                     "auroc_host_7feat": round(auroc_7, 4),
                     "auroc_host_8feat_sampled": round(auroc_8s, 4),
+                    # real-silicon Pallas-vs-XLA kNN at the deployed k=128
+                    # and the k=8 crossover (r4 item 5); None off-TPU —
+                    # the full policy citation lives in ops/knn.py
+                    "knn_impl_timing": knn_timing,
                     "device": str(jax.devices()[0]),
                 },
             }
@@ -451,12 +486,38 @@ def main_snap() -> None:
     )
 
 
+# Quality-tier SBM configs: (name, block_sizes, p_in, p_out). The LAST
+# entry is always the headline — the detectability-MARGIN config whose
+# best-ARI sits mid-band (~0.75-0.95; tests/test_bench_capture.py pins the
+# seed band on the real margin-20k parameters). Exported as constants so
+# the band test asserts on the exact deployed parameters, not a copy.
+QUALITY_CONFIGS = [
+    ("sbm-2k", [100] * 20, 0.1, 0.002),
+    ("sbm-20k", [400] * 50, 0.04, 0.0004),
+    ("sbm-margin-20k", [400] * 50, 0.028, 0.0008),
+]
+QUALITY_CONFIGS_FALLBACK = [
+    ("sbm-2k", [100] * 20, 0.1, 0.002),
+    ("sbm-margin-2k", [100] * 20, 0.08, 0.008),
+]
+
+
 def main_quality() -> None:
     """Quality tier (VERDICT r1 item 8): community-detection *accuracy* —
     the ``Overview:9`` axis the reference names but never measures.
 
     ARI/NMI against SBM planted truth plus modularity, for LPA vs Louvain
-    vs Leiden at two scales. Headline value: best ARI on the larger SBM."""
+    vs Leiden. Headline value (r5, VERDICT r4 item 4): best ARI on the
+    detectability-MARGIN SBM — the r1-r4 headline configs have 50-100x
+    p_in/p_out ratios that any good method fully recovers (ARI 1.0, a
+    ceiling that can't show a regression, the same defect the r4 stream
+    fix removed). The margin config balances in-block degree ~11 against
+    out-block degree ~16, right above the recovery threshold: the r5 CPU
+    sweep measured best-ARI {0.83, 0.84, 0.81, 0.94} across seeds 3/4/5/11
+    (p_in=0.026 collapses to 0.54-0.87, p_in=0.03 saturates at 0.98), so
+    the recorded value sits mid-band with room to regress in both
+    directions; tests pin the seed band. The easy configs stay in detail
+    as the recoverable-regime parity check."""
     import jax
 
     _setup_jax_cache()
@@ -471,15 +532,17 @@ def main_quality() -> None:
     from graphmine_tpu.ops.lpa import label_propagation
     from graphmine_tpu.ops.modularity import modularity
 
-    configs = [
-        ("sbm-2k", [100] * 20, 0.1, 0.002),
-        ("sbm-20k", [400] * 50, 0.04, 0.0004),
-    ]
+    seed = int(os.environ.get("GRAPHMINE_QUALITY_SEED", "3"))
+    configs = QUALITY_CONFIGS
     if _CPU_FALLBACK:
-        configs = configs[:1]
+        # Reduced scale, but keep a margin config so even the degraded
+        # record carries a non-saturated value (best-ARI ~0.5-0.8 — the
+        # 2k blocks are too small for a tight band; the pinned band test
+        # runs the REAL margin-20k config instead).
+        configs = QUALITY_CONFIGS_FALLBACK
     out = []
     for name, sizes, p_in, p_out in configs:
-        src, dst, truth = sbm(sizes, p_in, p_out, seed=3)
+        src, dst, truth = sbm(sizes, p_in, p_out, seed=seed)
         v = int(truth.shape[0])
         g = build_graph(src, dst, num_vertices=v)
         rec = {"config": name, "vertices": v, "edges": int(len(src)), "algos": {}}
@@ -505,8 +568,10 @@ def main_quality() -> None:
         out.append(rec)
         print(json.dumps({"progress": rec}), file=sys.stderr, flush=True)
 
-    big = out[-1]
-    best = max(a["ari"] for a in big["algos"].values())
+    # Headline: the MARGIN config (always last) — the only one whose value
+    # can move in either direction. The easy configs ride in detail.
+    margin = out[-1]
+    best = max(a["ari"] for a in margin["algos"].values())
     print(
         json.dumps(
             {
@@ -516,12 +581,13 @@ def main_quality() -> None:
                 ),
                 "value": best,
                 "unit": "ari",
-                # baseline 0.5: mid-quality recovery; planted SBM structure
-                # at these densities is fully recoverable (ARI ~1) by a
-                # good method, so > 1.6 here means near-perfect recovery.
-                # Fallback runs only the small config: no ratio claimed.
+                # baseline 0.5: mid-quality recovery at the detectability
+                # margin. Expected band ~0.75-0.95 (seed-swept, pinned in
+                # tests) — NOT 1.0; a saturated value here is a bug, not
+                # a win. Fallback runs reduced scale: no ratio claimed.
                 "vs_baseline": 0.0 if _CPU_FALLBACK else round(best / 0.5, 3),
                 "detail": {
+                    "headline_config": margin["config"],
                     "configs": out,
                     "device": str(jax.devices()[0]),
                 },
@@ -755,9 +821,16 @@ def main_roofline() -> None:
     # (tests/test_bench_capture.py::test_roofline_body_cpu_smoke).
     v = int(os.environ.get("GRAPHMINE_ROOFLINE_TABLE", v))
     # round slots up to a whole number of 128-wide sort rows, so the
-    # row-sort rate divides by exactly the elements it sorted
-    m = int(os.environ.get("GRAPHMINE_ROOFLINE_SLOTS", m))
-    m = -(-max(m, 128) // 128) * 128
+    # row-sort rate divides by exactly the elements it sorted; when this
+    # adjusts an exact env-requested count, the record says so (ADVICE r4)
+    m_requested = int(os.environ.get("GRAPHMINE_ROOFLINE_SLOTS", m))
+    m = -(-max(m_requested, 128) // 128) * 128
+    slots_adjusted = m != m_requested
+    if slots_adjusted:
+        print(
+            f"[roofline] GRAPHMINE_ROOFLINE_SLOTS={m_requested} rounded up "
+            f"to {m} (whole 128-wide sort rows)", file=sys.stderr, flush=True,
+        )
     iters = int(os.environ.get("GRAPHMINE_ROOFLINE_ITERS", iters))
     rng = np.random.default_rng(5)
     idx = jnp.asarray(rng.integers(0, v, m).astype(np.int32))
@@ -867,6 +940,11 @@ def main_roofline() -> None:
                     ),
                     "gather_table_elems": v,
                     "gather_slots": m,
+                    # only present when an env override was rounded up
+                    **(
+                        {"gather_slots_requested": m_requested}
+                        if slots_adjusted else {}
+                    ),
                     "iters": iters,
                     "device": str(jax.devices()[0]),
                 },
@@ -883,6 +961,381 @@ def main_weighted() -> None:
     """Weighted-LPA throughput (r2: weighted rides the fused bucketed
     kernel — argmax of per-label weight sums)."""
     _run_chip_tier(weighted=True)
+
+
+def main_cc() -> None:
+    """Connected-components perf tier (VERDICT r4 item 2).
+
+    BASELINE.json's north star names "labelPropagation and
+    connectedComponents" as the two kernels to rebuild
+    (``Graphframes.py:78``'s GraphFrame exposes both); four rounds timed
+    LPA only. This tier runs CC **to convergence** (pointer-jumped
+    min-label propagation, ``ops/cc.py``) on the 100M-edge north-star
+    graph plus the com-livejournal ladder rung, reporting edges/s/chip
+    = E x supersteps / seconds with the iterations-to-fixpoint count.
+    The whole fixpoint loop is ONE ``lax.while_loop`` dispatch; the
+    completion signal is a device-slice fetch (chip-tier convention for
+    the tunneled device)."""
+    import jax
+
+    _setup_jax_cache()
+
+    from graphmine_tpu.datasets import load
+    from graphmine_tpu.graph.container import build_graph
+    from graphmine_tpu.ops.cc import connected_components
+
+    def measure(src, dst, v):
+        e = int(len(src))
+        t0 = time.perf_counter()
+        g = build_graph(src, dst, num_vertices=v)
+        t_build = time.perf_counter() - t0
+        labels, iters = connected_components(g, return_iterations=True)
+        np.asarray(labels[:4])  # compile + converge (cold)
+        t0 = time.perf_counter()
+        labels, iters = connected_components(g, return_iterations=True)
+        np.asarray(labels[:4])
+        dt = time.perf_counter() - t0
+        it = int(iters)
+        return {
+            "vertices": v,
+            "edges": e,
+            "iterations_to_fixpoint": it,
+            "seconds": round(dt, 3),
+            "build_seconds": round(t_build, 1),
+            "edges_per_sec_per_chip": round(e * it / dt),
+            "components": int(len(np.unique(np.asarray(labels)))),
+        }
+
+    v, e = 1 << 24, 100_000_000
+    if _CPU_FALLBACK:
+        v, e = 1 << 20, 6_250_000
+    src, dst = powerlaw_edges(v, e)
+    northstar = measure(src, dst, v)
+    print(json.dumps({"progress": {"northstar_cc": northstar}}),
+          file=sys.stderr, flush=True)
+
+    # One SNAP ladder rung (real file when present, honest R-MAT stand-in
+    # otherwise — same policy as the snap tier).
+    data_dir = os.environ.get(
+        "GRAPHMINE_SNAP_DIR", os.path.join(_REPO_DIR, "data")
+    )
+    rung_name = "com-amazon" if _CPU_FALLBACK else "com-livejournal"
+    et = load(rung_name, data_dir=data_dir,
+              max_scale=16 if _CPU_FALLBACK else None)
+    rung = dict(
+        rung=rung_name,
+        **measure(et.src, et.dst, et.num_vertices),
+    )
+
+    eps = northstar["edges_per_sec_per_chip"]
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "cc_edges_per_sec_cpu_fallback"
+                    if _CPU_FALLBACK else "cc_edges_per_sec_per_chip"
+                ),
+                "value": eps,
+                "unit": "edges/s" if _CPU_FALLBACK else "edges/s/chip",
+                # BASELINE.json gives CC no separate number; the bar is
+                # the same reference-derived per-chip rate the LPA tiers
+                # use (north-star 60 s budget, BASELINE.md derivation).
+                "vs_baseline": 0.0 if _CPU_FALLBACK else round(
+                    eps / BASELINE_EDGES_PER_SEC_PER_CHIP, 3
+                ),
+                "detail": {
+                    "northstar_100m": northstar,
+                    "snap_rung": rung,
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
+def main_sharded() -> None:
+    """Distributed-schedules-on-silicon tier (VERDICT r4 item 1 — the top
+    item): every shard_map/ring program had only ever compiled on XLA:CPU
+    virtual meshes; r4's first hardware contact proved that evidence class
+    finds real bugs (Mosaic compile blowup, MXU bf16 rounding) that CPU CI
+    structurally cannot. A 1-device ``make_mesh(1)`` on the real chip
+    compiles and executes the IDENTICAL shard_map programs — same bodies,
+    same collectives, same specs — so this tier runs the full distributed
+    family there and cross-checks each against its single-device twin:
+
+      * sharded_label_propagation (bucketed fast path) — label-exact
+      * ring_label_propagation — label-exact
+      * sharded_connected_components / ring variant — label-exact
+      * sharded_pagerank — allclose
+      * sharded_lof (ring kNN + distributed LOF) — allclose
+      * recursive_lpa_outliers_sharded — flag-exact
+
+    Headline: sharded-LPA edges/s/chip on the 1-device mesh; detail
+    carries each program's seconds and its agreement bit plus the
+    sharded/fused throughput ratio (the shard_map dispatch overhead)."""
+    import jax
+    import jax.numpy as jnp
+
+    build_graph_and_plan, lpa_superstep_bucketed = _setup_jax_cache()
+
+    from graphmine_tpu.graph.container import build_graph
+    from graphmine_tpu.ops.cc import connected_components
+    from graphmine_tpu.ops.lpa import label_propagation
+    from graphmine_tpu.ops.outliers import (
+        recursive_lpa_outliers,
+        recursive_lpa_outliers_sharded,
+    )
+    from graphmine_tpu.ops.pagerank import pagerank
+    from graphmine_tpu.ops.lof import lof_scores
+    from graphmine_tpu.parallel.knn import sharded_lof
+    from graphmine_tpu.parallel.mesh import make_mesh
+    from graphmine_tpu.parallel.ring import (
+        ring_connected_components,
+        ring_label_propagation,
+    )
+    from graphmine_tpu.parallel.sharded import (
+        partition_graph,
+        shard_graph_arrays,
+        sharded_connected_components,
+        sharded_label_propagation,
+        sharded_pagerank,
+    )
+
+    v, e = NUM_VERTICES, NUM_EDGES          # chip-tier graph
+    lof_n, lof_k = 1 << 16, 32
+    if _CPU_FALLBACK:
+        lof_n = 1 << 13
+    src, dst = powerlaw_edges(v, e)
+    host_g = build_graph(src, dst, num_vertices=v, to_device=False)
+    mesh = make_mesh(1)
+    sg_rep = shard_graph_arrays(
+        partition_graph(host_g, mesh=mesh, build_bucket_plan=True), mesh
+    )
+    sg_ring = shard_graph_arrays(partition_graph(host_g, mesh=mesh), mesh)
+
+    detail = {"num_vertices": v, "num_edges": e, "mesh_devices": 1}
+    agree_all = True
+
+    def timed(tag, fn, fetch=lambda r: np.asarray(r[:4])):
+        """Warm-up (compile) then one timed run; returns (result, secs)."""
+        fetch(fn())
+        t0 = time.perf_counter()
+        r = fn()
+        fetch(r)
+        return r, time.perf_counter() - t0
+
+    def mark(tag, secs, agree):
+        nonlocal agree_all
+        agree_all &= bool(agree)
+        detail[tag] = {"seconds": round(secs, 3), "agree": bool(agree)}
+        print(json.dumps({"progress": {tag: detail[tag]}}),
+              file=sys.stderr, flush=True)
+
+    # Single-device twins (the oracles — also run on this same silicon).
+    dev_g = build_graph(src, dst, num_vertices=v)
+    want_lpa, t_lpa_1dev = timed(
+        "fused", lambda: label_propagation(dev_g, max_iter=5)
+    )
+    want_lpa = np.asarray(want_lpa)
+    want_cc = np.asarray(connected_components(dev_g))
+    # PageRank is a directed-graph op: its own build + partition.
+    from graphmine_tpu.ops.degrees import out_degrees
+
+    dev_gd = build_graph(src, dst, num_vertices=v, symmetric=False)
+    od = out_degrees(dev_gd)
+    want_pr = np.asarray(pagerank(dev_gd, max_iter=20))
+    host_gd = build_graph(
+        src, dst, num_vertices=v, to_device=False, symmetric=False
+    )
+    sg_pr = shard_graph_arrays(partition_graph(host_gd, mesh=mesh), mesh)
+
+    lbl, secs = timed(
+        "sharded_lpa", lambda: sharded_label_propagation(sg_rep, mesh, max_iter=5)
+    )
+    mark("sharded_lpa", secs, np.array_equal(np.asarray(lbl), want_lpa))
+    sharded_lpa_secs = secs
+
+    lbl, secs = timed(
+        "ring_lpa", lambda: ring_label_propagation(sg_ring, mesh, max_iter=5)
+    )
+    mark("ring_lpa", secs, np.array_equal(np.asarray(lbl), want_lpa))
+
+    lbl, secs = timed(
+        "sharded_cc", lambda: sharded_connected_components(sg_rep, mesh)
+    )
+    mark("sharded_cc", secs, np.array_equal(np.asarray(lbl), want_cc))
+
+    lbl, secs = timed(
+        "ring_cc", lambda: ring_connected_components(sg_ring, mesh)
+    )
+    mark("ring_cc", secs, np.array_equal(np.asarray(lbl), want_cc))
+
+    pr, secs = timed(
+        "sharded_pagerank",
+        lambda: sharded_pagerank(sg_pr, mesh, od, max_iter=20),
+    )
+    mark("sharded_pagerank", secs,
+         np.allclose(np.asarray(pr), want_pr, rtol=2e-4, atol=1e-6))
+
+    rng = np.random.default_rng(13)
+    pts = rng.normal(size=(lof_n, 8)).astype(np.float32)
+    want_lof = np.asarray(lof_scores(pts, k=lof_k, impl="xla"))
+    sc, secs = timed(
+        "sharded_lof", lambda: sharded_lof(pts, mesh, k=lof_k),
+        fetch=lambda r: np.asarray(r[:4]),
+    )
+    # rtol matches the sharded-kNN parity tests: the ring path's
+    # per-chunk top-k merge reorders float reductions.
+    mark("sharded_lof", secs,
+         np.allclose(np.asarray(sc), want_lof, rtol=1e-3, atol=1e-5))
+    detail["sharded_lof"]["points"] = lof_n
+
+    want_out = recursive_lpa_outliers(dev_g, want_lpa)
+    rep, secs = timed(
+        "sharded_outliers",
+        lambda: recursive_lpa_outliers_sharded(
+            host_g, want_lpa, mesh, schedule="replicated"
+        ),
+        fetch=lambda r: r.outlier_vertices[:4],
+    )
+    mark("sharded_outliers", secs, np.array_equal(
+        np.asarray(rep.outlier_vertices),
+        np.asarray(want_out.outlier_vertices),
+    ))
+
+    eps = e * 5 / sharded_lpa_secs
+    detail["fused_lpa5_seconds"] = round(t_lpa_1dev, 3)
+    detail["sharded_over_fused"] = round(sharded_lpa_secs / t_lpa_1dev, 3)
+    detail["all_agree"] = agree_all
+    detail["device"] = str(jax.devices()[0])
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "sharded_lpa_edges_per_sec_cpu_fallback"
+                    if _CPU_FALLBACK else "sharded_lpa_edges_per_sec_per_chip"
+                ),
+                # a silent disagreement must not report healthy throughput
+                "value": round(eps) if agree_all else 0.0,
+                "unit": "edges/s" if _CPU_FALLBACK else "edges/s/chip",
+                "vs_baseline": 0.0 if (_CPU_FALLBACK or not agree_all)
+                else round(eps / BASELINE_EDGES_PER_SEC_PER_CHIP, 3),
+                "detail": detail,
+            }
+        )
+    )
+
+
+def main_e2e() -> None:
+    """End-to-end pipeline tier (VERDICT r4 item 3): the reference's five
+    chapters — CS-1 ingest, CS-2 build, CS-3 LPA, CS-4 census, CS-5
+    outliers (recursive-LPA decile + LOF), ``Graphframes.py:12-137`` —
+    as ONE ``run_pipeline`` wall-clock on the real chip, per-phase
+    seconds in the record, cold-compile and warm-cache runs separated.
+
+    The dataset is a generated string-domain parquet (the reference's
+    ingestion format: domain-string columns ``_c1``/``_c2``, built
+    columnar via Arrow dictionary arrays) at 25M edges / 262K vertices —
+    inside the 10-50M band the verdict asked for, and sized so the
+    all-pairs LOF chapter stays feasible on one chip."""
+    import jax
+
+    _setup_jax_cache()
+
+    import shutil
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from graphmine_tpu.pipeline.config import PipelineConfig
+    from graphmine_tpu.pipeline.driver import run_pipeline
+
+    v, e = 1 << 18, 25_000_000
+    if _CPU_FALLBACK:
+        v, e = 1 << 13, 400_000
+    t0 = time.perf_counter()
+    src, dst = powerlaw_edges(v, e, seed=9)
+    names = pa.array([f"d{i:07d}.example" for i in range(v)])
+    col = lambda ids: pa.DictionaryArray.from_arrays(
+        pa.array(ids, pa.int32()), names
+    ).cast(pa.string())
+    tmp = tempfile.mkdtemp(prefix="graphmine_e2e_")
+    try:
+        pq.write_table(
+            pa.table({"_c1": col(src), "_c2": col(dst)}),
+            os.path.join(tmp, "edges.parquet"),
+        )
+        t_dataset = time.perf_counter() - t0
+
+        cfg = PipelineConfig(
+            data_path=os.path.join(tmp, "edges.parquet"),
+            batch_rows=4_000_000,   # streaming interner (CS-1 slicer path)
+            max_iter=5,
+            outlier_method="both",
+        )
+
+        def one_run():
+            t0 = time.perf_counter()
+            res = run_pipeline(cfg)
+            wall = time.perf_counter() - t0
+            phases = {}
+            for r in res.metrics.records:
+                if "seconds" in r:
+                    phases[r["phase"]] = round(
+                        phases.get(r["phase"], 0.0) + r["seconds"], 2
+                    )
+            return wall, phases, res
+
+        cold_wall, cold_phases, res_cold = one_run()
+        print(json.dumps({"progress": {"cold": cold_phases}}),
+              file=sys.stderr, flush=True)
+        warm_wall, warm_phases, res = one_run()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # The two runs are the determinism check: identical partitions.
+    deterministic = (
+        res.num_communities == res_cold.num_communities
+        and np.array_equal(res.labels, res_cold.labels)
+    )
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "e2e_pipeline_seconds_cpu_fallback"
+                    if _CPU_FALLBACK else "e2e_pipeline_25m_warm_seconds"
+                ),
+                "value": round(warm_wall, 2),
+                "unit": "s",
+                # The bar: the reference-derived per-chip LPA rate implies
+                # 25M x 5 / 1.042M/s = 120 s for the LPA chapter ALONE on
+                # one chip (BASELINE.md derivation) — vs_baseline > 1
+                # means the WHOLE five-chapter pipeline (ingest through
+                # LOF) beats the budget the reference math gives just the
+                # propagation loop.
+                "vs_baseline": 0.0 if _CPU_FALLBACK else round(
+                    (e * 5 / BASELINE_EDGES_PER_SEC_PER_CHIP) / warm_wall, 3
+                ),
+                "detail": {
+                    "num_vertices": v,
+                    "num_edges": e,
+                    "dataset_gen_seconds": round(t_dataset, 1),
+                    "cold_wall_seconds": round(cold_wall, 2),
+                    "warm_phases": warm_phases,
+                    "cold_phases": cold_phases,
+                    "communities": res.num_communities,
+                    "outliers_flagged": int(
+                        res.outliers.outlier_vertices.sum()
+                    ) if res.outliers is not None else None,
+                    "lof_over_1_5": int((res.lof > 1.5).sum())
+                    if res.lof is not None else None,
+                    "deterministic_rerun": bool(deterministic),
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -919,6 +1372,9 @@ _CHILD_TIMEOUT_S = {
     "chip": 900.0,
     "roofline": 900.0,
     "northstar": 2700.0,
+    "sharded": 1800.0,
+    "cc": 1800.0,
+    "e2e": 2400.0,
     "lof": 1200.0,
     "snap": 2400.0,
     "quality": 1200.0,
@@ -932,13 +1388,14 @@ _CHILD_TIMEOUT_S = {
 # roofline second (validates the hardware model right next to the chip
 # number), then the remaining tiers by evidence value.
 _TIER_ORDER = [
-    "chip", "roofline", "northstar", "lof", "snap", "quality", "weighted",
-    "stream",
+    "chip", "roofline", "northstar", "sharded", "cc", "e2e", "lof", "snap",
+    "quality", "weighted", "stream",
 ]
 # Dead-tunnel fallback order: every tier has a reduced-scale CPU variant
 # except roofline (CPU primitive rates say nothing about the TPU model).
 _FALLBACK_TIERS = [
-    "chip", "northstar", "lof", "snap", "quality", "weighted", "stream",
+    "chip", "northstar", "sharded", "cc", "e2e", "lof", "snap", "quality",
+    "weighted", "stream",
 ]
 
 # Indirection so orchestration tests can stub the inter-probe wait.
@@ -1361,8 +1818,8 @@ if __name__ == "__main__":
     ap.add_argument(
         "--tier",
         choices=[
-            "all", "chip", "roofline", "northstar", "lof", "snap", "quality",
-            "weighted", "stream",
+            "all", "chip", "roofline", "northstar", "sharded", "cc", "e2e",
+            "lof", "snap", "quality", "weighted", "stream",
         ],
         # No-args (the driver's invocation) = the full evidence suite: one
         # healthy TPU window turns every README performance claim into a
@@ -1374,6 +1831,9 @@ if __name__ == "__main__":
         "chip": main,
         "roofline": main_roofline,
         "northstar": main_northstar,
+        "sharded": main_sharded,
+        "cc": main_cc,
+        "e2e": main_e2e,
         "lof": main_lof,
         "snap": main_snap,
         "quality": main_quality,

@@ -34,13 +34,19 @@ def cc_superstep(labels: jax.Array, graph: Graph) -> jax.Array:
     return jnp.minimum(new, new[new]).astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("max_iter",))
-def connected_components(graph: Graph, max_iter: int = 0) -> jax.Array:
+@partial(jax.jit, static_argnames=("max_iter", "return_iterations"))
+def connected_components(
+    graph: Graph, max_iter: int = 0, return_iterations: bool = False
+):
     """Weakly-connected component labels ``[V]`` (smallest member vertex id).
 
     Runs to fixpoint inside a ``lax.while_loop`` (bounded by ``max_iter``
     when nonzero). Returns int32 labels; distinct count on the bundled data
     must equal the measured golden of 34 WCCs (BASELINE.md).
+
+    ``return_iterations`` additionally returns the supersteps-to-fixpoint
+    count (int32 scalar, includes the final no-change confirming pass) —
+    the ``cc`` bench tier reports it alongside edges/s (VERDICT r4 item 2).
     """
     limit = max_iter if max_iter > 0 else graph.num_vertices + 2
 
@@ -55,5 +61,9 @@ def connected_components(graph: Graph, max_iter: int = 0) -> jax.Array:
         return new, changed, it + 1
 
     labels0 = jnp.arange(graph.num_vertices, dtype=jnp.int32)
-    labels, _, _ = lax.while_loop(cond, body, (labels0, jnp.int32(1), jnp.int32(0)))
+    labels, _, iters = lax.while_loop(
+        cond, body, (labels0, jnp.int32(1), jnp.int32(0))
+    )
+    if return_iterations:
+        return labels, iters
     return labels

@@ -167,6 +167,11 @@ def vertex_features_host(
     distinct = np.bincount((uniq // v).astype(np.int64), minlength=v).astype(
         np.float64
     )
+    # Normalize bool-likes first (ADVICE r4): callers threading flags out
+    # of numpy/config arrays pass np.True_/np.False_, which the identity
+    # checks below would bounce to the typo ValueError.
+    if isinstance(include_clustering, np.bool_):
+        include_clustering = bool(include_clustering)
     if include_clustering == "sampled":
         from graphmine_tpu.ops.triangles import sampled_clustering_coefficient
 

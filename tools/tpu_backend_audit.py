@@ -39,14 +39,35 @@ def compute():
     w = rng.uniform(0.1, 2.0, e).astype(np.float32)
     labels = gm.label_propagation(g, max_iter=5)
     h, a = gm.hits(gd)
-    # kNN/LOF: impl="auto" selects the fused Pallas kernel on TPU and the
-    # XLA path on CPU, so this row is a real-hardware Pallas-vs-XLA check
-    # (indices are excluded: near-tie orderings may legitimately differ).
+    # kNN/LOF at k=8: impl="auto" resolves to the fused Pallas kernel on
+    # TPU and the XLA path on CPU *only for k <= 8* (the r5-measured
+    # policy, ops/knn.py), so both rows are real-hardware Pallas-vs-XLA
+    # checks — at any larger k they would silently become vacuous
+    # XLA-vs-XLA comparisons. (kNN indices are excluded: near-tie
+    # orderings may legitimately differ across backends.)
     from graphmine_tpu.ops.knn import knn
     from graphmine_tpu.ops.lof import lof_scores
 
     pts = rng.normal(size=(512, 8)).astype(np.float32)
-    knn_d2, _ = knn(pts, k=16, impl="auto")
+    knn_d2, _ = knn(pts, k=8, impl="auto")
+
+    # One shard_map output (VERDICT r4 item 1): the distributed LPA body
+    # on a 1-device mesh of whatever backend this process has — on the
+    # real TPU this is the first-ever silicon execution class for the
+    # shard_map programs, which CPU CI can never de-risk (the r4 Mosaic
+    # compile blowup and MXU rounding bugs were both invisible there).
+    from graphmine_tpu.parallel.mesh import make_mesh
+    from graphmine_tpu.parallel.sharded import (
+        partition_graph,
+        shard_graph_arrays,
+        sharded_label_propagation,
+    )
+
+    mesh = make_mesh(1)
+    sg = shard_graph_arrays(
+        partition_graph(g, mesh=mesh, build_bucket_plan=True), mesh
+    )
+    sharded_lpa = sharded_label_propagation(sg, mesh, max_iter=5)
     return {
         "lpa": np.asarray(labels),
         "cc": np.asarray(gm.connected_components(g)),
@@ -65,7 +86,8 @@ def compute():
         "hits_a": np.asarray(a),
         "pagerank": np.asarray(gm.pagerank(gd, max_iter=50)),
         "knn_d2": np.asarray(knn_d2),
-        "lof": np.asarray(lof_scores(pts, k=16)),
+        "lof": np.asarray(lof_scores(pts, k=8)),
+        "sharded_lpa": np.asarray(sharded_lpa),
     }
 """
 
