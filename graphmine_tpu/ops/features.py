@@ -15,13 +15,15 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from graphmine_tpu.graph.container import Graph
 from graphmine_tpu.ops.census import community_sizes
 
 
 def vertex_features(
-    graph: Graph, communities: jax.Array, triangles_cache=None
+    graph: Graph, communities: jax.Array, triangles_cache=None,
+    include_clustering: bool | str = True,
 ) -> jax.Array:
     """Feature matrix ``[V, 8]`` (float32):
 
@@ -50,9 +52,29 @@ def vertex_features(
     # outside jit; everything else is one compiled program.
     # ``triangles_cache``: a prior ops.triangles._triangles result (e.g.
     # GraphFrame._triangle_cache()) to skip the host pass.
-    from graphmine_tpu.ops.triangles import clustering_coefficient
+    # ``include_clustering`` mirrors the host twin: True = exact wedge
+    # pipeline; ``"sampled"`` = the wedge-count-independent estimator
+    # (r5: the exact expansion allocates ~28 B/wedge on the host, which
+    # OOM-killed a 25M-edge mega-hub run at 130 GB — the driver probes
+    # ``oriented_wedge_count`` and passes "sampled" past its budget);
+    # False zeros the column (the measured-weaker host-7 configuration).
+    if isinstance(include_clustering, np.bool_):
+        include_clustering = bool(include_clustering)
+    if include_clustering == "sampled":
+        from graphmine_tpu.ops.triangles import sampled_clustering_coefficient
 
-    clust = clustering_coefficient(graph, _cached=triangles_cache)
+        clust = jnp.asarray(sampled_clustering_coefficient(graph))
+    elif include_clustering is True:
+        from graphmine_tpu.ops.triangles import clustering_coefficient
+
+        clust = clustering_coefficient(graph, _cached=triangles_cache)
+    elif include_clustering is False:
+        clust = jnp.zeros((graph.num_vertices,), jnp.float32)
+    else:
+        raise ValueError(
+            f"include_clustering must be True, False or 'sampled' "
+            f"(got {include_clustering!r})"
+        )
     return _vertex_features_jit(graph, communities, clust)
 
 
@@ -139,8 +161,6 @@ def vertex_features_host(
       exact-8 **0.9905**, host-7 **0.9940**, sampled-8 **0.9887** — all
       three configs within ~0.005 of each other at this scale.
     """
-    import numpy as np
-
     v = graph.num_vertices
     src = np.asarray(graph.src)
     dst = np.asarray(graph.dst)

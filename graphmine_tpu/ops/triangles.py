@@ -136,6 +136,32 @@ def triangle_count(graph: Graph):
     return tri, total
 
 
+def oriented_wedge_count(graph: Graph) -> int:
+    """Exact count of oriented wedges the exact triangle pipeline would
+    materialize — WITHOUT materializing them (O(E log E) host work, O(E)
+    memory).
+
+    This is the feasibility probe for :func:`_oriented_csr`, whose wedge
+    expansion allocates ~28 bytes per wedge on the host: a mega-hub
+    power-law graph at 25M edges reaches ~10^10 oriented wedges (~300 GB)
+    — the round-5 e2e bench run was OOM-killed at 130 GB RSS exactly
+    here. Callers (the pipeline driver's LOF feature phase) compare this
+    against a budget and fall back to
+    :func:`sampled_clustering_coefficient`, whose cost is independent of
+    the wedge count.
+    """
+    v = graph.num_vertices
+    a, b = simple_undirected_edges(graph)
+    if len(a) == 0:
+        return 0
+    deg = np.bincount(a, minlength=v) + np.bincount(b, minlength=v)
+    rank = deg.astype(np.int64) * v + np.arange(v)
+    lo = np.where(rank[a] <= rank[b], a, b)
+    counts = np.bincount(lo, minlength=v).astype(np.int64)
+    # each oriented edge (u, v) expands against u's whole oriented row
+    return int(counts[lo].sum())
+
+
 def clustering_coefficient(graph: Graph, _cached=None) -> jax.Array:
     """Local clustering coefficient ``[V]`` (float32): triangles through a
     vertex over its wedge count on the simplified graph.

@@ -15,6 +15,7 @@ chip), profiling, checkpoint/resume, multi-device execution.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 
@@ -236,9 +237,34 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
                 "devices so the sharded kNN/LOF path can run",
             )
             return result
+        # Wedge-budget guard (r5): the exact clustering pipeline
+        # materializes every oriented wedge on the host (~28 B each) —
+        # a mega-hub power-law graph at 25M edges has ~10^10 of them,
+        # and the first e2e bench run was OOM-killed at 130 GB RSS
+        # before this guard existed. The probe is O(E log E) host work;
+        # past the budget the clustering column comes from the sampled
+        # estimator (stderr <= 1/(2*sqrt(64)) per vertex), same as
+        # scale-out mode. Default 2.5e8 wedges ~ 7 GB host scratch.
+        feature_mode = "device-8"
+        if not scale_out:
+            from graphmine_tpu.ops.triangles import oriented_wedge_count
+
+            wedge_budget = int(float(os.environ.get(
+                "GRAPHMINE_WEDGE_BUDGET", "2.5e8"
+            )))
+            wedges = oriented_wedge_count(graph)
+            if wedges > wedge_budget:
+                feature_mode = "device-8-sampled"
+                m.emit(
+                    "warning",
+                    message=f"exact clustering infeasible: {wedges:,} "
+                    f"oriented wedges exceed GRAPHMINE_WEDGE_BUDGET="
+                    f"{wedge_budget:,} (~28 B/wedge host scratch); using "
+                    "the wedge-sampled estimator",
+                )
         with m.timed("outliers_lof", k=config.lof_k,
                      devices=n_dev if use_sharded_lof else 1,
-                     features="host-8-sampled" if scale_out else "device-8"):
+                     features="host-8-sampled" if scale_out else feature_mode):
             if scale_out:
                 # Host feature twin (no O(E) device transfer). The exact
                 # wedge pipeline is infeasible exactly when the graph
@@ -250,7 +276,13 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
                     graph, labels, include_clustering="sampled"
                 ))
             else:
-                feats = standardize(vertex_features(graph, labels))
+                feats = standardize(vertex_features(
+                    graph, labels,
+                    include_clustering=(
+                        "sampled" if feature_mode == "device-8-sampled"
+                        else True
+                    ),
+                ))
             if use_sharded_lof:
                 # Multi-device: ring-sharded kNN + distributed LOF — the
                 # O(V^2) distance work is scheduled over the mesh with no

@@ -254,6 +254,35 @@ def test_pipeline_auto_schedule_single_device():
     assert res.num_communities > 0
 
 
+def test_pipeline_wedge_budget_reroutes_lof_features(monkeypatch):
+    """r5 OOM fix: past GRAPHMINE_WEDGE_BUDGET the LOF phase must use the
+    wedge-sampled clustering column instead of the exact expansion (the
+    exact pipeline materializes ~28 B/wedge on the host and was OOM-
+    killed at 130 GB on the first e2e capture). A budget of 1 forces the
+    reroute on the bundled data; the phase event and warning say so."""
+    from graphmine_tpu.pipeline.driver import run_pipeline
+
+    monkeypatch.setenv("GRAPHMINE_WEDGE_BUDGET", "1")
+    res = run_pipeline(
+        _tiny_config(num_devices=1, outlier_method="lof", lof_k=16)
+    )
+    lof_events = [r for r in res.metrics.records
+                  if r.get("phase") == "outliers_lof"]
+    assert lof_events and lof_events[0]["features"] == "device-8-sampled"
+    warns = [r for r in res.metrics.records if r.get("phase") == "warning"]
+    assert any("wedge" in w["message"].lower() for w in warns)
+    assert res.lof is not None and len(res.lof) == res.graph.num_vertices
+
+    # default budget: bundled data is far below it -> exact features
+    monkeypatch.delenv("GRAPHMINE_WEDGE_BUDGET")
+    res2 = run_pipeline(
+        _tiny_config(num_devices=1, outlier_method="lof", lof_k=16)
+    )
+    lof_events = [r for r in res2.metrics.records
+                  if r.get("phase") == "outliers_lof"]
+    assert lof_events and lof_events[0]["features"] == "device-8"
+
+
 def test_pipeline_impossible_config_fails_before_allocation(monkeypatch):
     """The loud plan-time error: a budget no schedule fits under raises
     PlanError during run_pipeline, before any partition/device work."""
