@@ -70,6 +70,26 @@ class IncrementalFactorizer:
     def add(self, column: np.ndarray) -> np.ndarray:
         column = np.asarray(column)
         codes_batch, uniques = _factorize_first_appearance(column)
+        return self._intern_uniques(codes_batch, uniques)
+
+    def add_dictionary(self, indices: np.ndarray, dictionary: np.ndarray) -> np.ndarray:
+        """Encode a batch given as ``dictionary[indices]`` WITHOUT
+        materializing the per-row strings (r5 ingest fast path).
+
+        Equivalent to ``add(dictionary[indices])`` by construction — an
+        Arrow dictionary's values are unique, so first-appearance order
+        over the int index stream is first-appearance order over the
+        value stream, and only the batch's distinct values (``|D|``, not
+        ``|rows|``) touch Python. The e2e capture measured the per-row
+        string path at ~300K rows/s (84 s of a 196 s pipeline on 25M
+        rows); this path moves the per-row work to int32 numpy.
+        """
+        codes_batch, uniq_idx = _factorize_first_appearance(
+            np.asarray(indices)
+        )
+        return self._intern_uniques(codes_batch, np.asarray(dictionary)[uniq_idx])
+
+    def _intern_uniques(self, codes_batch, uniques) -> np.ndarray:
         lut = np.empty(len(uniques), dtype=np.int32)
         index, names = self._index, self._names
         for i, val in enumerate(uniques.tolist()):

@@ -83,6 +83,70 @@ def test_streaming_parquet_matches_bulk():
         load_parquet_edges(REFERENCE_PARQUET, batch_rows=0)
 
 
+def test_dictionary_fast_path_byte_identical_to_string_path():
+    """r5 ingest fast path: parquet string columns are PLAIN_DICTIONARY
+    on disk (the reference's own Spark output is), and interning the
+    dictionary VALUES + remapping int32 indices replaced per-row Python
+    strings (measured 84 s -> 14 s at 25M rows). Id assignment must be
+    BYTE-identical to the per-row string path — LPA tie-breaks read the
+    ids, so 'same names, different codes' would silently change pinned
+    partitions."""
+    import glob
+    import os
+
+    import pyarrow as pa
+    import pytest
+    import pyarrow.compute as pc
+    import pyarrow.parquet as pq
+
+    from graphmine_tpu.io.edges import load_parquet_edges
+    from graphmine_tpu.io.factorize import factorize
+    from tests.conftest import REFERENCE_PARQUET
+
+    if not os.path.exists(REFERENCE_PARQUET):
+        pytest.skip("bundled reference parquet not available")
+    # the pre-r5 string path, reproduced verbatim
+    paths = sorted(glob.glob(os.path.join(REFERENCE_PARQUET, "*.parquet")))
+    table = pa.concat_tables(
+        [pq.read_table(p, columns=["_c1", "_c2"]) for p in paths]
+    )
+    valid = pc.and_(
+        pc.is_valid(table.column("_c1")), pc.is_valid(table.column("_c2"))
+    )
+    table = table.filter(valid)
+    (src0, dst0), names0 = factorize(
+        table.column("_c1").to_numpy(zero_copy_only=False),
+        table.column("_c2").to_numpy(zero_copy_only=False),
+    )
+    et = load_parquet_edges(REFERENCE_PARQUET)
+    np.testing.assert_array_equal(et.src, src0)
+    np.testing.assert_array_equal(et.dst, dst0)
+    np.testing.assert_array_equal(et.names.astype(str), names0.astype(str))
+
+
+def test_parquet_plain_encoding_fallback(tmp_path):
+    """Non-dictionary parquet storage takes the per-row string fallback in
+    ``_column_codes`` — same table either way (with nulls filtered)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from graphmine_tpu.io.edges import load_parquet_edges
+
+    rows_a = ["x.com", "y.com", None, "x.com", "z.com"]
+    rows_b = ["y.com", "z.com", "x.com", None, "y.com"]
+    p = tmp_path / "plain.parquet"
+    pq.write_table(
+        pa.table({"_c1": pa.array(rows_a), "_c2": pa.array(rows_b)}),
+        p, use_dictionary=False,
+    )
+    et = load_parquet_edges(str(p))
+    ets = load_parquet_edges(str(p), batch_rows=2)
+    assert et.num_rows_raw == 5 and et.num_edges == 3  # two null rows drop
+    pairs = sorted(zip(et.names[et.src], et.names[et.dst]))
+    assert pairs == [("x.com", "y.com"), ("y.com", "z.com"), ("z.com", "y.com")]
+    assert sorted(zip(ets.names[ets.src], ets.names[ets.dst])) == pairs
+
+
 def test_weighted_edge_list_loading(tmp_path):
     """r2: 3-column weighted edge lists (`src dst weight`) load via
     weight_col and feed weighted LPA end-to-end."""
