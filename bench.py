@@ -1074,9 +1074,8 @@ def main_sharded() -> None:
     carries each program's seconds and its agreement bit plus the
     sharded/fused throughput ratio (the shard_map dispatch overhead)."""
     import jax
-    import jax.numpy as jnp
 
-    build_graph_and_plan, lpa_superstep_bucketed = _setup_jax_cache()
+    _setup_jax_cache()
 
     from graphmine_tpu.graph.container import build_graph
     from graphmine_tpu.ops.cc import connected_components

@@ -108,9 +108,11 @@ def _column_codes(col, interner):
             ))
         else:
             parts.append(interner.add(c.to_numpy(zero_copy_only=False)))
+    if not parts:
+        # an all-null (or empty) column filters to a 0-chunk ChunkedArray
+        return np.empty(0, np.int32)
     return (
-        np.concatenate(parts) if len(parts) != 1
-        else parts[0]
+        np.concatenate(parts) if len(parts) != 1 else parts[0]
     ).astype(np.int32, copy=False)
 
 

@@ -23,7 +23,7 @@ from graphmine_tpu.ops.census import community_sizes
 
 def vertex_features(
     graph: Graph, communities: jax.Array, triangles_cache=None,
-    include_clustering: bool | str = True,
+    include_clustering: bool | str = True, simple_edges=None,
 ) -> jax.Array:
     """Feature matrix ``[V, 8]`` (float32):
 
@@ -63,11 +63,15 @@ def vertex_features(
     if include_clustering == "sampled":
         from graphmine_tpu.ops.triangles import sampled_clustering_coefficient
 
-        clust = jnp.asarray(sampled_clustering_coefficient(graph))
+        clust = jnp.asarray(sampled_clustering_coefficient(
+            graph, simple_edges=simple_edges
+        ))
     elif include_clustering is True:
         from graphmine_tpu.ops.triangles import clustering_coefficient
 
-        clust = clustering_coefficient(graph, _cached=triangles_cache)
+        clust = clustering_coefficient(
+            graph, _cached=triangles_cache, simple_edges=simple_edges
+        )
     elif include_clustering is False:
         clust = jnp.zeros((graph.num_vertices,), jnp.float32)
     else:

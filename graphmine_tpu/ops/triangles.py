@@ -34,7 +34,7 @@ from jax import lax
 from graphmine_tpu.graph.container import Graph, simple_undirected_edges
 
 
-def _oriented_csr(graph: Graph):
+def _oriented_csr(graph: Graph, simple_edges=None):
     """Host-side: simple undirected edges oriented by (degree, id) rank.
 
     Returns ``(ptr, col, wedge_u, wedge_v, wedge_w, simple_degree,
@@ -43,9 +43,14 @@ def _oriented_csr(graph: Graph):
     edge ``(u, v)`` and the ``(u, w)`` row entry. Consumers that close a
     wedge (k-truss) get the third side's index from their binary-search
     hit, so every triangle knows all three edges from one shared build.
+
+    ``simple_edges``: optional precomputed
+    :func:`simple_undirected_edges` result — callers that already paid
+    the O(E log E) dedup (the driver's wedge-budget probe) pass it so
+    the pipeline runs it once per graph, not once per consumer.
     """
     v = graph.num_vertices
-    a, b = simple_undirected_edges(graph)
+    a, b = simple_edges or simple_undirected_edges(graph)
 
     deg = np.bincount(a, minlength=v) + np.bincount(b, minlength=v)
     # orient small rank -> large rank; rank = (degree, id)
@@ -108,12 +113,12 @@ def _count_device(ptr, col, wedge_v, wedge_w, wedge_u, num_vertices: int, search
     return tri, hit.sum()
 
 
-def _triangles(graph: Graph):
+def _triangles(graph: Graph, simple_edges=None):
     """Shared pipeline: host build + device count once.
 
     Returns ``(tri [V], total, simple_degree [V])``.
     """
-    ptr, col, wu, wv, ww, deg, _, _ = _oriented_csr(graph)
+    ptr, col, wu, wv, ww, deg, _, _ = _oriented_csr(graph, simple_edges)
     if len(wu) == 0:
         z = jnp.zeros((graph.num_vertices,), jnp.int32)
         return z, jnp.int32(0), jnp.asarray(deg, jnp.int32)
@@ -136,7 +141,7 @@ def triangle_count(graph: Graph):
     return tri, total
 
 
-def oriented_wedge_count(graph: Graph) -> int:
+def oriented_wedge_count(graph: Graph, simple_edges=None) -> int:
     """Exact count of oriented wedges the exact triangle pipeline would
     materialize — WITHOUT materializing them (O(E log E) host work, O(E)
     memory).
@@ -148,10 +153,11 @@ def oriented_wedge_count(graph: Graph) -> int:
     here. Callers (the pipeline driver's LOF feature phase) compare this
     against a budget and fall back to
     :func:`sampled_clustering_coefficient`, whose cost is independent of
-    the wedge count.
+    the wedge count. ``simple_edges``: optional precomputed
+    :func:`simple_undirected_edges` pair (see :func:`_oriented_csr`).
     """
     v = graph.num_vertices
-    a, b = simple_undirected_edges(graph)
+    a, b = simple_edges or simple_undirected_edges(graph)
     if len(a) == 0:
         return 0
     deg = np.bincount(a, minlength=v) + np.bincount(b, minlength=v)
@@ -162,14 +168,20 @@ def oriented_wedge_count(graph: Graph) -> int:
     return int(counts[lo].sum())
 
 
-def clustering_coefficient(graph: Graph, _cached=None) -> jax.Array:
+def clustering_coefficient(
+    graph: Graph, _cached=None, simple_edges=None
+) -> jax.Array:
     """Local clustering coefficient ``[V]`` (float32): triangles through a
     vertex over its wedge count on the simplified graph.
 
     ``_cached`` optionally takes a prior :func:`_triangles` result so a
-    caller needing both counts and coefficients pays the pipeline once.
+    caller needing both counts and coefficients pays the pipeline once;
+    ``simple_edges`` forwards a precomputed dedup (see
+    :func:`_oriented_csr`).
     """
-    tri, _, deg = _triangles(graph) if _cached is None else _cached
+    tri, _, deg = (
+        _triangles(graph, simple_edges) if _cached is None else _cached
+    )
     deg = deg.astype(jnp.float32)
     wedges = deg * (deg - 1.0) / 2.0
     return jnp.where(wedges > 0, tri / jnp.maximum(wedges, 1.0), 0.0).astype(jnp.float32)
@@ -194,7 +206,8 @@ def _hash_u01(key, seed_mix):
 
 
 def sampled_clustering_coefficient(
-    graph: Graph, samples: int = 64, seed: int = 0, chunk_vertices: int = 1 << 20
+    graph: Graph, samples: int = 64, seed: int = 0,
+    chunk_vertices: int = 1 << 20, simple_edges=None,
 ) -> np.ndarray:
     """Wedge-sampled approximate local clustering coefficient ``[V]``
     (float32, HOST NumPy) — the at-scale replacement for the exact wedge
@@ -217,9 +230,11 @@ def sampled_clustering_coefficient(
     stateless splitmix64 hash of ``(seed, vertex, sample)``, so the result
     is a pure function of the seed — changing ``chunk_vertices`` to fit
     host RAM cannot change the estimates (pinned in tests).
+    ``simple_edges`` forwards a precomputed dedup (see
+    :func:`_oriented_csr`).
     """
     v = graph.num_vertices
-    a, b = simple_undirected_edges(graph)
+    a, b = simple_edges or simple_undirected_edges(graph)
     # full undirected adjacency CSR of the simple graph (both directions)
     nodes = np.concatenate([a, b])
     nbrs = np.concatenate([b, a])

@@ -246,13 +246,19 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
         # estimator (stderr <= 1/(2*sqrt(64)) per vertex), same as
         # scale-out mode. Default 2.5e8 wedges ~ 7 GB host scratch.
         feature_mode = "device-8"
+        simple_edges = None
         if not scale_out:
+            from graphmine_tpu.graph.container import simple_undirected_edges
             from graphmine_tpu.ops.triangles import oriented_wedge_count
 
             wedge_budget = int(float(os.environ.get(
                 "GRAPHMINE_WEDGE_BUDGET", "2.5e8"
             )))
-            wedges = oriented_wedge_count(graph)
+            # One O(E log E) dedup, shared with the clustering column
+            # below (exact or sampled) — the probe must not double the
+            # host prep it exists to bound (code-review r5).
+            simple_edges = simple_undirected_edges(graph)
+            wedges = oriented_wedge_count(graph, simple_edges=simple_edges)
             if wedges > wedge_budget:
                 feature_mode = "device-8-sampled"
                 m.emit(
@@ -282,6 +288,7 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
                         "sampled" if feature_mode == "device-8-sampled"
                         else True
                     ),
+                    simple_edges=simple_edges,
                 ))
             if use_sharded_lof:
                 # Multi-device: ring-sharded kNN + distributed LOF — the

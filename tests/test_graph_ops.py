@@ -186,6 +186,24 @@ def test_oriented_wedge_count_matches_expansion(rng):
     want = len(_oriented_csr(star)[2])
     assert oriented_wedge_count(star) == want
 
+    # the shared-dedup plumbing (code-review r5): a precomputed
+    # simple_undirected_edges pair gives identical results everywhere
+    from graphmine_tpu.graph.container import simple_undirected_edges
+    from graphmine_tpu.ops.triangles import sampled_clustering_coefficient
+
+    g = build_graph(rng.integers(0, 80, 600), rng.integers(0, 80, 600),
+                    num_vertices=80)
+    se = simple_undirected_edges(g)
+    assert oriented_wedge_count(g, simple_edges=se) == oriented_wedge_count(g)
+    np.testing.assert_array_equal(
+        np.asarray(clustering_coefficient(g, simple_edges=se)),
+        np.asarray(clustering_coefficient(g)),
+    )
+    np.testing.assert_array_equal(
+        sampled_clustering_coefficient(g, seed=2, simple_edges=se),
+        sampled_clustering_coefficient(g, seed=2),
+    )
+
 
 def test_vertex_features_sampled_clustering_mode(rng):
     """r5: ``vertex_features(include_clustering="sampled")`` — the

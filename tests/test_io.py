@@ -146,6 +146,17 @@ def test_parquet_plain_encoding_fallback(tmp_path):
     assert pairs == [("x.com", "y.com"), ("y.com", "z.com"), ("z.com", "y.com")]
     assert sorted(zip(ets.names[ets.src], ets.names[ets.dst])) == pairs
 
+    # all rows null: filters to a 0-chunk column — must yield an EMPTY
+    # table, not crash in np.concatenate (code-review r5)
+    p2 = tmp_path / "allnull.parquet"
+    pq.write_table(
+        pa.table({"_c1": pa.array([None, None], pa.string()),
+                  "_c2": pa.array(["a", "b"])}), p2,
+    )
+    empty = load_parquet_edges(str(p2))
+    assert empty.num_rows_raw == 2 and empty.num_edges == 0
+    assert empty.num_vertices == 0
+
 
 def test_weighted_edge_list_loading(tmp_path):
     """r2: 3-column weighted edge lists (`src dst weight`) load via
