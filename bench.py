@@ -1009,7 +1009,7 @@ def main_cc() -> None:
             return labels, int(iters), time.perf_counter() - t0
 
         labels, it, dt = timed_cc(plan=plan)
-        seg_labels, seg_it, seg_dt = timed_cc()
+        seg_labels, seg_it, seg_dt = timed_cc(plan=None)  # segment_min path
         assert np.array_equal(np.asarray(labels), np.asarray(seg_labels))
         return {
             "vertices": v,

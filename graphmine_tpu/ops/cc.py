@@ -78,10 +78,9 @@ def cc_superstep_bucketed(labels: jax.Array, plan) -> jax.Array:
     return jnp.minimum(new, new[new]).astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("max_iter", "return_iterations"))
 def connected_components(
     graph: Graph, max_iter: int = 0, return_iterations: bool = False,
-    plan=None,
+    plan="auto",
 ):
     """Weakly-connected component labels ``[V]`` (smallest member vertex id).
 
@@ -93,12 +92,35 @@ def connected_components(
     count (int32 scalar, includes the final no-change confirming pass) —
     the ``cc`` bench tier reports it alongside edges/s (VERDICT r4 item 2).
 
-    ``plan``: optional fused :class:`BucketedModePlan` (r5) — supersteps
-    run :func:`cc_superstep_bucketed` instead of the segment_min path
-    (identical labels every step, tested; the cc bench tier records the
-    measured speedup of both paths on real silicon). Callers that built
-    the graph with ``build_graph_and_plan`` already hold one.
+    ``plan``: a fused :class:`BucketedModePlan` (r5) — supersteps run
+    :func:`cc_superstep_bucketed` instead of the segment_min path
+    (identical labels every step, tested; measured 2.57x on the
+    100M-edge cc bench tier, `bench_r5_final_tpu.log`). The default
+    ``"auto"`` reuses LPA's per-graph cached fused plan when the message
+    count amortizes the one-time host build (same policy and cache as
+    :func:`~graphmine_tpu.ops.lpa.label_propagation`); ``None`` forces
+    the segment_min path. Callers that built the graph with
+    ``build_graph_and_plan`` can pass their plan directly.
     """
+    if isinstance(plan, str) and plan == "auto":
+        from graphmine_tpu.ops.lpa import _cached_auto_plan
+
+        plan = None
+        if (
+            not isinstance(graph.msg_ptr, jax.core.Tracer)
+            and graph.num_messages >= (1 << 16)
+        ):
+            plan = _cached_auto_plan(graph)
+    if plan is not None and plan.send_idx is None:
+        plan = None  # non-fused plan: no label-gather indices to min over
+    return _connected_components(graph, max_iter, return_iterations, plan)
+
+
+@partial(jax.jit, static_argnames=("max_iter", "return_iterations"))
+def _connected_components(
+    graph: Graph, max_iter: int = 0, return_iterations: bool = False,
+    plan=None,
+):
     limit = max_iter if max_iter > 0 else graph.num_vertices + 2
 
     def cond(state):

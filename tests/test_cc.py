@@ -77,3 +77,22 @@ def test_bucketed_cc_matches_segment_path(rng):
     dst = np.concatenate([np.arange(1, n + 1, dtype=np.int32),
                           np.arange(n + 2, n + 5, dtype=np.int32)])
     check(src, dst, n + 5)
+
+
+def test_cc_auto_plan_policy(rng):
+    """r5: plan="auto" reuses LPA's cached fused plan above the message
+    threshold and must agree with the forced segment path; tiny graphs
+    stay on segment_min (no plan build)."""
+    from graphmine_tpu.ops import lpa as lpa_mod
+
+    v, e = 300, 40_000  # 80K messages > the 1<<16 auto threshold
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    g = build_graph(src, dst, num_vertices=v)
+    auto = np.asarray(connected_components(g))
+    seg = np.asarray(connected_components(g, plan=None))
+    np.testing.assert_array_equal(auto, seg)
+    # the auto path populated the shared LPA plan cache for this graph
+    assert any(
+        ref() is g.msg_ptr for ref, _ in lpa_mod._auto_plan_cache.values()
+    )
