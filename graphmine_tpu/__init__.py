@@ -48,6 +48,7 @@ from graphmine_tpu.ops.features import (
     vertex_features,
     vertex_features_host,
 )
+from graphmine_tpu.ops.ann import ivf_knn, kmeans
 from graphmine_tpu.ops.knn import knn
 from graphmine_tpu.ops.lof import lof_scores
 from graphmine_tpu.ops.outliers import (
@@ -118,6 +119,8 @@ __all__ = [
     "fit_lof",
     "standardize",
     "vertex_features",
+    "ivf_knn",
+    "kmeans",
     "knn",
     "lof_scores",
     "score_lof",

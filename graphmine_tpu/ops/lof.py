@@ -22,7 +22,6 @@ import jax.numpy as jnp
 from graphmine_tpu.ops.knn import knn
 
 
-@partial(jax.jit, static_argnames=("k", "row_tile", "impl"))
 def lof_scores(
     points: jax.Array, k: int = 20, row_tile: int = 1024, impl: str = "auto"
 ) -> jax.Array:
@@ -41,9 +40,23 @@ def lof_scores(
     neighborhood is just the other anomalies, so they score as inliers
     (measured: 64 injected hubs at 65K vertices swing AUROC 0.49 → 0.91
     going from k=20 to k=100; see ``bench.py --tier lof``).
+
+    ``impl="ivf"`` (r5) routes the kNN through the approximate IVF-flat
+    index (:func:`graphmine_tpu.ops.ann.ivf_knn`) — the exact all-pairs
+    scorer is AT the top_k roofline (docs/DESIGN.md), so large clouds
+    trade a measured sliver of recall for the candidate reduction; the
+    lof bench tier records recall and the AUROC delta on real silicon.
+    (This wrapper is NOT jitted: the IVF path is host-orchestrated —
+    inverted-list construction needs concrete points; the exact paths
+    and :func:`lof_from_knn` are jitted internally as before.)
     """
-    d2, idx = knn(points, k=k, row_tile=row_tile, impl=impl)
-    return lof_from_knn(d2, idx, k)
+    if impl == "ivf":
+        from graphmine_tpu.ops.ann import ivf_knn
+
+        d2, idx = ivf_knn(points, k=k)
+    else:
+        d2, idx = knn(points, k=k, row_tile=row_tile, impl=impl)
+    return _lof_from_knn_jit(d2, idx, k)
 
 
 def lof_from_knn(d2: jax.Array, idx: jax.Array, k: int) -> jax.Array:
@@ -53,12 +66,23 @@ def lof_from_knn(d2: jax.Array, idx: jax.Array, k: int) -> jax.Array:
     the gathers ``kdist[idx]`` / ``lrd[idx]`` are over ``[N]`` vectors, so
     under GSPMD they cost one small all-gather each."""
     dists = jnp.sqrt(d2)
-    pos = dists > 0
-    eps = 1e-3 * dists.sum() / jnp.maximum(pos.sum(), 1)
+    finite_pos = (dists > 0) & jnp.isfinite(dists)
+    # finite-masked mean (r5): an approximate-kNN source could in
+    # principle hand an inf slot; summing it here would turn eps — and
+    # through reach/lrd EVERY score — into garbage. ivf_knn guards its
+    # own capacity, but the formula must not be poisonable by one slot.
+    eps = 1e-3 * jnp.where(finite_pos, dists, 0.0).sum() / jnp.maximum(
+        finite_pos.sum(), 1
+    )
     kdist = dists[:, -1]
     reach = jnp.maximum(jnp.maximum(kdist[idx], dists), eps)  # [N, k]
     lrd = k / jnp.maximum(reach.sum(axis=1), 1e-12)
     return jnp.mean(lrd[idx], axis=1) / jnp.maximum(lrd, 1e-12)
+
+
+# lof_scores (a host-dispatching wrapper since the r5 IVF path) jits the
+# formula once here; external lof_from_knn callers keep the raw function.
+_lof_from_knn_jit = partial(jax.jit, static_argnames=("k",))(lof_from_knn)
 
 
 def auroc(scores, is_outlier) -> float:

@@ -31,9 +31,8 @@ from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from graphmine_tpu.ops.knn import _tiled_knn
-from graphmine_tpu.ops.lof import lof_from_knn
-
-_lof_from_knn = jax.jit(lof_from_knn, static_argnums=2)
+# the one jitted wrapper of the shared LOF formula (ops/lof.py owns it)
+from graphmine_tpu.ops.lof import _lof_from_knn_jit as _lof_from_knn
 from graphmine_tpu.parallel.mesh import VERTEX_AXIS, cached_jit_shard_map
 
 

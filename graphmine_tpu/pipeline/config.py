@@ -48,6 +48,13 @@ class PipelineConfig:
     # hubs (docs/DESIGN.md, bench.py --tier lof). 128 is the measured
     # best; the driver clamps it to num_vertices - 1 on small graphs.
     lof_k: int = 128
+    # LOF kNN implementation (r5): "auto" = the measured exact-path
+    # policy (XLA dot+top_k; Pallas at k <= 8); "ivf" = the approximate
+    # IVF-flat index — the exact scorer is AT the top_k roofline, so
+    # large feature clouds trade a measured sliver of recall (0.9999 at
+    # 262K points; AUROC 0.9895 vs 0.9905 on the harness) for ~3x wall
+    # (docs/DESIGN.md "Exact kNN is at the sort roofline").
+    lof_impl: str = "auto"  # auto | xla | pallas | ivf
     # observability
     show: int = 10  # .show(10) parity
     profile_dir: str | None = None  # jax.profiler trace output
@@ -69,6 +76,8 @@ class PipelineConfig:
             raise ValueError(f"unknown schedule {self.schedule!r}")
         if self.outlier_method not in ("recursive_lpa", "lof", "both", "none"):
             raise ValueError(f"unknown outlier_method {self.outlier_method!r}")
+        if self.lof_impl not in ("auto", "xla", "pallas", "ivf"):
+            raise ValueError(f"unknown lof_impl {self.lof_impl!r}")
         if self.community_method not in ("lpa", "louvain", "leiden"):
             raise ValueError(f"unknown community_method {self.community_method!r}")
         if self.backend == "graphframes" and self.community_method != "lpa":

@@ -229,6 +229,13 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
 
         k = min(config.lof_k, graph.num_vertices - 1)
         use_sharded_lof = n_dev > 1 and can_shard(graph.num_vertices, n_dev, k)
+        if use_sharded_lof and config.lof_impl != "auto":
+            m.emit(
+                "warning",
+                message=f"lof_impl={config.lof_impl!r} applies to the "
+                "single-device scorer only; the multi-device path runs "
+                "the exact ring-sharded kNN/LOF",
+            )
         if scale_out and not use_sharded_lof:
             m.emit(
                 "warning",
@@ -299,7 +306,10 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
 
                 scores = sharded_lof(feats, make_mesh(n_dev), k=k)
             else:
-                scores = lof_scores(feats, k=k)
+                # config.lof_impl="ivf" opts large clouds into the
+                # approximate IVF index (r5; measured ~3x at 262K points
+                # for ~0.001 AUROC — see config.py)
+                scores = lof_scores(feats, k=k, impl=config.lof_impl)
             result.lof = np.asarray(scores)
         m.emit(
             "outlier_summary",
