@@ -10,9 +10,41 @@ noted as the upgrade path).
 
 from __future__ import annotations
 
+import hashlib
 import os
+import zipfile
+import zlib
 
 import numpy as np
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint failed its integrity check (zip CRC or state checksum)
+    and no good fallback existed. The message names every file tried."""
+
+
+class FingerprintMismatch(ValueError):
+    """The checkpoint indexes a different graph / id assignment. Distinct
+    from corruption on purpose: rolling back to a previous checkpoint of
+    the SAME wrong graph would not help, so this always propagates."""
+
+
+def _state_checksum(labels: np.ndarray, iteration: int, fingerprint: str) -> str:
+    """Content hash of the full checkpoint state — written at save time,
+    re-derived at load time. Catches silent bit damage that slips past the
+    zip-member CRC (e.g. a rewritten-in-place but internally consistent
+    member) and any tearing between the arrays."""
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(labels).tobytes())
+    h.update(str(labels.dtype).encode())
+    h.update(str(labels.shape).encode())
+    h.update(str(int(iteration)).encode())
+    h.update((fingerprint or "").encode())
+    return h.hexdigest()
+
+
+def _prev_path(path: str) -> str:
+    return path[: -len(".npz")] + ".prev.npz"
 
 
 def graph_fingerprint(src, dst, weights=None) -> str:
@@ -41,32 +73,71 @@ def save_labels(
     checkpoint_dir: str, labels, iteration: int, tag: str = "lpa",
     fingerprint: str | None = None,
 ) -> str:
+    """Durably save (labels, iteration) — torn-write-proof.
+
+    Write protocol: tmp file → fsync → rotate the current checkpoint to
+    ``*.prev.npz`` → rename tmp into place → fsync the directory. A kill at
+    any point leaves either the old checkpoint or the new one fully intact,
+    never a truncated ``.npz``; the rotation keeps the last good state
+    available for :func:`load_labels`'s corruption rollback. The embedded
+    ``checksum`` covers labels + iteration + fingerprint.
+    """
     os.makedirs(checkpoint_dir, exist_ok=True)
     path = os.path.join(checkpoint_dir, f"{tag}_labels.npz")
     tmp = path + ".tmp.npz"  # .npz suffix keeps np.savez from renaming
+    labels_np = np.asarray(labels)
     np.savez(
         tmp,
-        labels=np.asarray(labels),
+        labels=labels_np,
         iteration=np.int64(iteration),
         fingerprint=np.str_(fingerprint or ""),
+        checksum=np.str_(
+            _state_checksum(labels_np, iteration, fingerprint or "")
+        ),
     )
+    with open(tmp, "rb+") as f:
+        os.fsync(f.fileno())
+    if os.path.exists(path):
+        os.replace(path, _prev_path(path))
     os.replace(tmp, path)
+    dirfd = os.open(checkpoint_dir, os.O_RDONLY)
+    try:
+        os.fsync(dirfd)
+    finally:
+        os.close(dirfd)
     return path
 
 
-def load_labels(checkpoint_dir: str, tag: str = "lpa", fingerprint: str | None = None):
-    """Returns (labels, iteration) or None when no checkpoint exists.
+# Everything np.load / zipfile can throw at damaged bytes: truncation
+# (BadZipFile/EOFError), bit flips in a member (BadZipFile "Bad CRC-32",
+# zlib.error), header damage (ValueError/KeyError/OSError from the npy
+# parser), plus our own checksum verdict.
+_CORRUPTION_ERRORS = (
+    zipfile.BadZipFile, zlib.error, EOFError, KeyError, OSError,
+    ValueError, CheckpointCorruptionError,
+)
 
-    ``fingerprint``: when given and the checkpoint recorded one, the two
-    must match — a mismatch means the checkpoint indexes a different
-    graph or id assignment, and resuming would silently mislabel every
-    vertex (raises ValueError instead).
+
+def _read_verified(path: str, fingerprint: str | None):
+    """Load one checkpoint file, verifying integrity then identity.
+
+    Raises a :data:`_CORRUPTION_ERRORS` member on damaged bytes (the
+    caller may roll back) or :class:`FingerprintMismatch` on a
+    wrong-graph checkpoint (the caller must NOT roll back — every
+    generation of this checkpoint indexes the same wrong graph).
     """
-    path = os.path.join(checkpoint_dir, f"{tag}_labels.npz")
-    if not os.path.exists(path):
-        return None
     with np.load(path) as z:
+        labels = z["labels"]
+        iteration = int(z["iteration"])
         saved_fp = str(z["fingerprint"]) if "fingerprint" in z else ""
+        if "checksum" in z:
+            want = str(z["checksum"])
+            got = _state_checksum(labels, iteration, saved_fp)
+            if want != got:
+                raise CheckpointCorruptionError(
+                    f"checkpoint at {path} failed its state checksum "
+                    f"({got[:12]}... != recorded {want[:12]}...)"
+                )
         if fingerprint and not saved_fp:
             import warnings
 
@@ -74,16 +145,117 @@ def load_labels(checkpoint_dir: str, tag: str = "lpa", fingerprint: str | None =
                 f"checkpoint at {path} predates graph fingerprinting; cannot "
                 "verify it matches this graph/id assignment — resuming "
                 "unchecked (re-save to upgrade)",
-                stacklevel=2,
+                stacklevel=3,
             )
         if fingerprint and saved_fp and fingerprint != saved_fp:
-            raise ValueError(
+            raise FingerprintMismatch(
                 f"checkpoint at {path} was written for a different graph or "
                 f"vertex-id assignment (fingerprint {saved_fp[:12]}... != "
                 f"{fingerprint[:12]}...); delete the checkpoint or reload the "
                 "data the way the original run did (e.g. same batch_rows)"
             )
-        return z["labels"], int(z["iteration"])
+        return labels, iteration
+
+
+def _read_verified_confirmed(path: str, fingerprint: str | None):
+    """:func:`_read_verified` with one confirming re-read before a
+    corruption verdict. ``OSError`` sits in :data:`_CORRUPTION_ERRORS`
+    (damaged headers surface as it), but it is also how transient I/O
+    weather (flaky NFS, EIO) presents — and condemning the NEWEST healthy
+    checkpoint on one unlucky read would silently resume from older
+    state. Real corruption is deterministic across reads; transient
+    weather is not, so a second read disambiguates cheaply."""
+    try:
+        return _read_verified(path, fingerprint)
+    except FingerprintMismatch:
+        raise
+    except _CORRUPTION_ERRORS as first:
+        try:
+            return _read_verified(path, fingerprint)
+        except FingerprintMismatch:
+            raise
+        except _CORRUPTION_ERRORS:
+            raise first
+
+
+def load_labels(
+    checkpoint_dir: str, tag: str = "lpa", fingerprint: str | None = None,
+    sink=None,
+):
+    """Returns (labels, iteration) or None when no checkpoint exists.
+
+    Integrity: every load re-verifies the zip CRCs and the embedded state
+    checksum. A corrupt current checkpoint automatically **rolls back** to
+    the rotated ``*.prev.npz`` (the last good save), promoting it back to
+    the current slot; the condemned file is preserved at ``*.npz.corrupt``
+    for forensics (the verdict may stem from a transient read error on
+    healthy bytes). When both generations are damaged,
+    :class:`CheckpointCorruptionError` names every file tried. Rollbacks
+    are emitted as ``checkpoint_rollback`` records through ``sink`` (a
+    :class:`~graphmine_tpu.pipeline.metrics.MetricsSink`) when given.
+
+    ``fingerprint``: when given and the checkpoint recorded one, the two
+    must match — a mismatch means the checkpoint indexes a different
+    graph or id assignment, and resuming would silently mislabel every
+    vertex (raises :class:`FingerprintMismatch` instead).
+    """
+    path = os.path.join(checkpoint_dir, f"{tag}_labels.npz")
+    prev = _prev_path(path)
+    if not os.path.exists(path) and not os.path.exists(prev):
+        return None
+    try:
+        if not os.path.exists(path):
+            raise CheckpointCorruptionError(
+                f"checkpoint at {path} is missing (previous generation "
+                f"exists at {prev})"
+            )
+        return _read_verified_confirmed(path, fingerprint)
+    except FingerprintMismatch:
+        raise
+    except _CORRUPTION_ERRORS as e:
+        primary_error = e
+    if not os.path.exists(prev):
+        raise CheckpointCorruptionError(
+            f"checkpoint at {path} is corrupt ({primary_error!r}) and no "
+            f"previous generation exists; delete {checkpoint_dir!r} to "
+            "restart from scratch"
+        ) from primary_error
+    # Emitted only once a previous generation exists to roll back TO —
+    # an unrecoverable corruption must not read as a rollback in the
+    # metrics stream (checkpoint_rollback_ok still marks success).
+    if sink is not None:
+        sink.emit(
+            "checkpoint_rollback", path=path, error=repr(primary_error),
+        )
+    try:
+        labels, iteration = _read_verified_confirmed(prev, fingerprint)
+    except FingerprintMismatch:
+        raise
+    except _CORRUPTION_ERRORS as e2:
+        raise CheckpointCorruptionError(
+            f"both checkpoint generations are corrupt: {path} "
+            f"({primary_error!r}) and {prev} ({e2!r}); delete "
+            f"{checkpoint_dir!r} to restart from scratch"
+        ) from e2
+    # Promote the good generation back to the current slot so the next
+    # save's rotation cannot demote the corrupt file into the prev slot.
+    # The suspect file is set aside, NOT destroyed — and at a name no
+    # later incident overwrites: even after the confirming re-read
+    # (_read_verified_confirmed), a condemned NEWER checkpoint is
+    # evidence the operator may still want.
+    if os.path.exists(path):
+        condemned = path + ".corrupt"
+        n = 1
+        while os.path.exists(condemned):
+            condemned = f"{path}.corrupt.{n}"
+            n += 1
+        os.replace(path, condemned)
+    os.replace(prev, path)
+    if sink is not None:
+        sink.emit(
+            "checkpoint_rollback_ok", path=path, iteration=iteration,
+        )
+    return labels, iteration
 
 
 def save_sharded(checkpoint_dir: str, labels, iteration: int, tag: str = "lpa") -> str:
@@ -101,7 +273,9 @@ def save_sharded(checkpoint_dir: str, labels, iteration: int, tag: str = "lpa") 
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(
             path,
-            {"labels": labels, "iteration": np.int64(iteration)},
+            # 0-d ndarray, not np.int64: orbax's StandardCheckpointHandler
+            # rejects numpy scalar types on some releases
+            {"labels": labels, "iteration": np.asarray(iteration, np.int64)},
             force=True,
         )
     return path
