@@ -1,0 +1,35 @@
+"""Version-bridging aliases for jax APIs that moved between releases.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the top-level
+``jax`` namespace (jax >= 0.5), renaming ``check_rep`` to ``check_vma`` on
+the way; the container pins 0.4.x where only the experimental spelling
+exists. Every caller imports from here (using the NEW spelling) so the
+bridge lives in exactly one place and deletes cleanly once the floor moves.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.5: public API, check_vma kwarg
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # jax 0.4.x: experimental location, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, /, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        # 0.4.x's replication checker has no rule for while-loops (ring
+        # fixpoints, ppr batching); it is a static checker only, so default
+        # it off rather than making every caller version-conditional.
+        kwargs.setdefault("check_rep", False)
+        return _shard_map(f, **kwargs)
+
+
+try:  # jax >= 0.7: explicit varying-axes casts for the vma type system
+    from jax.lax import pcast  # type: ignore[attr-defined]
+except ImportError:  # jax 0.4.x has no vma tracking; the cast is identity
+    def pcast(x, axes, *, to=None):
+        del axes, to
+        return x
+
+
+__all__ = ["pcast", "shard_map"]

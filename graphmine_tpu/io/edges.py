@@ -35,6 +35,10 @@ class EdgeTable:
     names: np.ndarray  # str [V] — vertex id -> domain string
     num_rows_raw: int = 0  # rows before the null filter (Graphframes.py:18)
     weights: np.ndarray | None = None  # float32 [E] — optional edge weights
+    # Input-quarantine counts (rows set aside instead of crashing
+    # ingestion): keys among null_rows, bad_rows, nan_weights,
+    # out_of_range_ids. None = the loader recorded no quarantine info.
+    quarantine: dict | None = None
 
     @property
     def num_vertices(self) -> int:
@@ -61,6 +65,31 @@ def _isnull(col: np.ndarray) -> np.ndarray:
     if col.dtype == object:
         return np.frompyfunc(lambda v: v is None, 1, 1)(col).astype(bool)
     return np.zeros(len(col), dtype=bool)
+
+
+def _add_quarantine(et: EdgeTable, key: str, count: int) -> EdgeTable:
+    """Accumulate one quarantine counter onto the table (0 is recorded
+    too once any quarantine accounting is active — tests read exact
+    counts, not just presence)."""
+    et.quarantine = {**(et.quarantine or {}), key: count + (et.quarantine or {}).get(key, 0)}
+    return et
+
+
+def quarantine_nonfinite_weights(et: EdgeTable) -> EdgeTable:
+    """Drop edges whose weight is NaN/±inf, counting them as
+    ``nan_weights``. A NaN weight would silently poison weighted LPA's
+    argmax (NaN sums make every comparison false) — setting the edge
+    aside with a counted record is the resilient behavior. No-op for
+    unweighted tables."""
+    if et.weights is None:
+        return et
+    bad = ~np.isfinite(et.weights)
+    n = int(bad.sum())
+    if n:
+        keep = ~bad
+        et.src, et.dst = et.src[keep], et.dst[keep]
+        et.weights = et.weights[keep]
+    return _add_quarantine(et, "nan_weights", n)
 
 
 def edge_table_from_parts(
@@ -158,9 +187,11 @@ def load_parquet_edges(path: str, batch_rows: int | None = None) -> EdgeTable:
     interner = IncrementalFactorizer()
     src = _column_codes(table.column("_c1"), interner)
     dst = _column_codes(table.column("_c2"), interner)
-    return EdgeTable(
+    et = EdgeTable(
         src=src, dst=dst, names=interner.names(), num_rows_raw=num_rows_raw
     )
+    # the null filter IS a quarantine: rows set aside, counted, not fatal
+    return _add_quarantine(et, "null_rows", num_rows_raw - table.num_rows)
 
 
 def _load_parquet_edges_streaming(path: str, batch_rows: int) -> EdgeTable:
@@ -188,9 +219,10 @@ def _load_parquet_edges_streaming(path: str, batch_rows: int) -> EdgeTable:
             # falls back to per-row strings for non-dict storage)
             src_parts.append(_column_codes(batch.column(0), interner))
             dst_parts.append(_column_codes(batch.column(1), interner))
-    return edge_table_from_parts(
+    et = edge_table_from_parts(
         src_parts, dst_parts, interner.names(), num_rows_raw
     )
+    return _add_quarantine(et, "null_rows", num_rows_raw - et.num_edges)
 
 
 def _resolve_paths(path: str) -> list[str]:
@@ -240,7 +272,8 @@ _DEFAULT_CHUNK_BYTES = 64 << 20
 
 def load_edge_list(path: str, comments: str = "#", use_native: bool = True,
                    weight_col: int | None = None,
-                   chunk_bytes: int | None = None) -> EdgeTable:
+                   chunk_bytes: int | None = None,
+                   quarantine: bool = False) -> EdgeTable:
     """Load a SNAP-style whitespace edge list (``src dst [weight ...]``).
 
     IDs may be arbitrary integers or strings; they are densified to int32.
@@ -256,11 +289,46 @@ def load_edge_list(path: str, comments: str = "#", use_native: bool = True,
     (the common 3-column weighted edge-list format uses ``weight_col=2``);
     weights feed weighted LPA via ``graph_from_edge_table``.
     ``chunk_bytes``: override the 64 MB streaming chunk size.
+
+    ``quarantine``: resilient-ingestion mode (the pipeline default via
+    ``PipelineConfig.quarantine_inputs``). Rows that would crash the
+    strict parsers — too few columns, unparseable weight fields — and
+    edges with non-finite weights are counted and set aside on
+    ``EdgeTable.quarantine`` instead of raising. Clean files still take
+    the fast strict paths (native/NumPy); the tolerant per-line parser
+    only engages when a strict parse fails, so the resilient mode costs
+    nothing on well-formed data.
     """
     if weight_col is not None and weight_col < 2:
         raise ValueError(
             f"weight_col={weight_col} invalid: columns 0-1 are the endpoints"
         )
+    if quarantine:
+        try:
+            et = load_edge_list(
+                path, comments=comments, use_native=use_native,
+                weight_col=weight_col, chunk_bytes=chunk_bytes,
+            )
+            _add_quarantine(et, "bad_rows", 0)
+        except ValueError as strict_err:
+            # strict parse failed (ragged rows / bad weight fields):
+            # re-ingest tolerantly, quarantining the offending rows
+            et = _load_edge_list_tolerant(
+                path, comments, weight_col,
+                chunk_bytes or _DEFAULT_CHUNK_BYTES,
+            )
+            if et.num_rows_raw and (
+                et.quarantine.get("bad_rows") == et.num_rows_raw
+            ):
+                # EVERY data row set aside: the file and the config
+                # disagree wholesale (e.g. a mistyped weight_col on a
+                # clean file) — an empty graph would hide the error
+                raise ValueError(
+                    f"every data row of {path!r} failed to parse under "
+                    "the current options — this is a misconfiguration "
+                    "(e.g. wrong weight_col), not dirty data"
+                ) from strict_err
+        return quarantine_nonfinite_weights(et)
     if use_native:
         from graphmine_tpu.io import native
 
@@ -357,11 +425,83 @@ def _load_edge_list_numpy_chunked(
     )
 
 
-def from_arrays(src, dst, names=None) -> EdgeTable:
-    """Build an EdgeTable from pre-densified integer endpoint arrays."""
+def _load_edge_list_tolerant(
+    path: str, comments: str, weight_col: int | None,
+    chunk_bytes: int = _DEFAULT_CHUNK_BYTES,
+) -> EdgeTable:
+    """Per-line parser that QUARANTINES malformed rows instead of raising.
+
+    Only reached when a strict parse has already failed (see
+    ``load_edge_list(quarantine=True)``): rows with fewer than the
+    required columns or unparseable weight fields are counted as
+    ``bad_rows`` and set aside; every well-formed row ingests with the
+    same interning/id-assignment as the streaming paths. Memory bound is
+    the usual O(chunk + vocabulary + edges).
+    """
+    from graphmine_tpu.io.factorize import IncrementalFactorizer
+
+    interner = IncrementalFactorizer()
+    cmt = comments.encode() if comments else None
+    need = 2 if weight_col is None else weight_col + 1
+    src_parts, dst_parts, w_parts = [], [], []
+    num_rows = 0
+    bad_rows = 0
+    for buf in iter_line_chunks(path, chunk_bytes):
+        src_l, dst_l, w_l = [], [], []
+        for line in buf.splitlines():
+            line = line.strip()
+            if not line or (cmt and line.startswith(cmt)):
+                continue
+            num_rows += 1
+            parts = line.split()
+            if len(parts) < need:
+                bad_rows += 1
+                continue
+            if weight_col is not None:
+                try:
+                    w_l.append(float(parts[weight_col]))
+                except ValueError:
+                    bad_rows += 1
+                    continue
+            # backslashreplace, not replace: distinct invalid byte
+            # sequences must stay distinct vertex ids ('a\xff' and
+            # 'a\xfe' both map to 'a�' under replace, silently
+            # coalescing two vertices into one)
+            src_l.append(parts[0].decode("utf-8", "backslashreplace"))
+            dst_l.append(parts[1].decode("utf-8", "backslashreplace"))
+        if src_l:
+            src_parts.append(interner.add(np.asarray(src_l, dtype=object)))
+            dst_parts.append(interner.add(np.asarray(dst_l, dtype=object)))
+            if weight_col is not None:
+                w_parts.append(np.asarray(w_l, dtype=np.float32))
+    et = edge_table_from_parts(
+        src_parts, dst_parts, interner.names(), num_rows,
+        w_parts if weight_col is not None else None,
+    )
+    return _add_quarantine(et, "bad_rows", bad_rows)
+
+
+def from_arrays(src, dst, names=None, quarantine: bool = False) -> EdgeTable:
+    """Build an EdgeTable from pre-densified integer endpoint arrays.
+
+    ``quarantine``: drop edges whose endpoints are negative or (when
+    ``names`` is given) dangle past the vertex table, counting them as
+    ``out_of_range_ids`` — such ids would otherwise wrap or fail deep in
+    graph assembly."""
     src = np.asarray(src, dtype=np.int32)
     dst = np.asarray(dst, dtype=np.int32)
+    dropped = 0
+    if quarantine and len(src):
+        ok = (src >= 0) & (dst >= 0)
+        if names is not None:
+            ok &= (src < len(names)) & (dst < len(names))
+        dropped = int((~ok).sum())
+        if dropped:
+            src, dst = src[ok], dst[ok]
     n = int(max(src.max(initial=-1), dst.max(initial=-1))) + 1 if len(src) else 0
     if names is None:
         names = np.array([str(i) for i in range(n)])
-    return EdgeTable(src=src, dst=dst, names=np.asarray(names), num_rows_raw=len(src))
+    et = EdgeTable(
+        src=src, dst=dst, names=np.asarray(names), num_rows_raw=len(src) + dropped
+    )
+    return _add_quarantine(et, "out_of_range_ids", dropped) if quarantine else et

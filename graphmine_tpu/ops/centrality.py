@@ -195,8 +195,9 @@ def betweenness_centrality(
         # Source-parallel: the graph is replicated, the source tiles are
         # sharded across every mesh axis, partial accumulators meet in one
         # psum over ICI — embarrassingly parallel Brandes.
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        from graphmine_tpu._jax_compat import shard_map
 
         axes = tuple(mesh.axis_names)
 

@@ -19,6 +19,8 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+
+from graphmine_tpu._jax_compat import pcast
 import numpy as np
 from jax import lax
 
@@ -134,7 +136,7 @@ def _batched_ppr(src, dst, v, sources, alpha, max_iter, tol,
     if varying_axes:
         # pr varies per device; delta stays replicated (the pmax in step
         # produces the same coupled value everywhere).
-        pr0 = lax.pcast(pr0, varying_axes, to="varying")
+        pr0 = pcast(pr0, varying_axes, to="varying")
     pr, _, _ = lax.while_loop(cond, step, (pr0, jnp.float32(1.0), jnp.int32(0)))
     return pr
 

@@ -25,6 +25,8 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+
+from graphmine_tpu._jax_compat import shard_map
 import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding
@@ -73,7 +75,7 @@ def _compiled_body(mesh, n: int, k: int, chunk: int, row_tile: int):
     invocation."""
     return cached_jit_shard_map(
         ("knn_ring", mesh, n, k, chunk, row_tile),
-        lambda: jax.shard_map(
+        lambda: shard_map(
             partial(_knn_ring_body, n=n, k=k, chunk=chunk,
                     num_shards=mesh.size, row_tile=row_tile),
             mesh=mesh,

@@ -18,6 +18,8 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+
+from graphmine_tpu._jax_compat import shard_map
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
@@ -39,7 +41,7 @@ def _compiled_body(mesh, v: int, chunk: int, max_iter: int):
     alpha/tol ride as traced scalars so parameter sweeps reuse it."""
     return cached_jit_shard_map(
         ("ppr", mesh, v, chunk, max_iter),
-        lambda: jax.shard_map(
+        lambda: shard_map(
             partial(_ppr_chunk, v=v, max_iter=max_iter),
             mesh=mesh,
             # the mesh's one axis shards the SOURCE dimension here

@@ -22,6 +22,8 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+
+from graphmine_tpu._jax_compat import pcast, shard_map
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -68,7 +70,7 @@ def _ring_gather(chunk: jax.Array, global_idx: jax.Array, *, num_shards: int, ch
     perm = [(i, (i + 1) % num_shards) for i in range(num_shards)]
     # Mark the accumulator device-varying up front so the loop carry type
     # is stable (ppermute output is varying; zeros start out unvarying).
-    out = lax.pcast(jnp.zeros(global_idx.shape, chunk.dtype), (VERTEX_AXIS,), to="varying")
+    out = pcast(jnp.zeros(global_idx.shape, chunk.dtype), (VERTEX_AXIS,), to="varying")
 
     def fill(chunk, out, r):
         owner = jnp.mod(my - r, num_shards)
@@ -123,7 +125,7 @@ def _cc_ring_body(own, recv_local, send, deg, *, chunk_size, num_shards):
 
 
 def _ring_step_fn(sg: ShardedGraph, mesh, body, n_graph_args: int = 3):
-    return jax.shard_map(
+    return shard_map(
         partial(body, chunk_size=sg.chunk_size, num_shards=sg.num_shards),
         mesh=mesh,
         in_specs=(P(VERTEX_AXIS),) + (P(VERTEX_AXIS, None),) * n_graph_args,
@@ -218,7 +220,7 @@ def ring_pagerank(
 
     sharded = P(VERTEX_AXIS)
     data = P(VERTEX_AXIS, None)
-    pr = jax.shard_map(
+    pr = shard_map(
         body,
         mesh=mesh,
         in_specs=(sharded, sharded, sharded, data, data)

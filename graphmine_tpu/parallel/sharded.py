@@ -31,6 +31,8 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+
+from graphmine_tpu._jax_compat import shard_map
 import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -450,7 +452,7 @@ def sharded_label_propagation(
         # weighted graphs carry slot-aligned bucket_weight matrices (r2).
         n = len(sg.bucket_send)
         nw = len(sg.bucket_weight)
-        body = jax.shard_map(
+        body = shard_map(
             partial(_lpa_shard_body_bucketed, chunk_size=sg.chunk_size, axes=axes),
             mesh=mesh,
             in_specs=(
@@ -468,7 +470,7 @@ def sharded_label_propagation(
     else:
         in_specs, _ = _shard_specs(mesh)
         data_spec = P(axes, None)
-        body = jax.shard_map(
+        body = shard_map(
             partial(_lpa_shard_body, chunk_size=sg.chunk_size, axes=axes),
             mesh=mesh,
             in_specs=in_specs + (data_spec,),  # None weights: empty subtree
@@ -489,7 +491,7 @@ def sharded_connected_components(sg: ShardedGraph, mesh, max_iter: int = 0) -> j
     jumping); parity with :func:`graphmine_tpu.ops.cc.connected_components`."""
     _check_mesh(sg, mesh)
     in_specs, rep = _shard_specs(mesh)
-    body = jax.shard_map(
+    body = shard_map(
         partial(_cc_shard_body, chunk_size=sg.chunk_size, axes=_vertex_axes(mesh)),
         mesh=mesh,
         in_specs=in_specs,
@@ -608,7 +610,7 @@ def sharded_pagerank(
 
     in_specs, rep = _shard_specs(mesh)
     data_spec = P(_vertex_axes(mesh), None)
-    body = jax.shard_map(
+    body = shard_map(
         partial(
             _pagerank_shard_body,
             chunk_size=sg.chunk_size,

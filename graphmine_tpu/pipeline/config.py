@@ -10,7 +10,9 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+
+from graphmine_tpu.pipeline.resilience import ResilienceConfig
 
 
 @dataclass
@@ -58,6 +60,10 @@ class PipelineConfig:
     # observability
     show: int = 10  # .show(10) parity
     profile_dir: str | None = None  # jax.profiler trace output
+    # write every metrics record (incl. retry/degrade/quarantine/rollback
+    # recovery events, docs/RESILIENCE.md) as JSON lines to this path at
+    # the end of the run — the on-disk twin of the logging stream
+    metrics_out: str | None = None
     # checkpoint / resume
     checkpoint_dir: str | None = None
     # Save every N supersteps (plus always the final one). 1 = every
@@ -66,8 +72,17 @@ class PipelineConfig:
     # north-star scale each save is a ~64 MB npz.
     checkpoint_every: int = 1
     resume: bool = False
+    # resilience (docs/RESILIENCE.md): retry/backoff budget, superstep
+    # watchdog, and degradation policy for every pipeline phase. CLI
+    # flags are flattened (--max-retries, --superstep-timeout-s, ...).
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    # Count-and-set-aside malformed rows / NaN weights at ingestion
+    # (emitted as a "quarantine" metrics record) instead of crashing.
+    # --no-quarantine-inputs restores strict parsing.
+    quarantine_inputs: bool = True
 
     def validate(self) -> "PipelineConfig":
+        self.resilience.validate()
         if self.data_format not in ("parquet", "edgelist"):
             raise ValueError(f"unknown data_format {self.data_format!r}")
         if self.backend not in ("jax", "graphframes"):
@@ -110,11 +125,15 @@ def parse_args(argv=None) -> PipelineConfig:
         prog="graphmine_tpu.pipeline",
         description="TPU-native community + outlier detection pipeline",
     )
-    for f in dataclasses.fields(PipelineConfig):
+    def add_field(f):
         name = "--" + f.name.replace("_", "-")
         default = f.default
         if f.type in ("bool", bool):
-            parser.add_argument(name, action="store_true", default=default)
+            # BooleanOptionalAction so default-True flags (e.g.
+            # quarantine_inputs) stay switchable: --no-quarantine-inputs
+            parser.add_argument(
+                name, action=argparse.BooleanOptionalAction, default=default
+            )
         else:
             typ = str
             if f.type in ("int", int):
@@ -123,6 +142,19 @@ def parse_args(argv=None) -> PipelineConfig:
                 typ = float
             elif f.type in ("int | None",):
                 typ = int
+            elif f.type in ("float | None",):
+                typ = float
             parser.add_argument(name, type=typ, default=default)
-    ns = parser.parse_args(argv)
-    return PipelineConfig(**vars(ns)).validate()
+
+    for f in dataclasses.fields(PipelineConfig):
+        if f.name == "resilience":
+            continue  # nested config: its fields flatten onto the CLI
+        add_field(f)
+    res_fields = dataclasses.fields(ResilienceConfig)
+    for f in res_fields:
+        add_field(f)
+    ns = vars(parser.parse_args(argv))
+    resilience = ResilienceConfig(
+        **{f.name: ns.pop(f.name) for f in res_fields}
+    )
+    return PipelineConfig(**ns, resilience=resilience).validate()

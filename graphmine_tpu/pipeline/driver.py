@@ -24,6 +24,7 @@ import numpy as np
 from graphmine_tpu.graph.container import Graph, graph_from_edge_table
 from graphmine_tpu.io.edges import EdgeTable, load_edge_list, load_parquet_edges
 from graphmine_tpu.pipeline import checkpoint as ckpt
+from graphmine_tpu.pipeline import resilience
 from graphmine_tpu.pipeline.config import PipelineConfig
 from graphmine_tpu.pipeline.metrics import MetricsSink, maybe_profile
 
@@ -67,22 +68,56 @@ class PipelineResult:
 
 def run_pipeline(config: PipelineConfig) -> PipelineResult:
     config.validate()
-    m = MetricsSink()
+    # Records stream to --metrics-out AS EMITTED (MetricsSink.emit), not
+    # only at exit: a preemption or OOM-kill skips every finally block,
+    # and those are exactly the runs whose retry/degrade/rollback trail
+    # the operator needs for offline triage.
+    m = MetricsSink(stream_path=config.metrics_out)
+    try:
+        return _run_pipeline(config, m)
+    finally:
+        # Finalized on EVERY exit, not just success: closes the live
+        # stream, or writes the whole file when streaming was off/failed.
+        # A failed flush must not mask the pipeline's own outcome.
+        if config.metrics_out:
+            try:
+                m.finalize(config.metrics_out)
+            except OSError as flush_err:
+                import logging
 
+                logging.getLogger("graphmine_tpu").warning(
+                    "could not write --metrics-out %s: %r",
+                    config.metrics_out, flush_err,
+                )
+
+
+def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
     # ---- CS-1 ingestion -------------------------------------------------
-    with m.timed("load", path=config.data_path, format=config.data_format):
+    def _load():
+        resilience.fault_point("load", path=config.data_path)
         if config.data_format == "parquet":
-            table = load_parquet_edges(config.data_path, batch_rows=config.batch_rows)
-        else:
-            table = load_edge_list(
-                config.data_path, weight_col=config.edge_weight_col
+            return load_parquet_edges(
+                config.data_path, batch_rows=config.batch_rows
             )
+        return load_edge_list(
+            config.data_path, weight_col=config.edge_weight_col,
+            quarantine=config.quarantine_inputs,
+        )
+
+    with m.timed("load", path=config.data_path, format=config.data_format):
+        table = resilience.run_phase("load", _load, config.resilience, m)
     m.emit(
         "counts",  # parity with the prints at Graphframes.py:18 and :54
         rows_raw=table.num_rows_raw,
         edges=table.num_edges,
         vertices=table.num_vertices,
     )
+    if table.quarantine and config.quarantine_inputs:
+        # rows set aside instead of crashing ingestion (docs/RESILIENCE.md).
+        # Gated on the flag: parquet loaders always count their null filter,
+        # but --no-quarantine-inputs promises a strict-parsing run whose
+        # metrics stream carries no quarantine records.
+        m.emit("quarantine", **table.quarantine)
 
     # ---- CS-2 graph construction ---------------------------------------
     # Schedule resolution happens HERE, before any device allocation: the
@@ -136,17 +171,26 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
         m.emit("scale_out", message="full graph exceeds one device: host-"
                "resident graph; outlier phases run distributed (recursive "
                "LPA over the intra-community subgraph, sharded kNN/LOF)")
-    with m.timed("build_graph"):
+    def _build():
+        resilience.fault_point("build_graph")
         if wants_plan:
             from graphmine_tpu.ops.bucketed_mode import build_graph_and_plan
 
-            graph, mode_plan = build_graph_and_plan(
+            g, plan = build_graph_and_plan(
                 table.src, table.dst, num_vertices=table.num_vertices,
                 edge_weights=table.weights,
             )
-        else:
-            graph = graph_from_edge_table(table, to_device=not scale_out)
-            mode_plan = None
+            # single-element holder, not the bare plan: the LPA loop can
+            # release the fused plan's padded device matrices when the
+            # degradation ladder leaves the fused kernel, with no caller
+            # frame still pinning a reference
+            return g, [plan]
+        return graph_from_edge_table(table, to_device=not scale_out), [None]
+
+    with m.timed("build_graph"):
+        graph, plan_holder = resilience.run_phase(
+            "build_graph", _build, config.resilience, m
+        )
 
     # ---- CS-3 community detection --------------------------------------
     if config.community_method in ("louvain", "leiden"):
@@ -159,7 +203,7 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
         with m.timed(config.community_method, gamma=config.gamma):
             labels, q = algo(graph, gamma=config.gamma)
     else:
-        labels = _run_lpa(config, table, graph, m, mode_plan, n_dev, run_plan)
+        labels = _run_lpa(config, table, graph, m, plan_holder, n_dev, run_plan)
         q = None
 
     # ---- CS-4 census ----------------------------------------------------
@@ -167,11 +211,19 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
     from graphmine_tpu.ops.lpa import num_communities
     from graphmine_tpu.ops.modularity import modularity
 
+    def _census():
+        resilience.fault_point("census")
+        n = int(num_communities(labels))
+        table_ = census_table(labels, graph)
+        qq = q if q is not None else float(
+            modularity(labels, graph, gamma=config.gamma)
+        )
+        return n, table_, qq
+
     with m.timed("census"):
-        n_comm = int(num_communities(labels))
-        present, sizes, edge_counts = census_table(labels, graph)
-        if q is None:
-            q = float(modularity(labels, graph, gamma=config.gamma))
+        n_comm, (present, sizes, edge_counts), q = resilience.run_phase(
+            "census", _census, config.resilience, m
+        )
     # parity with "There are N Communities in the Dataset." (:85)
     m.emit("communities", count=n_comm, largest=int(sizes.max(initial=0)), modularity=round(q, 6))
 
@@ -197,20 +249,29 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
             from graphmine_tpu.ops.outliers import recursive_lpa_outliers_sharded
             from graphmine_tpu.parallel.mesh import make_mesh
 
-            with m.timed("outliers_recursive_lpa", schedule=run_plan.schedule,
-                         devices=n_dev):
-                result.outliers = recursive_lpa_outliers_sharded(
-                    graph, labels, make_mesh(n_dev),
-                    max_iter=config.sub_max_iter, decile=config.decile,
-                    schedule=run_plan.schedule,
-                )
+            scorer = lambda: recursive_lpa_outliers_sharded(
+                graph, labels, make_mesh(n_dev),
+                max_iter=config.sub_max_iter, decile=config.decile,
+                schedule=run_plan.schedule,
+            )
+            timing_kv = dict(schedule=run_plan.schedule, devices=n_dev)
         else:
             from graphmine_tpu.ops.outliers import recursive_lpa_outliers
 
-            with m.timed("outliers_recursive_lpa"):
-                result.outliers = recursive_lpa_outliers(
-                    graph, labels, max_iter=config.sub_max_iter, decile=config.decile
-                )
+            scorer = lambda: recursive_lpa_outliers(
+                graph, labels, max_iter=config.sub_max_iter,
+                decile=config.decile,
+            )
+            timing_kv = {}
+
+        def _outliers():
+            resilience.fault_point("outliers_recursive")
+            return scorer()
+
+        with m.timed("outliers_recursive_lpa", **timing_kv):
+            result.outliers = resilience.run_phase(
+                "outliers_recursive", _outliers, config.resilience, m
+            )
         m.emit(
             "outlier_summary",
             method="recursive_lpa",
@@ -304,12 +365,28 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
                 from graphmine_tpu.parallel.knn import sharded_lof
                 from graphmine_tpu.parallel.mesh import make_mesh
 
-                scores = sharded_lof(feats, make_mesh(n_dev), k=k)
+                def _score():
+                    resilience.fault_point("outliers_lof")
+                    return sharded_lof(feats, make_mesh(n_dev), k=k)
+
+                ladder = ()
             else:
                 # config.lof_impl="ivf" opts large clouds into the
                 # approximate IVF index (r5; measured ~3x at 262K points
                 # for ~0.001 AUROC — see config.py)
-                scores = lof_scores(feats, k=k, impl=config.lof_impl)
+                def _score():
+                    resilience.fault_point("outliers_lof")
+                    return lof_scores(feats, k=k, impl=config.lof_impl)
+
+                # OOM ladder: the exact all-pairs scorer's [V, V] distance
+                # tiles are the memory hog; the IVF index probes a bounded
+                # candidate set (bounded recall loss, see config.py)
+                ladder = (
+                    ("lof_ivf", lambda: lof_scores(feats, k=k, impl="ivf")),
+                ) if config.lof_impl != "ivf" else ()
+            scores = resilience.run_phase(
+                "outliers_lof", _score, config.resilience, m, ladder=ladder
+            )
             result.lof = np.asarray(scores)
         m.emit(
             "outlier_summary",
@@ -322,7 +399,7 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
 
 def _run_lpa(
     config: PipelineConfig, table: EdgeTable, graph: Graph, m: MetricsSink,
-    mode_plan, n_dev: int, run_plan,
+    plan_holder: list, n_dev: int, run_plan,
 ):
     """Community detection with backend dispatch, checkpointing and
     per-iteration metrics. Runs iterations one jit call at a time so the
@@ -357,7 +434,9 @@ def _run_lpa(
     )
 
     if config.resume and config.checkpoint_dir:
-        loaded = ckpt.load_labels(config.checkpoint_dir, fingerprint=fingerprint)
+        loaded = ckpt.load_labels(
+            config.checkpoint_dir, fingerprint=fingerprint, sink=m
+        )
         if loaded is not None:
             saved_labels, start_iter = loaded
             if start_iter > config.max_iter:
@@ -374,64 +453,158 @@ def _run_lpa(
     if config.schedule == "ring" and run_plan.schedule == "single":
         m.emit("warning", message="schedule='ring' needs >1 device; "
                "running the single-device fused kernel instead")
-    if run_plan.schedule == "ring":
-        # Memory-scalable schedule: labels stay sharded, chunks rotate
-        # over ICI (parallel/ring.py). Uses the sort-body message CSR.
-        from graphmine_tpu.parallel.ring import ring_label_propagation
 
-        mesh = make_mesh(n_dev)
-        with m.timed("partition", shards=n_dev, schedule="ring"):
-            sg = shard_graph_arrays(partition_graph(graph, mesh=mesh), mesh)
+    policy = config.resilience
+    # Mutable loop state shared by every ladder rung: a retry re-enters
+    # and a degradation steps down FROM THE LAST GOOD SUPERSTEP, never
+    # from iteration 0 — supersteps are deterministic, so a resumed
+    # trajectory is byte-identical to an uninterrupted one.
+    state = {"labels": labels, "it": start_iter}
 
-        def one_iter(lbl):
-            return ring_label_propagation(sg, mesh, max_iter=1, init_labels=lbl)
+    def make_superstep(variant: str):
+        """Build the per-superstep callable for one operating point
+        (schedules, plus the planner's degradation rungs)."""
+        if variant == "ring":
+            # Memory-scalable schedule: labels stay sharded, chunks rotate
+            # over ICI (parallel/ring.py). Uses the sort-body message CSR.
+            from graphmine_tpu.parallel.ring import ring_label_propagation
 
-    elif run_plan.schedule == "replicated":
-        mesh = make_mesh(n_dev)
-        with m.timed("partition", shards=n_dev, schedule="replicated"):
-            sg = shard_graph_arrays(
-                partition_graph(graph, mesh=mesh, build_bucket_plan=True),
-                mesh,
-                lpa_only=run_plan.lpa_only,
+            mesh = make_mesh(n_dev)
+            with m.timed("partition", shards=n_dev, schedule="ring"):
+                sg = shard_graph_arrays(partition_graph(graph, mesh=mesh), mesh)
+            return lambda lbl: ring_label_propagation(
+                sg, mesh, max_iter=1, init_labels=lbl
             )
+        if variant == "replicated":
+            mesh = make_mesh(n_dev)
+            with m.timed("partition", shards=n_dev, schedule="replicated"):
+                sg = shard_graph_arrays(
+                    partition_graph(graph, mesh=mesh, build_bucket_plan=True),
+                    mesh,
+                    lpa_only=run_plan.lpa_only,
+                )
+            return lambda lbl: sharded_label_propagation(
+                sg, mesh, max_iter=1, init_labels=lbl
+            )
+        if variant == "single_sort":
+            # Degradation rung: the plain sort-based superstep over the
+            # bare message CSR — no padded bucket matrices, ~identical
+            # labels by construction (tests/test_lpa.py pins parity).
+            from graphmine_tpu.ops.lpa import lpa_superstep
 
-        def one_iter(lbl):
-            return sharded_label_propagation(sg, mesh, max_iter=1, init_labels=lbl)
-
-    else:
-        # Fused degree-bucketed kernel (ops/bucketed_mode.py): ~3x the
-        # sort-based superstep, identical labels. The plan was built
-        # alongside the Graph from one shared message-CSR pass
+            step = jax.jit(lpa_superstep)
+            return lambda lbl: step(lbl, graph)
+        # "single": fused degree-bucketed kernel (ops/bucketed_mode.py):
+        # ~3x the sort-based superstep, identical labels. The plan was
+        # built alongside the Graph from one shared message-CSR pass
         # (wants_plan in run_pipeline is true exactly for this branch).
         from graphmine_tpu.ops.bucketed_mode import lpa_superstep_bucketed
 
-        if mode_plan is None:
+        if plan_holder[0] is None:
             raise ValueError("single-device LPA requires the fused plan "
                              "built by run_pipeline (wants_plan)")
-        plan = mode_plan
         step = jax.jit(lpa_superstep_bucketed)
+        plan = plan_holder[0]
+        return lambda lbl: step(lbl, graph, plan)
 
-        def one_iter(lbl):
-            return step(lbl, graph, plan)
+    def save_ck(iteration: int) -> None:
+        if config.checkpoint_dir:
+            ckpt.save_labels(
+                config.checkpoint_dir, state["labels"], iteration,
+                fingerprint=fingerprint,
+            )
 
-    with maybe_profile(config.profile_dir):
-        for it in range(start_iter, config.max_iter):
-            t0 = time.perf_counter()
-            new = one_iter(labels)
-            new.block_until_ready()
-            dt = time.perf_counter() - t0
-            changed = int((new != labels).sum())
-            labels = new
-            m.lpa_iteration(it + 1, changed, graph.num_edges, dt, chips)
-            # Cadence (r3): every Nth superstep, plus always the final one
-            # so a completed run's checkpoint is never stale.
-            if config.checkpoint_dir and (
-                (it + 1) % config.checkpoint_every == 0
-                or it + 1 == config.max_iter
-            ):
-                ckpt.save_labels(
-                    config.checkpoint_dir, labels, it + 1, fingerprint=fingerprint
+    # Built supersteps survive retry re-entry: a transient failure at
+    # superstep N must not repartition/reshard the whole graph (minutes
+    # of host+device work at scale) nor emit a duplicate "partition"
+    # record before resuming at N.
+    superstep_cache: dict = {}
+    # Variants that have completed >=1 superstep in THIS build: the first
+    # superstep of a freshly built variant includes its XLA compile, which
+    # can dwarf the steady-state bound the operator sized the watchdog
+    # for — arming it there would kill the very rung a degradation just
+    # rescued the run with. The watchdog arms from the second superstep.
+    warmed: set = set()
+
+    def make_runner(variant: str):
+        """The remaining-supersteps loop at one operating point. Runs
+        iterations one jit call at a time so the labels-changed counter
+        and edges/sec stay observable (the loop is device-resident; only
+        the scalar counter syncs) and every superstep is a watchdog +
+        checkpoint boundary."""
+
+        def run():
+            # The ladder degrades BECAUSE device memory ran out: before
+            # building this rung's superstep, release everything the
+            # failed rung held on device — its cached superstep closure
+            # (sharded label/bucket arrays) and, once the fused kernel is
+            # abandoned, the plan's padded bucket matrices. Retries
+            # re-enter the SAME variant, so its cache entry survives.
+            for stale in [k for k in superstep_cache if k != variant]:
+                del superstep_cache[stale]
+                warmed.discard(stale)  # re-entry would recompile
+            if variant != "single":
+                plan_holder[0] = None
+            if variant not in superstep_cache:
+                superstep_cache[variant] = make_superstep(variant)
+            one_iter = superstep_cache[variant]
+            while state["it"] < config.max_iter:
+                it = state["it"]
+
+                def step_sync():
+                    resilience.fault_point(
+                        "lpa_superstep", iteration=it + 1, variant=variant
+                    )
+                    new = one_iter(state["labels"])
+                    new.block_until_ready()
+                    return new
+
+                t0 = time.perf_counter()
+                # Watchdog contract: checkpoint-then-abort. On a hung
+                # superstep the LAST GOOD labels (iteration `it`) are
+                # saved before SuperstepTimeout surfaces, so the run
+                # resumes exactly where it hung. Unarmed (None) for a
+                # variant's compile-bearing first superstep — see
+                # ``warmed`` above.
+                new = resilience.run_with_watchdog(
+                    "lpa_superstep", step_sync,
+                    policy.superstep_timeout_s if variant in warmed else None,
+                    m,
+                    # no hook at all without a checkpoint_dir: the timeout
+                    # message/record must not claim a checkpoint was saved
+                    on_timeout=(
+                        (lambda it=it: save_ck(it))
+                        if config.checkpoint_dir else None
+                    ),
                 )
+                dt = time.perf_counter() - t0
+                warmed.add(variant)
+                changed = int((new != state["labels"]).sum())
+                state["labels"] = new
+                state["it"] = it + 1
+                m.lpa_iteration(it + 1, changed, graph.num_edges, dt, chips)
+                # Cadence (r3): every Nth superstep, plus always the final
+                # one so a completed run's checkpoint is never stale.
+                if config.checkpoint_dir and (
+                    (it + 1) % config.checkpoint_every == 0
+                    or it + 1 == config.max_iter
+                ):
+                    save_ck(it + 1)
+            return state["labels"]
+
+        return run
+
+    from graphmine_tpu.pipeline.planner import degradation_ladder
+
+    rungs = degradation_ladder(run_plan.schedule, n_dev)
+    with maybe_profile(config.profile_dir):
+        labels = resilience.run_phase(
+            "lpa", make_runner(run_plan.schedule), policy, m,
+            ladder=tuple((v, make_runner(v)) for v in rungs),
+            # supersteps advanced since the last failure => a NEW incident:
+            # the retry budget bounds attempts per incident, not per run
+            progress=lambda: state["it"],
+        )
     return labels
 
 

@@ -19,14 +19,38 @@ log = logging.getLogger("graphmine_tpu")
 
 @dataclass
 class MetricsSink:
-    """Collects phase timings and counters; emits JSON lines via logging."""
+    """Collects phase timings and counters; emits JSON lines via logging.
+
+    ``stream_path``: when set, every record is ALSO appended to that file
+    as it is emitted (line-buffered JSONL). Exit-time-only persistence
+    would lose exactly the records that matter most — a preemption or
+    OOM-kill ends the process without running any ``finally`` block, and
+    those are the runs whose retry/degrade/rollback trail the operator
+    needs. A stream write failure disables streaming with one warning
+    (the in-memory records remain for the exit-time fallback)."""
 
     records: list = field(default_factory=list)
+    stream_path: str | None = None
+    _stream: object = field(default=None, repr=False)
+    _stream_ok: bool = field(default=True, repr=False)
 
     def emit(self, phase: str, **kv) -> dict:
         rec = {"phase": phase, "t": time.time(), **kv}
         self.records.append(rec)
-        log.info("%s", json.dumps(rec, default=str))
+        line = json.dumps(rec, default=str)
+        log.info("%s", line)
+        if self.stream_path is not None and self._stream_ok:
+            try:
+                if self._stream is None:
+                    self._stream = open(self.stream_path, "w")
+                self._stream.write(line + "\n")
+                self._stream.flush()
+            except OSError as e:
+                self._stream_ok = False
+                log.warning(
+                    "metrics stream to %s failed: %r; records will be "
+                    "written at exit instead", self.stream_path, e,
+                )
         return rec
 
     @contextlib.contextmanager
@@ -36,6 +60,35 @@ class MetricsSink:
             yield
         finally:
             self.emit(phase, seconds=round(time.perf_counter() - t0, 4), **kv)
+
+    def of_phase(self, phase: str) -> list:
+        """All records for one phase name — recovery events (``retry``,
+        ``degrade``, ``quarantine``, ``checkpoint_rollback``, ...) are
+        phases like any other, so observability tooling and tests filter
+        them the same way."""
+        return [r for r in self.records if r.get("phase") == phase]
+
+    def write_jsonl(self, path: str) -> str:
+        """Dump every record as JSON lines (the on-disk twin of the
+        logging stream; one file per run for offline triage)."""
+        with open(path, "w") as f:
+            for rec in self.records:
+                f.write(json.dumps(rec, default=str) + "\n")
+        return path
+
+    def finalize(self, path: str) -> str:
+        """End-of-run persistence: when the live stream wrote every
+        record, just close it; otherwise (streaming off, or it failed
+        mid-run) write the whole file in one pass."""
+        if self._stream is not None:
+            try:
+                self._stream.close()
+            except OSError:
+                self._stream_ok = False
+            self._stream = None
+            if self._stream_ok and self.stream_path == path:
+                return path
+        return self.write_jsonl(path)
 
     def lpa_iteration(self, it: int, changed: int, num_edges: int, seconds: float, chips: int):
         """Per-superstep record with the headline edges/sec/chip metric."""

@@ -139,6 +139,33 @@ def estimate_bytes_per_device(
     raise ValueError(f"unknown schedule {schedule!r}")
 
 
+def degradation_ladder(schedule: str, num_devices: int) -> list[str]:
+    """Successive LPA operating points after resource exhaustion under
+    ``schedule`` — the planner's answer to "the plan fit on paper but the
+    device disagreed" (fragmentation, a co-tenant, an optimistic budget).
+
+    Each rung trades speed for strictly less per-device memory, per the
+    model above:
+
+    - ``single`` → ``single_sort``: drop the fused kernel's padded bucket
+      matrices and per-bucket gather transients (~5E of the 36 B/edge);
+      the plain sort-based superstep runs over the bare message CSR.
+    - ``replicated`` → ``ring``: drop the replicated V-length label
+      vector (the 16 B/vertex term) — labels stay sharded, chunks rotate
+      over ICI.
+    - ``ring``: nothing below — ring is already the memory floor; the
+      failure surfaces.
+
+    The driver re-runs the remaining supersteps on the next rung from the
+    last good label state, recording a ``degrade`` metrics event.
+    """
+    if schedule == "single" or num_devices <= 1:
+        return ["single_sort"]
+    if schedule == "replicated":
+        return ["ring"]
+    return []
+
+
 def plan_run(
     num_vertices: int,
     num_edges: int,

@@ -1,0 +1,321 @@
+"""Resilient phase execution: error taxonomy, bounded retry, degradation.
+
+The reference Spark script inherited fault tolerance from the JVM engine
+(lineage recomputation, task retry, speculative execution). The TPU-native
+driver has no such engine underneath it — one transient XLA runtime error,
+preemption, or OOM killed a whole billion-edge run. This module is the
+driver's execution armor:
+
+- an **error taxonomy** (:func:`classify_error`): every exception out of a
+  pipeline phase is *retryable* (transient runtime/RPC weather — retry the
+  same work), *degradable* (resource exhaustion — the same work cannot
+  succeed at this operating point; step down the degradation ladder), or
+  *fatal* (bugs, bad input, preemption — surface immediately);
+- :func:`run_phase`: bounded retry with exponential backoff + deterministic
+  jitter for retryables, ladder descent for degradables, immediate
+  re-raise for fatals — every decision emitted as a structured record
+  through the :class:`~graphmine_tpu.pipeline.metrics.MetricsSink`;
+- :func:`run_with_watchdog`: a wall-clock bound on a single phase step
+  (hung LPA supersteps), with a checkpoint-then-abort hook;
+- :func:`fault_point`: the deterministic fault-injection seam used by
+  :mod:`graphmine_tpu.testing.faults` so every recovery path above is
+  exercised in CI on CPU, no TPU required.
+
+Everything here is stdlib-only and host-side; nothing imports jax.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+RETRYABLE = "retryable"
+DEGRADABLE = "degradable"
+FATAL = "fatal"
+
+# Transient runtime weather: the work is sound, the attempt was unlucky.
+# XLA/PJRT runtime errors carry their absl status code as a message PREFIX
+# ("UNAVAILABLE: socket closed ..."), so the status tokens are anchored to
+# the start of the message — a fatal error that merely *quotes* a token
+# ("failed reading /data/ABORTED_run/...") must not be retried. The phrase
+# markers are specific enough to match anywhere. The injected faults in
+# testing/faults.py use the same message shapes on purpose: the classifier
+# under test is this one, not a test double.
+_RETRYABLE_STATUS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED", "UNKNOWN")
+_RETRYABLE_PHRASES = ("socket closed", "connection reset", "transport closed")
+
+# Resource exhaustion: retrying the identical program would OOM again;
+# the only way forward is a smaller operating point (degradation ladder).
+_DEGRADABLE_STATUS = ("RESOURCE_EXHAUSTED",)
+_DEGRADABLE_PHRASES = ("Out of memory", "out of memory")
+
+
+def _status_prefixed(msg: str, codes: tuple) -> bool:
+    return any(msg == c or msg.startswith(c + ":") for c in codes)
+
+
+class ResilienceError(RuntimeError):
+    """Base for errors raised by the resilience layer itself."""
+
+    graphmine_error_class = FATAL
+
+
+class RetriesExhausted(ResilienceError):
+    """A retryable error outlasted the retry budget. ``__cause__`` holds
+    the final underlying error."""
+
+
+class SuperstepTimeout(ResilienceError):
+    """A watchdogged phase step exceeded its wall-clock bound. When a
+    checkpoint hook was given, the last good state was checkpointed
+    before this was raised — the message says which case applies."""
+
+
+def classify_error(exc: BaseException) -> str:
+    """Map an exception to RETRYABLE / DEGRADABLE / FATAL.
+
+    Precedence: an explicit ``graphmine_error_class`` attribute (the
+    protocol for injected faults and our own error types) wins; then
+    degradable resource-exhaustion markers (checked before retryable ones:
+    an OOM status string may also mention a retryable-looking transport
+    detail); then transient markers and connection errors; else fatal.
+    """
+    explicit = getattr(exc, "graphmine_error_class", None)
+    if explicit in (RETRYABLE, DEGRADABLE, FATAL):
+        return explicit
+    if isinstance(exc, MemoryError):
+        return DEGRADABLE
+    msg = str(exc)
+    if _status_prefixed(msg, _DEGRADABLE_STATUS) or any(
+        m in msg for m in _DEGRADABLE_PHRASES
+    ):
+        return DEGRADABLE
+    if isinstance(exc, ConnectionError):
+        return RETRYABLE
+    if _status_prefixed(msg, _RETRYABLE_STATUS) or any(
+        m in msg for m in _RETRYABLE_PHRASES
+    ):
+        return RETRYABLE
+    return FATAL
+
+
+@dataclass
+class ResilienceConfig:
+    """Knobs for :func:`run_phase` / :func:`run_with_watchdog`.
+
+    ``max_retries`` bounds *additional* attempts per phase (0 = one attempt,
+    no retry). Backoff for attempt ``n`` (1-based) is
+    ``min(backoff_base_s * 2**(n-1), backoff_max_s)`` scaled by a
+    deterministic jitter in ``[1 - jitter, 1 + jitter]`` (seeded per phase
+    and process: reproducible within one process, decorrelated across
+    phases and across a fleet of workers).
+    ``superstep_timeout_s`` arms the LPA superstep watchdog (None = off,
+    the default). Size it to steady-state step time: the driver leaves the
+    compile-bearing first superstep of each operating point unarmed, so
+    XLA compilation (which can dwarf a steady-state step) never trips it.
+    ``degradation`` is ``"auto"`` (walk the ladder on degradable errors) or
+    ``"off"`` (surface the error; an operator who sized the run wants the
+    OOM, not a silently slower schedule).
+    """
+
+    max_retries: int = 2
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 5.0
+    jitter: float = 0.5
+    superstep_timeout_s: float | None = None
+    degradation: str = "auto"
+
+    def validate(self) -> "ResilienceConfig":
+        if self.max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        if self.backoff_base_s < 0 or self.backoff_max_s < 0:
+            raise ValueError("backoff seconds must be >= 0")
+        if not 0 <= self.jitter <= 1:
+            raise ValueError("jitter must be in [0, 1]")
+        if self.superstep_timeout_s is not None and self.superstep_timeout_s <= 0:
+            raise ValueError("superstep_timeout_s must be positive")
+        if self.degradation not in ("auto", "off"):
+            raise ValueError(f"unknown degradation policy {self.degradation!r}")
+        return self
+
+
+def backoff_s(policy: ResilienceConfig, attempt: int, rng: random.Random) -> float:
+    """Jittered exponential delay before retry ``attempt`` (1-based)."""
+    base = min(policy.backoff_base_s * (2 ** (attempt - 1)), policy.backoff_max_s)
+    return base * (1 + policy.jitter * (2 * rng.random() - 1))
+
+
+def _retry_loop(name, thunk, policy, metrics, sleep, rng, progress=None):
+    """Retry ``thunk`` on transient errors, ``max_retries`` times per
+    *incident*: when ``progress()`` has advanced since the last failure
+    (e.g. the LPA loop's iteration counter), the budget resets — a
+    multi-hour run that recovers cleanly from independent transient
+    events at superstep 10 and superstep 9000 must not die on the
+    third, hours later, because a lifetime counter ran out."""
+    attempt = 0
+    last_mark = progress() if progress is not None else None
+    while True:
+        try:
+            return thunk()
+        except Exception as e:
+            if classify_error(e) != RETRYABLE:
+                raise
+            if progress is not None:
+                mark = progress()
+                if mark != last_mark:
+                    attempt = 0
+                    last_mark = mark
+            attempt += 1
+            if attempt > policy.max_retries:
+                metrics.emit(
+                    "retries_exhausted", stage=name,
+                    attempts=attempt, error=repr(e),
+                )
+                raise RetriesExhausted(
+                    f"phase {name!r} still failing transiently after "
+                    f"{attempt} attempts with no progress: {e!r}"
+                ) from e
+            delay = backoff_s(policy, attempt, rng)
+            metrics.emit(
+                "retry", stage=name, attempt=attempt,
+                backoff_s=round(delay, 4), error=repr(e),
+            )
+            sleep(delay)
+
+
+def run_phase(
+    name: str,
+    fn,
+    policy: ResilienceConfig,
+    metrics,
+    ladder: tuple = (),
+    sleep=time.sleep,
+    progress=None,
+):
+    """Run ``fn()`` with the full retry/degrade/fail taxonomy applied.
+
+    ``ladder``: ordered ``(label, thunk)`` fallbacks for degradable
+    failures — each rung is itself retried on transient errors. Thunks that
+    share mutable state (e.g. the LPA loop's labels + iteration counter)
+    make a rung *resume* rather than restart; see the driver.
+
+    ``progress``: optional zero-arg callable sampled at each failure; when
+    its value has advanced since the previous failure the retry budget
+    resets — ``max_retries`` bounds attempts per *incident*, not per phase
+    lifetime (see :func:`_retry_loop`).
+
+    Emits ``retry`` / ``retries_exhausted`` / ``degrade`` records through
+    ``metrics``. Raises the classified-fatal error, the degradable error
+    when the ladder is exhausted (or degradation is off), or
+    :class:`RetriesExhausted`.
+    """
+    # Jitter stream seeded per (phase, process): reproducible within one
+    # process, but DIFFERENT across a fleet — N preempted workers retrying
+    # a shared dependency must not wake in lockstep (the thundering herd
+    # jitter exists to prevent).
+    rng = random.Random(f"{name}:{os.getpid()}")
+    steps = [(None, fn), *ladder]
+    for depth, (label, thunk) in enumerate(steps):
+        try:
+            return _retry_loop(
+                name, thunk, policy, metrics, sleep, rng, progress
+            )
+        except Exception as e:
+            if (
+                classify_error(e) == DEGRADABLE
+                and policy.degradation == "auto"
+                and depth < len(steps) - 1
+            ):
+                metrics.emit(
+                    "degrade", stage=name, to=steps[depth + 1][0],
+                    depth=depth + 1, error=repr(e),
+                )
+                continue
+            raise
+
+
+def run_with_watchdog(name, fn, timeout_s, metrics, on_timeout=None):
+    """Run ``fn()`` bounded by ``timeout_s`` wall-clock seconds.
+
+    The work runs in a daemon worker thread; on timeout, ``on_timeout()``
+    fires (the driver checkpoints the last good labels) and
+    :class:`SuperstepTimeout` is raised. A truly hung device call cannot be
+    interrupted portably from Python, so the contract is
+    **checkpoint-then-abort**: the abandoned worker stays parked in the
+    runtime while the process surfaces the error, and the run resumes from
+    the checkpoint after the hang is resolved. ``timeout_s`` of None/0
+    runs ``fn`` inline with no thread.
+    """
+    if not timeout_s:
+        return fn()
+    result: list = []
+    err: list = []
+
+    def _target():
+        try:
+            result.append(fn())
+        except BaseException as e:  # propagate even SystemExit-ish faults
+            err.append(e)
+
+    t = threading.Thread(target=_target, daemon=True, name=f"{name}-watchdog")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        # Run the hook FIRST, tolerating its failure: the record and the
+        # message must state what actually happened, and a failing save
+        # (disk full) must not suppress the timeout — the hang is the
+        # root cause the operator needs to see.
+        checkpointed = False
+        save_err = None
+        if on_timeout is not None:
+            try:
+                on_timeout()
+                checkpointed = True
+            except Exception as e:
+                save_err = e
+        metrics.emit(
+            "watchdog_timeout", stage=name, timeout_s=timeout_s,
+            checkpointed=checkpointed,
+        )
+        if checkpointed:
+            hint = ("last good state was checkpointed — resume after "
+                    "resolving the hang")
+        elif on_timeout is not None:
+            hint = (f"the checkpoint hook FAILED ({save_err!r}); no "
+                    "recovery point was saved")
+        else:
+            hint = ("NO checkpoint hook was configured; the run restarts "
+                    "from scratch (set checkpoint_dir to make hangs "
+                    "resumable)")
+        raise SuperstepTimeout(
+            f"phase {name!r} exceeded its {timeout_s}s watchdog; {hint}"
+        ) from save_err
+    if err:
+        raise err[0]
+    return result[0]
+
+
+# ---- fault-injection seam -------------------------------------------------
+# Production code calls fault_point(site, ...) at instrumented points; the
+# hook is None (zero-cost beyond one attribute read) unless
+# graphmine_tpu.testing.faults installs an injector. Kept here, not in the
+# testing package, so production modules never import test code.
+
+_fault_hook = None
+
+
+def set_fault_hook(hook) -> None:
+    """Install (or clear, with None) the process-wide fault hook."""
+    global _fault_hook
+    _fault_hook = hook
+
+
+def fault_point(site: str, **ctx) -> None:
+    """Named instrumentation point; raises whatever the installed injector
+    decides to raise at this site (deterministically, per its plan)."""
+    hook = _fault_hook
+    if hook is not None:
+        hook(site, **ctx)

@@ -1,0 +1,4 @@
+"""Test-support utilities shipped with the package (deterministic fault
+injection, corruption helpers). Production modules never import from here;
+the coupling runs one way, through
+:func:`graphmine_tpu.pipeline.resilience.set_fault_hook`."""

@@ -1,0 +1,194 @@
+"""Deterministic fault injection — every recovery path exercised on CPU.
+
+A :class:`FaultInjector` installs into the
+:func:`graphmine_tpu.pipeline.resilience.fault_point` seam and raises a
+planned error the Nth time a named site is hit::
+
+    inj = FaultInjector()
+    inj.add("lpa_superstep", transient_error, at=2)      # 2nd superstep
+    inj.add("lpa_superstep", oom_error, at=4, repeat=2)  # 4th AND 5th hit
+    with inj.installed():
+        run_pipeline(cfg)
+    assert inj.fired("lpa_superstep") == 1
+
+Sites currently instrumented in the driver: ``load``, ``build_graph``,
+``lpa_superstep`` (ctx: ``iteration``), ``census``, ``outliers_recursive``,
+``outliers_lof``.
+
+The error factories below produce exceptions whose *messages* mimic real
+XLA/PJRT runtime failures (``UNAVAILABLE: ...``, ``RESOURCE_EXHAUSTED:
+...``), so the production classifier
+(:func:`~graphmine_tpu.pipeline.resilience.classify_error`) is the code
+under test — not a test double.
+
+File corruptors (:func:`corrupt_file`, :func:`truncate_file`) damage
+checkpoints/parquet bytes in place to exercise checksum rollback and
+ingestion failure paths.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from dataclasses import dataclass, field
+
+from graphmine_tpu.pipeline import resilience
+
+
+class InjectedTransientError(Exception):
+    """Looks like transient device/RPC weather; classified retryable."""
+
+
+class InjectedOOM(Exception):
+    """Looks like device memory exhaustion; classified degradable."""
+
+
+class SimulatedPreemption(Exception):
+    """A preempted worker: the process dies mid-run. Fatal by contract —
+    recovery is a NEW process resuming from the checkpoint, not a retry."""
+
+    graphmine_error_class = resilience.FATAL
+
+
+class InjectedHang(Exception):
+    """Marker used via :func:`hang` (sleeps, never raises)."""
+
+
+def transient_error() -> Exception:
+    return InjectedTransientError(
+        "UNAVAILABLE: socket closed; failed to connect to remote runtime "
+        "(injected fault)"
+    )
+
+
+def oom_error() -> Exception:
+    return InjectedOOM(
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        "9437184000 bytes (injected fault)"
+    )
+
+
+def preemption() -> Exception:
+    return SimulatedPreemption("worker preempted (injected fault)")
+
+
+# Parked hang() sleepers, each waiting on its OWN event. A single shared
+# event is unfixably racy for this: set()-then-clear() can put a notified
+# sleeper back to sleep (Event.wait re-checks the flag), and swapping in a
+# fresh event races sleepers that haven't sampled the global yet. With one
+# event per sleeper, release simply sets every registered event — an event,
+# once set, stays set for its owner.
+_sleepers_lock = None  # threading.Lock, created lazily
+_sleepers: list = []
+
+
+def _release_abandoned_sleepers() -> None:
+    """Wake every parked :func:`hang` sleeper (see ``_sleepers``)."""
+    if _sleepers_lock is None:
+        return
+    with _sleepers_lock:
+        for ev in _sleepers:
+            ev.set()
+        _sleepers.clear()
+
+
+def hang(seconds: float):
+    """Return a 'factory' that sleeps instead of raising — a hung device
+    call for watchdog tests. The watchdog abandons the worker thread, so
+    the sleep is interruptible: uninstalling the injector releases any
+    abandoned sleepers (a process exiting right after the timeout must
+    not race runtime teardown against a still-parked thread)."""
+    import threading
+
+    global _sleepers_lock
+    if _sleepers_lock is None:
+        _sleepers_lock = threading.Lock()
+
+    def _sleep():
+        ev = threading.Event()
+        with _sleepers_lock:
+            _sleepers.append(ev)
+        ev.wait(seconds)
+        with _sleepers_lock:
+            if ev in _sleepers:
+                _sleepers.remove(ev)
+        return None
+
+    _sleep.is_hang = True
+    return _sleep
+
+
+@dataclass
+class _Rule:
+    site: str
+    factory: object          # () -> Exception, or a hang() sleeper
+    at: int                  # 1-based hit index at which to fire
+    repeat: int = 1          # fire on this many consecutive hits
+    fired: int = 0
+
+
+@dataclass
+class FaultInjector:
+    """Deterministic site/hit-count fault plan (see module docstring)."""
+
+    rules: list = field(default_factory=list)
+    hits: dict = field(default_factory=dict)
+    log: list = field(default_factory=list)  # (site, hit, ctx) of every hit
+
+    def add(self, site: str, factory, at: int = 1, repeat: int = 1) -> "FaultInjector":
+        if at < 1 or repeat < 1:
+            raise ValueError("at and repeat are 1-based positive counts")
+        self.rules.append(_Rule(site=site, factory=factory, at=at, repeat=repeat))
+        return self
+
+    def fired(self, site: str | None = None) -> int:
+        return sum(
+            r.fired for r in self.rules if site is None or r.site == site
+        )
+
+    def __call__(self, site: str, **ctx) -> None:
+        n = self.hits[site] = self.hits.get(site, 0) + 1
+        self.log.append((site, n, ctx))
+        for r in self.rules:
+            if r.site == site and r.at <= n < r.at + r.repeat:
+                r.fired += 1
+                out = r.factory()
+                if out is not None:  # hang() sleepers return None
+                    raise out
+
+    @contextlib.contextmanager
+    def installed(self):
+        """Install into the resilience seam for the duration of the block.
+        Not reentrant; one injector at a time per process."""
+        resilience.set_fault_hook(self)
+        try:
+            yield self
+        finally:
+            resilience.set_fault_hook(None)
+            _release_abandoned_sleepers()
+
+
+def corrupt_file(path: str, offset: int = -64, nbytes: int = 16) -> None:
+    """Flip ``nbytes`` bytes in place at ``offset`` (negative = from EOF).
+    Defaults land inside the last zip member of a small ``.npz``, tripping
+    its CRC and the checkpoint checksum."""
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError(f"{path!r} is empty; nothing to corrupt")
+    pos = offset % size
+    nbytes = min(nbytes, size - pos)
+    with open(path, "r+b") as f:
+        f.seek(pos)
+        chunk = f.read(nbytes)
+        f.seek(pos)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+
+
+def truncate_file(path: str, keep_fraction: float = 0.5) -> None:
+    """Truncate a file to ``keep_fraction`` of its bytes (a partially
+    written / torn parquet part or checkpoint)."""
+    if not 0 <= keep_fraction < 1:
+        raise ValueError("keep_fraction must be in [0, 1)")
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(int(size * keep_fraction))

@@ -54,6 +54,14 @@ def _invoked_as_pytest_cli() -> bool:
 
 
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running; excluded from the tier-1 pass"
+    )
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-injection resilience suite (tests/test_resilience.py); "
+        "runs in the default CPU pass — select with -m faults",
+    )
     if not (_needs_reexec() and _invoked_as_pytest_cli()):
         return
     cap = config.pluginmanager.getplugin("capturemanager")
@@ -74,6 +82,44 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 REFERENCE_PARQUET = "/root/reference/CommunityDetection/data/outlinks_pq"
+
+
+def cached_edgelist(prefix: str, text: str) -> str:
+    """Persist generated test edge-list ``text`` at a content-addressed,
+    per-user path in the shared tempdir and return the path.
+
+    Reused across pytest runs instead of leaking one temp dir per
+    invocation — but never trusted blindly: the digest in the name
+    invalidates the cache whenever the generator changes, and the
+    read-back check means a stale or foreign file (shared /tmp) can't be
+    consumed. If the shared path isn't writable, falls back to a private
+    directory.
+    """
+    import hashlib
+    import tempfile
+
+    digest = hashlib.sha1(text.encode()).hexdigest()[:12]
+    p = os.path.join(
+        tempfile.gettempdir(), f"{prefix}_{os.getuid()}_{digest}.txt"
+    )
+    try:
+        with open(p) as f:
+            cached_ok = f.read() == text
+    except OSError:
+        cached_ok = False
+    if not cached_ok:
+        try:
+            tmp = f"{p}.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(text)
+            os.replace(tmp, p)
+        except OSError:
+            p = os.path.join(
+                tempfile.mkdtemp(prefix=f"{prefix}_"), "edges.txt"
+            )
+            with open(p, "w") as f:
+                f.write(text)
+    return p
 
 
 @pytest.fixture(scope="session")

@@ -5,6 +5,8 @@ and the driver wiring (--schedule auto default; explicit schedules still
 planner-checked; checkpoint cadence rides the same loop).
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -224,10 +226,36 @@ def test_pipeline_plan_uses_device_reported_hbm(monkeypatch, tmp_path):
 # ---------------------------------------------------------------------------
 
 
+_SYNTH = {}
+
+
+def _synthetic_edgelist() -> str:
+    """Deterministic stand-in for the bundled reference parquet (absent in
+    some containers): same V/E scale (V=4613, E=18399), so every byte
+    threshold in these tests — the 300 KB scale-out budget, the wedge
+    budget, the replicated-fits/single-doesn't split — models identically.
+    A chain over all V vertices guarantees full id coverage; the remaining
+    edges are uniform random."""
+    if "path" not in _SYNTH:
+        from conftest import cached_edgelist
+
+        v, e = 4613, 18399
+        rng = np.random.default_rng(20260802)
+        chain = np.arange(v, dtype=np.int64)
+        src = np.concatenate([chain, rng.integers(0, v, e - v)])
+        dst = np.concatenate([(chain + 1) % v, rng.integers(0, v, e - v)])
+        text = "".join(f"{s} {t}\n" for s, t in zip(src, dst))
+        _SYNTH["path"] = cached_edgelist("graphmine_synth", text)
+    return _SYNTH["path"]
+
+
 def _tiny_config(**kw):
     from graphmine_tpu.pipeline.config import PipelineConfig
 
-    defaults = dict(outlier_method="none", max_iter=3)
+    defaults = dict(
+        outlier_method="none", max_iter=3,
+        data_path=_synthetic_edgelist(), data_format="edgelist",
+    )
     defaults.update(kw)
     return PipelineConfig(**defaults)
 

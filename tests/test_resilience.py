@@ -1,0 +1,738 @@
+"""Resilience suite: every recovery path exercised on CPU via the
+deterministic fault injector (:mod:`graphmine_tpu.testing.faults`).
+
+Acceptance matrix (ISSUE 1), all end-to-end through ``run_pipeline``:
+  (a) a transient device error is retried and the run completes with
+      labels identical to the no-fault run;
+  (b) an injected OOM triggers a recorded degradation (fused kernel →
+      sort-based superstep) and still completes;
+  (c) a corrupted checkpoint rolls back to the last good generation;
+  (d) simulated preemption mid-LPA resumes to the same final labels;
+plus unit coverage of the taxonomy/backoff/watchdog primitives, the
+graph-fingerprint refusal, and ingestion-quarantine accounting — and
+every recovery decision asserted as a structured MetricsSink record.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from graphmine_tpu.pipeline import checkpoint as ckpt
+from graphmine_tpu.pipeline import resilience
+from graphmine_tpu.pipeline.config import PipelineConfig
+from graphmine_tpu.pipeline.metrics import MetricsSink
+from graphmine_tpu.pipeline.resilience import (
+    DEGRADABLE,
+    FATAL,
+    RETRYABLE,
+    ResilienceConfig,
+    RetriesExhausted,
+    SuperstepTimeout,
+    classify_error,
+    run_phase,
+    run_with_watchdog,
+)
+from graphmine_tpu.testing import faults
+
+pytestmark = pytest.mark.faults
+
+
+# ---------------------------------------------------------------------------
+# error taxonomy
+# ---------------------------------------------------------------------------
+
+
+def test_classify_error_taxonomy():
+    assert classify_error(RuntimeError("UNAVAILABLE: socket closed")) == RETRYABLE
+    assert classify_error(RuntimeError("DEADLINE_EXCEEDED: rpc")) == RETRYABLE
+    assert classify_error(ConnectionResetError("peer")) == RETRYABLE
+    assert classify_error(
+        RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating 1 bytes")
+    ) == DEGRADABLE
+    assert classify_error(MemoryError()) == DEGRADABLE
+    # degradable wins when an OOM status also mentions transport noise
+    assert classify_error(
+        RuntimeError("RESOURCE_EXHAUSTED: OOM; socket closed while spilling")
+    ) == DEGRADABLE
+    assert classify_error(ValueError("bad config")) == FATAL
+    assert classify_error(KeyError("x")) == FATAL
+
+    # the explicit protocol attribute beats message sniffing
+    e = RuntimeError("UNAVAILABLE: looks transient")
+    e.graphmine_error_class = FATAL
+    assert classify_error(e) == FATAL
+
+    # the injected fault types classify through the REAL classifier
+    assert classify_error(faults.transient_error()) == RETRYABLE
+    assert classify_error(faults.oom_error()) == DEGRADABLE
+    assert classify_error(faults.preemption()) == FATAL
+
+
+def test_resilience_config_validation():
+    ResilienceConfig().validate()
+    with pytest.raises(ValueError):
+        ResilienceConfig(max_retries=-1).validate()
+    with pytest.raises(ValueError):
+        ResilienceConfig(jitter=1.5).validate()
+    with pytest.raises(ValueError):
+        ResilienceConfig(superstep_timeout_s=0).validate()
+    with pytest.raises(ValueError):
+        ResilienceConfig(degradation="maybe").validate()
+
+
+def test_backoff_is_exponential_and_capped():
+    import random
+
+    pol = ResilienceConfig(backoff_base_s=0.1, backoff_max_s=0.4, jitter=0.0)
+    rng = random.Random(0)
+    delays = [resilience.backoff_s(pol, n, rng) for n in (1, 2, 3, 4, 5)]
+    assert delays == [0.1, 0.2, 0.4, 0.4, 0.4]  # doubles, then caps
+    # jitter stays within the documented band
+    pol_j = ResilienceConfig(backoff_base_s=0.1, backoff_max_s=10.0, jitter=0.5)
+    for n in range(1, 6):
+        d = resilience.backoff_s(pol_j, n, random.Random(n))
+        base = 0.1 * 2 ** (n - 1)
+        assert base * 0.5 <= d <= base * 1.5
+
+
+# ---------------------------------------------------------------------------
+# run_phase: retry / degrade / fatal
+# ---------------------------------------------------------------------------
+
+
+def _no_sleep(_):
+    pass
+
+
+def test_run_phase_retries_transient_then_succeeds():
+    m = MetricsSink()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise faults.transient_error()
+        return "ok"
+
+    out = run_phase("p", flaky, ResilienceConfig(max_retries=3), m,
+                    sleep=_no_sleep)
+    assert out == "ok" and calls["n"] == 3
+    retries = m.of_phase("retry")
+    assert [r["attempt"] for r in retries] == [1, 2]
+    assert all(r["stage"] == "p" and r["backoff_s"] >= 0 for r in retries)
+
+
+def test_run_phase_exhausts_retry_budget():
+    m = MetricsSink()
+
+    def always():
+        raise faults.transient_error()
+
+    with pytest.raises(RetriesExhausted) as ei:
+        run_phase("p", always, ResilienceConfig(max_retries=2), m,
+                  sleep=_no_sleep)
+    assert isinstance(ei.value.__cause__, faults.InjectedTransientError)
+    assert m.of_phase("retries_exhausted")[0]["attempts"] == 3
+    assert len(m.of_phase("retry")) == 2
+
+
+def test_retry_budget_is_per_incident_not_per_lifetime():
+    """A long-running phase that makes progress between transient
+    failures gets a fresh budget per incident — three recovered blips
+    across a run must not kill it (each incident stays bounded)."""
+    m = MetricsSink()
+    state = {"it": 0}
+    fail_at = {2, 5, 8}  # independent incidents, progress in between
+
+    def runner():
+        while state["it"] < 10:
+            if state["it"] in fail_at:
+                fail_at.discard(state["it"])
+                raise faults.transient_error()
+            state["it"] += 1
+        return "done"
+
+    out = run_phase("p", runner, ResilienceConfig(max_retries=1), m,
+                    sleep=_no_sleep, progress=lambda: state["it"])
+    assert out == "done"
+    assert len(m.of_phase("retry")) == 3
+    # every incident restarted its budget: attempt is always 1
+    assert all(r["attempt"] == 1 for r in m.of_phase("retry"))
+
+    # without progress, the same schedule exhausts the lifetime budget
+    state2 = {"it": 0}
+    fail2 = {2, 5, 8}
+
+    def runner2():
+        while state2["it"] < 10:
+            if state2["it"] in fail2:
+                fail2.discard(state2["it"])
+                raise faults.transient_error()
+            state2["it"] += 1
+        return "done"
+
+    with pytest.raises(RetriesExhausted):
+        run_phase("p", runner2, ResilienceConfig(max_retries=1),
+                  MetricsSink(), sleep=_no_sleep)
+
+
+def test_run_phase_fatal_raises_immediately():
+    m = MetricsSink()
+    calls = {"n": 0}
+
+    def bug():
+        calls["n"] += 1
+        raise ValueError("a bug, not weather")
+
+    with pytest.raises(ValueError):
+        run_phase("p", bug, ResilienceConfig(max_retries=5), m, sleep=_no_sleep)
+    assert calls["n"] == 1 and not m.of_phase("retry")
+
+
+def test_run_phase_walks_degradation_ladder():
+    m = MetricsSink()
+
+    def big():
+        raise faults.oom_error()
+
+    out = run_phase(
+        "p", big, ResilienceConfig(), m,
+        ladder=(("smaller", lambda: "degraded-ok"),), sleep=_no_sleep,
+    )
+    assert out == "degraded-ok"
+    deg = m.of_phase("degrade")
+    assert deg and deg[0]["to"] == "smaller" and deg[0]["depth"] == 1
+
+    # ladder exhausted -> the degradable error surfaces
+    with pytest.raises(faults.InjectedOOM):
+        run_phase("p", big, ResilienceConfig(), MetricsSink(), sleep=_no_sleep)
+
+    # degradation="off" surfaces the OOM without touching the ladder
+    with pytest.raises(faults.InjectedOOM):
+        run_phase(
+            "p", big, ResilienceConfig(degradation="off"), MetricsSink(),
+            ladder=(("smaller", lambda: "nope"),), sleep=_no_sleep,
+        )
+
+
+def test_run_phase_rung_is_retried_on_transient():
+    """Each ladder rung gets its own transient-retry protection."""
+    m = MetricsSink()
+    calls = {"n": 0}
+
+    def rung():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise faults.transient_error()
+        return "ok"
+
+    out = run_phase(
+        "p", lambda: (_ for _ in ()).throw(faults.oom_error()),
+        ResilienceConfig(max_retries=1), m,
+        ladder=(("rung", rung),), sleep=_no_sleep,
+    )
+    assert out == "ok" and calls["n"] == 2
+    assert m.of_phase("degrade") and m.of_phase("retry")
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_passthrough_and_errors():
+    m = MetricsSink()
+    assert run_with_watchdog("p", lambda: 42, 5.0, m) == 42
+    assert run_with_watchdog("p", lambda: 42, None, m) == 42  # inline, no thread
+    with pytest.raises(ValueError):
+        run_with_watchdog("p", lambda: (_ for _ in ()).throw(ValueError("x")),
+                          5.0, m)
+    assert not m.of_phase("watchdog_timeout")
+
+
+def test_watchdog_times_out_and_checkpoints():
+    import time
+
+    m = MetricsSink()
+    fired = []
+    with pytest.raises(SuperstepTimeout, match="was checkpointed"):
+        run_with_watchdog(
+            "p", lambda: time.sleep(1.5), 0.1, m,
+            on_timeout=lambda: fired.append(True),
+        )
+    assert fired == [True]
+    rec = m.of_phase("watchdog_timeout")
+    assert rec and rec[0]["timeout_s"] == 0.1 and rec[0]["checkpointed"]
+
+
+def test_watchdog_without_hook_does_not_claim_a_checkpoint():
+    import time
+
+    m = MetricsSink()
+    with pytest.raises(SuperstepTimeout, match="NO checkpoint hook"):
+        run_with_watchdog("p", lambda: time.sleep(1.5), 0.1, m)
+    assert m.of_phase("watchdog_timeout")[0]["checkpointed"] is False
+
+
+def test_watchdog_survives_a_failing_checkpoint_hook():
+    """A failing save (disk full) must not suppress the timeout — the
+    hang is the root cause — and the record must not claim a checkpoint."""
+    import time
+
+    m = MetricsSink()
+
+    def bad_save():
+        raise OSError("No space left on device")
+
+    with pytest.raises(SuperstepTimeout, match="hook FAILED") as ei:
+        run_with_watchdog("p", lambda: time.sleep(1.5), 0.1, m,
+                          on_timeout=bad_save)
+    assert isinstance(ei.value.__cause__, OSError)
+    assert m.of_phase("watchdog_timeout")[0]["checkpointed"] is False
+
+
+# ---------------------------------------------------------------------------
+# fault injector mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_fault_injector_is_deterministic():
+    inj = faults.FaultInjector()
+    inj.add("s", faults.transient_error, at=2)
+    inj.add("s", faults.oom_error, at=4, repeat=2)
+    seen = []
+    with inj.installed():
+        for i in range(1, 7):
+            try:
+                resilience.fault_point("s", i=i)
+                seen.append("ok")
+            except faults.InjectedTransientError:
+                seen.append("transient")
+            except faults.InjectedOOM:
+                seen.append("oom")
+    assert seen == ["ok", "transient", "ok", "oom", "oom", "ok"]
+    assert inj.fired("s") == 3 and inj.fired() == 3
+    assert [ctx["i"] for (_, _, ctx) in inj.log] == [1, 2, 3, 4, 5, 6]
+    # uninstalled: the seam is inert again
+    resilience.fault_point("s", i=99)
+    assert len(inj.log) == 6
+
+
+def test_file_corruptors(tmp_path):
+    p = tmp_path / "f.bin"
+    p.write_bytes(bytes(range(200)))
+    faults.corrupt_file(str(p), offset=-10, nbytes=4)
+    data = p.read_bytes()
+    assert len(data) == 200 and data[:190] == bytes(range(190))
+    assert data[190:194] != bytes(range(190, 194))
+    faults.truncate_file(str(p), keep_fraction=0.5)
+    assert p.stat().st_size == 100
+    with pytest.raises(ValueError):
+        faults.truncate_file(str(p), keep_fraction=1.5)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint hardening (API level)
+# ---------------------------------------------------------------------------
+
+
+def test_save_labels_is_atomic_and_rotates(tmp_path):
+    d = str(tmp_path)
+    lbl1 = np.arange(10, dtype=np.int32)
+    path = ckpt.save_labels(d, lbl1, 1)
+    assert not [f for f in os.listdir(d) if ".tmp" in f]  # no tmp debris
+    ckpt.save_labels(d, lbl1 + 1, 2)
+    # previous generation rotated aside, current is the new save
+    labels, it = ckpt.load_labels(d)
+    assert it == 2
+    prev = path[: -len(".npz")] + ".prev.npz"
+    assert os.path.exists(prev)
+
+
+@pytest.mark.parametrize("damage", [faults.corrupt_file,
+                                    lambda p: faults.truncate_file(p, 0.3)])
+def test_corrupt_checkpoint_rolls_back(tmp_path, damage):
+    d = str(tmp_path)
+    good = np.arange(32, dtype=np.int32) % 7
+    ckpt.save_labels(d, good, 3)
+    ckpt.save_labels(d, good * 0, 4)  # current generation, to be damaged
+    damage(os.path.join(d, "lpa_labels.npz"))
+    m = MetricsSink()
+    labels, it = ckpt.load_labels(d, sink=m)
+    np.testing.assert_array_equal(labels, good)
+    assert it == 3
+    assert m.of_phase("checkpoint_rollback") and m.of_phase("checkpoint_rollback_ok")
+    # the good generation was promoted back to the current slot
+    labels2, it2 = ckpt.load_labels(d)
+    assert it2 == 3
+    # the condemned file is preserved for forensics, not destroyed (the
+    # corruption verdict may stem from a transient read error)
+    assert os.path.exists(os.path.join(d, "lpa_labels.npz.corrupt"))
+
+
+def test_both_generations_corrupt_is_a_clean_failure(tmp_path):
+    d = str(tmp_path)
+    ckpt.save_labels(d, np.arange(8, dtype=np.int32), 1)
+    ckpt.save_labels(d, np.arange(8, dtype=np.int32), 2)
+    faults.corrupt_file(os.path.join(d, "lpa_labels.npz"))
+    faults.corrupt_file(os.path.join(d, "lpa_labels.prev.npz"))
+    with pytest.raises(ckpt.CheckpointCorruptionError, match="both"):
+        ckpt.load_labels(d)
+
+
+def test_unrecoverable_corruption_emits_no_rollback_record(tmp_path):
+    """A corrupt sole generation (nothing to roll back TO) must not leave
+    a checkpoint_rollback record claiming a recovery that never ran."""
+    d = str(tmp_path)
+    ckpt.save_labels(d, np.arange(8, dtype=np.int32), 1)
+    faults.corrupt_file(os.path.join(d, "lpa_labels.npz"))
+    m = MetricsSink()
+    with pytest.raises(ckpt.CheckpointCorruptionError, match="no\\s+previous"):
+        ckpt.load_labels(d, sink=m)
+    assert not m.of_phase("checkpoint_rollback")
+
+
+def test_checksum_catches_internally_consistent_rewrite(tmp_path):
+    """Damage that re-zips cleanly (valid CRCs, wrong content) is still
+    caught by the embedded state checksum."""
+    d = str(tmp_path)
+    ckpt.save_labels(d, np.arange(8, dtype=np.int32), 1)
+    ckpt.save_labels(d, np.arange(8, dtype=np.int32), 2)
+    path = os.path.join(d, "lpa_labels.npz")
+    with np.load(path) as z:
+        state = {k: z[k] for k in z.files}
+    state["labels"] = state["labels"] + 1  # silent bit damage, then re-save
+    np.savez(path, **state)
+    m = MetricsSink()
+    labels, it = ckpt.load_labels(d, sink=m)
+    assert it == 1  # rolled back past the forged file
+    assert "checksum" in m.of_phase("checkpoint_rollback")[0]["error"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the driver (8 virtual CPU devices via conftest)
+# ---------------------------------------------------------------------------
+
+_E2E = {}
+
+
+def _edgelist_path() -> str:
+    """Small deterministic graph shared by every e2e test: two planted
+    communities plus random cross edges — enough structure that LPA takes
+    several supersteps (checkpoint/retry boundaries to inject at)."""
+    if "path" not in _E2E:
+        from conftest import cached_edgelist
+
+        rng = np.random.default_rng(7)
+        v, e = 160, 800
+        src = rng.integers(0, v, e)
+        # bias edges to stay within each half: two communities
+        dst = (src + rng.integers(1, v // 2, e)) % (v // 2) + (src // (v // 2)) * (v // 2)
+        cross = rng.random(e) < 0.05
+        dst = np.where(cross, rng.integers(0, v, e), dst)
+        text = "".join(f"{s} {t}\n" for s, t in zip(src, dst))
+        _E2E["path"] = cached_edgelist("graphmine_resilience", text)
+    return _E2E["path"]
+
+
+def _cfg(**kw):
+    base = dict(
+        data_path=_edgelist_path(), data_format="edgelist",
+        outlier_method="none", num_devices=1, max_iter=5,
+        resilience=ResilienceConfig(backoff_base_s=0.001, backoff_max_s=0.01),
+    )
+    base.update(kw)
+    return PipelineConfig(**base)
+
+
+def _baseline_labels():
+    if "labels" not in _E2E:
+        from graphmine_tpu.pipeline.driver import run_pipeline
+
+        _E2E["labels"] = run_pipeline(_cfg()).labels
+    return _E2E["labels"]
+
+
+def test_transient_error_is_retried_to_identical_labels():
+    """(a): transient device weather at superstep 2 AND at ingestion —
+    both retried, final labels byte-identical to the no-fault run."""
+    from graphmine_tpu.pipeline.driver import run_pipeline
+
+    inj = faults.FaultInjector()
+    inj.add("load", faults.transient_error, at=1)
+    inj.add("lpa_superstep", faults.transient_error, at=2)
+    with inj.installed():
+        res = run_pipeline(_cfg())
+    assert inj.fired() == 2
+    np.testing.assert_array_equal(res.labels, _baseline_labels())
+    retries = res.metrics.of_phase("retry")
+    assert {r["stage"] for r in retries} == {"load", "lpa"}
+
+
+def test_oom_triggers_recorded_degradation_and_completes():
+    """(b): OOM at superstep 2 on the fused single-device kernel — the
+    planner's ladder steps down to the sort-based superstep, the run
+    completes from the last good state, labels still match."""
+    from graphmine_tpu.pipeline.driver import run_pipeline
+
+    inj = faults.FaultInjector()
+    inj.add("lpa_superstep", faults.oom_error, at=2)
+    with inj.installed():
+        res = run_pipeline(_cfg())
+    np.testing.assert_array_equal(res.labels, _baseline_labels())
+    deg = res.metrics.of_phase("degrade")
+    assert deg and deg[0]["stage"] == "lpa" and deg[0]["to"] == "single_sort"
+    # supersteps resumed, not restarted: 5 good iterations exactly
+    iters = [r["iteration"] for r in res.metrics.of_phase("lpa_iter")]
+    assert iters == [1, 2, 3, 4, 5]
+
+
+def test_corrupted_checkpoint_rolls_back_e2e(tmp_path):
+    """(c): the current checkpoint generation is corrupted on disk; resume
+    rolls back to the previous good generation and converges to the same
+    labels, emitting checkpoint_rollback records."""
+    from graphmine_tpu.pipeline.driver import run_pipeline
+
+    ck = str(tmp_path / "ck")
+    run_pipeline(_cfg(checkpoint_dir=ck))  # saves every superstep
+    faults.corrupt_file(os.path.join(ck, "lpa_labels.npz"))
+    res = run_pipeline(_cfg(checkpoint_dir=ck, resume=True))
+    np.testing.assert_array_equal(res.labels, _baseline_labels())
+    assert res.metrics.of_phase("checkpoint_rollback")
+    ok = res.metrics.of_phase("checkpoint_rollback_ok")
+    assert ok and ok[0]["iteration"] == 4  # prev generation = superstep 4
+    resume = res.metrics.of_phase("resume")
+    assert resume and resume[0]["iteration"] == 4
+
+
+def test_preemption_mid_lpa_resumes_to_same_labels(tmp_path):
+    """(d): a simulated preemption kills the run at superstep 3 (fatal by
+    contract — no in-process retry); a NEW run with --resume picks up from
+    the checkpoint and lands on identical final labels."""
+    from graphmine_tpu.pipeline.driver import run_pipeline
+
+    ck = str(tmp_path / "ck")
+    inj = faults.FaultInjector()
+    inj.add("lpa_superstep", faults.preemption, at=3)
+    with inj.installed():
+        with pytest.raises(faults.SimulatedPreemption):
+            run_pipeline(_cfg(checkpoint_dir=ck))
+    # no retry was attempted on the fatal error
+    saved = ckpt.load_labels(ck)
+    assert saved is not None and saved[1] == 2  # last good superstep
+    res = run_pipeline(_cfg(checkpoint_dir=ck, resume=True))
+    np.testing.assert_array_equal(res.labels, _baseline_labels())
+    resume = res.metrics.of_phase("resume")
+    assert resume and resume[0]["iteration"] == 2
+
+
+def test_hung_superstep_checkpoints_then_aborts(tmp_path):
+    """Watchdog contract: a hung superstep trips the timeout, the LAST
+    GOOD labels are checkpointed before SuperstepTimeout surfaces, and a
+    resumed run completes identically."""
+    from graphmine_tpu.pipeline.driver import run_pipeline
+
+    ck = str(tmp_path / "ck")
+    inj = faults.FaultInjector()
+    inj.add("lpa_superstep", faults.hang(3.0), at=2)
+    cfg = _cfg(
+        checkpoint_dir=ck, checkpoint_every=10,  # only the watchdog saves
+        resilience=ResilienceConfig(
+            backoff_base_s=0.001, superstep_timeout_s=0.3
+        ),
+    )
+    with inj.installed():
+        with pytest.raises(SuperstepTimeout):
+            run_pipeline(cfg)
+    saved = ckpt.load_labels(ck)
+    assert saved is not None and saved[1] == 1  # superstep before the hang
+    res = run_pipeline(_cfg(checkpoint_dir=ck, resume=True))
+    np.testing.assert_array_equal(res.labels, _baseline_labels())
+    assert res.metrics.of_phase("resume")
+
+
+def test_fingerprint_mismatch_refuses_resume(tmp_path):
+    """Satellite: resuming against a permuted or reweighted edge set must
+    refuse with an actionable error, never silently relabel."""
+    from graphmine_tpu.pipeline.driver import run_pipeline
+
+    ck = str(tmp_path / "ck")
+    run_pipeline(_cfg(checkpoint_dir=ck, max_iter=2))
+
+    # permuted edge order => different id assignment => refuse
+    lines = open(_edgelist_path()).readlines()
+    permuted = tmp_path / "permuted.txt"
+    permuted.write_text("".join(reversed(lines)))
+    with pytest.raises(ckpt.FingerprintMismatch, match="different graph"):
+        run_pipeline(_cfg(
+            data_path=str(permuted), checkpoint_dir=ck, resume=True,
+        ))
+
+    # same topology, reweighted => different trajectory => refuse
+    weighted = tmp_path / "weighted.txt"
+    weighted.write_text("".join(
+        f"{ln.rstrip()} {1.0 + i % 3}\n" for i, ln in enumerate(lines)
+    ))
+    with pytest.raises(ckpt.FingerprintMismatch):
+        run_pipeline(_cfg(
+            data_path=str(weighted), edge_weight_col=2,
+            checkpoint_dir=ck, resume=True,
+        ))
+
+
+# ---------------------------------------------------------------------------
+# ingestion quarantine
+# ---------------------------------------------------------------------------
+
+
+def test_quarantine_bad_rows_and_nan_weights(tmp_path):
+    """Malformed rows and non-finite weights are counted and set aside;
+    the run completes and the counts surface as a quarantine record."""
+    from graphmine_tpu.pipeline.driver import run_pipeline
+
+    p = tmp_path / "dirty.txt"
+    p.write_text(
+        "a b 1.0\n"
+        "b c 2.0\n"
+        "c a 1.5\n"
+        "d\n"                # too few columns -> bad_rows
+        "e f not-a-float\n"  # unparseable weight -> bad_rows
+        "x y 4.0\n"
+        "y z nan\n"          # parseable but non-finite -> nan_weights
+        "z x inf\n"          # idem
+        "x z 2.0\n"
+    )
+    cfg = PipelineConfig(
+        data_path=str(p), data_format="edgelist", edge_weight_col=2,
+        outlier_method="none", num_devices=1, max_iter=3,
+    )
+    res = run_pipeline(cfg)
+    et = res.edge_table
+    assert et.quarantine == {"bad_rows": 2, "nan_weights": 2}
+    assert et.num_edges == 5  # 9 rows - 2 bad - 2 non-finite
+    q = res.metrics.of_phase("quarantine")
+    assert q and q[0]["bad_rows"] == 2 and q[0]["nan_weights"] == 2
+
+
+def test_mojibake_ids_stay_distinct(tmp_path):
+    """Invalid byte sequences in vertex ids must not coalesce distinct
+    vertices: 'a\\xff' and 'a\\xfe' decode to distinct ids under the
+    tolerant parser (errors='replace' would merge both into 'a\\ufffd')."""
+    from graphmine_tpu.io.edges import load_edge_list
+
+    p = tmp_path / "moji.txt"
+    p.write_bytes(b"a\xff b\nc\n" + b"a\xfe b\n")  # bad row forces tolerant
+    et = load_edge_list(str(p), quarantine=True)
+    assert et.quarantine == {"bad_rows": 1}
+    assert et.num_edges == 2
+    assert et.num_vertices == 3  # a\xff, b, a\xfe — NOT 2
+
+
+def test_metrics_out_writes_recovery_records(tmp_path):
+    """--metrics-out flushes every structured record (including recovery
+    events) as JSON lines for offline triage."""
+    import json
+
+    from graphmine_tpu.pipeline.driver import run_pipeline
+
+    out = str(tmp_path / "metrics.jsonl")
+    inj = faults.FaultInjector()
+    inj.add("lpa_superstep", faults.transient_error, at=2)
+    with inj.installed():
+        run_pipeline(_cfg(metrics_out=out))
+    recs = [json.loads(ln) for ln in open(out)]
+    phases = {r["phase"] for r in recs}
+    assert "retry" in phases and "lpa_iter" in phases and "counts" in phases
+
+    # a FAILED run still flushes: the triage data must survive the death
+    # it is meant to explain
+    out2 = str(tmp_path / "failed.jsonl")
+    inj2 = faults.FaultInjector()
+    inj2.add("lpa_superstep", faults.preemption, at=3)
+    with inj2.installed():
+        with pytest.raises(faults.SimulatedPreemption):
+            run_pipeline(_cfg(metrics_out=out2))
+    recs2 = [json.loads(ln) for ln in open(out2)]
+    assert {r["phase"] for r in recs2} >= {"counts", "lpa_iter"}
+
+
+def test_quarantine_preserves_clean_fast_path(tmp_path):
+    """A well-formed file through quarantine mode ingests identically to
+    strict mode (same ids, same edges) and records zero bad rows."""
+    from graphmine_tpu.io.edges import load_edge_list
+
+    p = tmp_path / "clean.txt"
+    p.write_text("a b\nb c\nc a\n")
+    strict = load_edge_list(str(p))
+    tolerant = load_edge_list(str(p), quarantine=True)
+    np.testing.assert_array_equal(strict.src, tolerant.src)
+    np.testing.assert_array_equal(strict.dst, tolerant.dst)
+    assert tolerant.quarantine == {"bad_rows": 0}
+
+
+def test_quarantine_does_not_mask_misconfiguration(tmp_path):
+    """A mistyped weight_col on a CLEAN file would tolerantly quarantine
+    every row into an empty graph — that wholesale disagreement must
+    surface as the configuration error it is."""
+    from graphmine_tpu.io.edges import load_edge_list
+
+    p = tmp_path / "clean.txt"
+    p.write_text("a b\nb c\nc a\n")
+    with pytest.raises(ValueError, match="misconfiguration"):
+        load_edge_list(str(p), weight_col=5, quarantine=True)
+
+
+def test_quarantine_out_of_range_ids():
+    from graphmine_tpu.io.edges import from_arrays
+
+    et = from_arrays(
+        [0, 1, 2, -1, 5], [1, 2, 0, 0, 0],
+        names=["a", "b", "c"], quarantine=True,
+    )
+    assert et.quarantine == {"out_of_range_ids": 2}  # -1 src, 5 >= len(names)
+    assert et.num_edges == 3 and et.num_rows_raw == 5
+    # strict mode keeps historic behavior: no filtering, no accounting
+    et2 = from_arrays([0, 1], [1, 0])
+    assert et2.quarantine is None
+
+
+def test_quarantine_null_rows_parquet(tmp_path):
+    """Parquet rows with null domains are filtered AND counted (the
+    reference's :30 null filter, now with a structured record)."""
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    from graphmine_tpu.io.edges import load_parquet_edges
+    from graphmine_tpu.pipeline.driver import run_pipeline
+
+    table = pa.table({
+        "_c0": ["p"] * 6,
+        "_c1": ["a", "b", None, "c", "a", None],
+        "_c2": ["b", "c", "x", None, "b", None],
+        "_c3": ["q"] * 6,
+    })
+    p = str(tmp_path / "part.parquet")
+    pq.write_table(table, p)
+    et = load_parquet_edges(p)
+    assert et.quarantine == {"null_rows": 3}
+    assert et.num_edges == 3 and et.num_rows_raw == 6
+
+    res = run_pipeline(PipelineConfig(
+        data_path=p, outlier_method="none", num_devices=1, max_iter=2,
+    ))
+    q = res.metrics.of_phase("quarantine")
+    assert q and q[0]["null_rows"] == 3
+
+    # --no-quarantine-inputs: a strict-parsing run's metrics stream
+    # carries no quarantine records (the parity null filter still runs)
+    res_strict = run_pipeline(PipelineConfig(
+        data_path=p, outlier_method="none", num_devices=1, max_iter=2,
+        quarantine_inputs=False,
+    ))
+    assert not res_strict.metrics.of_phase("quarantine")
+    assert res_strict.edge_table.num_edges == 3
+
+    # streaming ingestion counts the same quarantine
+    et_s = load_parquet_edges(p, batch_rows=2)
+    assert et_s.quarantine == {"null_rows": 3}

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Tier-1 verification: the exact command pinned in ROADMAP.md.
+#
+# Runs the full CPU test suite (excluding @slow) with collection errors
+# surfaced instead of aborting the run, and prints the passed-dot count
+# the roadmap uses as its no-regression floor. The fault-injection suite
+# (-m faults, tests/test_resilience.py) is part of this default pass.
+#
+# Usage: tools/run_tier1.sh [extra pytest args...]
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+LOG="${TIER1_LOG:-/tmp/_t1.log}"
+rm -f "$LOG"
+timeout -k 10 "${TIER1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    "$@" 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+exit "$rc"
