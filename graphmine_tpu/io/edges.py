@@ -124,13 +124,24 @@ def _column_codes(col, interner):
     work in numpy; first-appearance id-assignment order is identical by
     construction (an Arrow dictionary's values are unique), pinned
     byte-exact by ``tests/test_io.py``.
+
+    Null safety (ADVICE r5): the loaders filter null rows BEFORE interning
+    (the Graphframes.py:30 parity filter), but this function is also a
+    standalone surface — nulls are dropped here too, so ``None`` can never
+    be interned as a vertex id (``to_numpy`` on a nullable column yields
+    Python ``None`` objects, which the per-row fallback would happily hash
+    into the vocabulary). Callers that need row alignment across columns
+    must still pre-filter; per-column dropping protects the id space, not
+    the pairing.
     """
     import pyarrow as pa
 
     chunks = col.chunks if isinstance(col, pa.ChunkedArray) else [col]
     parts = []
     for c in chunks:
-        if pa.types.is_dictionary(c.type) and not c.null_count:
+        if c.null_count:
+            c = c.drop_null()
+        if pa.types.is_dictionary(c.type):
             parts.append(interner.add_dictionary(
                 np.asarray(c.indices),
                 c.dictionary.to_numpy(zero_copy_only=False),
@@ -178,7 +189,13 @@ def load_parquet_edges(path: str, batch_rows: int | None = None) -> EdgeTable:
                       read_dictionary=["_c1", "_c2"])
         for p in paths
     ]
-    table = pa.concat_tables(tables, promote_options="permissive")
+    try:
+        table = pa.concat_tables(tables, promote_options="permissive")
+    except TypeError:
+        # pyarrow < 14 has no promote_options; promote=True is the same
+        # permissive schema unification there (ADVICE r5: don't fail a
+        # previously-working path on older environments)
+        table = pa.concat_tables(tables, promote=True)
     num_rows_raw = table.num_rows
     valid = pc.and_(pc.is_valid(table.column("_c1")), pc.is_valid(table.column("_c2")))
     table = table.filter(valid)  # Graphframes.py:30 null-domain filter

@@ -130,6 +130,26 @@ def _search_clusters(q_vec, q_gid, m_vec, m_gid, m_valid, k: int):
     return -neg, m_gid[j]
 
 
+def _exact_fallback(pts, k, guard: str, detail: str, sink):
+    """The honest exit when an IVF pathology guard trips: run the exact
+    path — but LOUDLY (ADVICE r5). The silent version cost a round of
+    bench triage: 'ivf' timings that were secretly exact-path timings.
+    ``guard`` names which guard fired; the warning + ``ivf_fallback``
+    metrics record carry it."""
+    import warnings
+
+    from graphmine_tpu.ops.knn import knn as exact_knn
+
+    warnings.warn(
+        f"ivf_knn guard {guard!r} tripped ({detail}); falling back to the "
+        "exact kNN path",
+        stacklevel=3,
+    )
+    if sink is not None:
+        sink.emit("ivf_fallback", guard=guard, detail=detail)
+    return exact_knn(pts, k, impl="auto")
+
+
 def ivf_knn(
     points,
     k: int,
@@ -137,6 +157,7 @@ def ivf_knn(
     n_probe: int = 16,
     seed: int = 0,
     kmeans_iters: int = 5,
+    sink=None,
 ):
     """Approximate k nearest neighbors (IVF-flat). ``(d2, idx)`` like
     :func:`~graphmine_tpu.ops.knn.knn`: ``[N, k]`` ascending squared
@@ -148,7 +169,11 @@ def ivf_knn(
     6–13% candidate fraction on Gaussian clouds; the bench lof tier
     records recall on its real feature cloud). Falls back to the exact
     path when the cloud is too small for the machinery to pay
-    (``N < 4 * n_clusters`` or ``k >= Lmax`` after clustering).
+    (``N < 4 * n_clusters`` or ``k >= Lmax`` after clustering); pathology
+    guards (capacity / probe skew / chunk-index bound) also fall back,
+    each with a ``warnings.warn`` and — when ``sink`` (a
+    :class:`~graphmine_tpu.pipeline.metrics.MetricsSink`) is given — an
+    ``ivf_fallback`` record naming the guard (ADVICE r5).
     """
     pts = np.asarray(points, np.float32)
     n, f = pts.shape
@@ -160,6 +185,8 @@ def ivf_knn(
     from graphmine_tpu.ops.knn import knn as exact_knn
 
     if n < 4 * n_clusters:
+        # documented sizing fallback, not a pathology guard: tiny clouds
+        # route to the exact path by design, no warning
         return exact_knn(pts, k, impl="auto")
 
     centers = kmeans(pts, n_clusters, iters=kmeans_iters, seed=seed)
@@ -196,7 +223,10 @@ def ivf_knn(
     if k >= sizes.max():
         # no cluster can fill its own top-k; recall craters — the honest
         # move is the exact path.
-        return exact_knn(pts, k, impl="auto")
+        return _exact_fallback(
+            pts, k, "k_unfillable",
+            f"k={k} >= largest cluster size {int(sizes.max())}", sink,
+        )
     # member id matrix [n_sub, Lmax] (clamps keep empty sublists
     # in-bounds; their rows are fully masked)
     j = np.arange(l_max)
@@ -229,8 +259,19 @@ def ivf_knn(
     #    the same blowup class the sublist cap fixed on the member
     #    side. IVF has nothing to exploit on such a cloud anyway.
     probed_sizes = sizes[probe].sum(axis=1)       # members across probes
-    if int(probed_sizes.min()) < k + 1 or p_max > 4 * n_probe:
-        return exact_knn(pts, k, impl="auto")
+    if int(probed_sizes.min()) < k + 1:
+        return _exact_fallback(
+            pts, k, "capacity",
+            f"a query's probed clusters hold {int(probed_sizes.min())} "
+            f"members < k+1={k + 1} (its top-k cannot fill)", sink,
+        )
+    if p_max > 4 * n_probe:
+        return _exact_fallback(
+            pts, k, "skew",
+            f"probe expansion {p_max} sublists/query > 4*n_probe="
+            f"{4 * n_probe} (one dominant cluster; IVF has no structure "
+            "to exploit)", sink,
+        )
     pair_q = np.repeat(
         np.arange(n, dtype=np.int64), pairs_per_q
     )
@@ -253,6 +294,17 @@ def ivf_knn(
     np.cumsum(q_counts[:-1], out=q_starts[1:])
     chunks_per_s = -(-q_counts // chunk_b)       # ceil; 0 for unprobed
     r_rows = int(chunks_per_s.sum())
+    # Loud int32 bound (ADVICE r5): the merge-gather take table indexes
+    # the flat [r_rows * chunk_b + 1] result rows, and jnp.asarray would
+    # SILENTLY downcast an int64 host table to int32 on device — a row id
+    # past 2^31-1 would wrap to a junk gather instead of failing. The
+    # junk-row sentinel id r_rows * chunk_b is the largest value stored.
+    if r_rows * chunk_b >= (1 << 31):
+        return _exact_fallback(
+            pts, k, "index_bound",
+            f"merge-gather row ids reach {r_rows * chunk_b:,} >= 2^31 "
+            "(int32 device gather would wrap)", sink,
+        )
     row_sub = np.repeat(np.arange(n_sub), chunks_per_s)
     chunk_rank = (
         np.arange(r_rows) - np.repeat(
@@ -317,8 +369,11 @@ def ivf_knn(
         - np.repeat(np.cumsum(pairs_per_q) - pairs_per_q, pairs_per_q)
     )
     take[pair_q, pair_col] = slot_of_pair
+    # Explicit int32, not an implicit jnp downcast: the bound above
+    # guarantees every row id (junk sentinel included) fits, and the cast
+    # states the invariant instead of relying on x64-mode defaults.
     take_dev = jnp.asarray(
-        take.reshape(n_pad // merge_t, merge_t, p_max)
+        take.astype(np.int32).reshape(n_pad // merge_t, merge_t, p_max)
     )
 
     # NB: the flat result arrays are jit ARGUMENTS, not closure captures

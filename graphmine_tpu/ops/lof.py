@@ -23,7 +23,8 @@ from graphmine_tpu.ops.knn import knn
 
 
 def lof_scores(
-    points: jax.Array, k: int = 20, row_tile: int = 1024, impl: str = "auto"
+    points: jax.Array, k: int = 20, row_tile: int = 1024, impl: str = "auto",
+    sink=None,
 ) -> jax.Array:
     """LOF score per point, shape ``[N]`` (higher = more outlying).
 
@@ -49,11 +50,15 @@ def lof_scores(
     (This wrapper is NOT jitted: the IVF path is host-orchestrated —
     inverted-list construction needs concrete points; the exact paths
     and :func:`lof_from_knn` are jitted internally as before.)
+
+    ``sink``: optional MetricsSink forwarded to :func:`ivf_knn` so its
+    pathology-guard fallbacks to the exact path surface as
+    ``ivf_fallback`` records (ADVICE r5) — ignored by the exact impls.
     """
     if impl == "ivf":
         from graphmine_tpu.ops.ann import ivf_knn
 
-        d2, idx = ivf_knn(points, k=k)
+        d2, idx = ivf_knn(points, k=k, sink=sink)
     else:
         d2, idx = knn(points, k=k, row_tile=row_tile, impl=impl)
     return _lof_from_knn_jit(d2, idx, k)

@@ -41,6 +41,28 @@ def make_mesh(num_devices: int | None = None, devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (VERTEX_AXIS,))
 
 
+def surviving_mesh(num_devices: int, exclude=(), devices=None) -> Mesh:
+    """A 1-D vertex-axis mesh over the devices that survived a loss.
+
+    ``exclude``: indices (into ``jax.devices()`` order) of dead/suspect
+    devices to route around — the elastic-degradation path
+    (docs/RESILIENCE.md "Elastic mesh degradation") rebuilds its rung
+    meshes through this so a chip that the runtime still *lists* but that
+    just failed a collective is never re-enrolled. Takes the first
+    ``num_devices`` survivors; raises when fewer remain.
+    """
+    if devices is None:
+        devices = jax.devices()
+    exclude = set(exclude)
+    alive = [d for i, d in enumerate(devices) if i not in exclude]
+    if num_devices > len(alive):
+        raise ValueError(
+            f"requested {num_devices} devices, only {len(alive)} survive "
+            f"({len(exclude)} excluded of {len(devices)} visible)"
+        )
+    return Mesh(np.asarray(alive[:num_devices]), (VERTEX_AXIS,))
+
+
 def make_multislice_mesh(
     num_slices: int, chips_per_slice: int | None = None, devices=None
 ) -> Mesh:
@@ -85,8 +107,17 @@ def initialize_distributed(**kw) -> bool:
     """
     import jax
 
-    if jax.distributed.is_initialized():
-        return True
+    # jax.distributed.is_initialized is missing on some pinned releases
+    # (0.4.x): probe it, falling back to the runtime's global client
+    # state, so this entry point works on every jax this repo supports.
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        if probe():
+            return True
+    else:
+        state = getattr(jax.distributed, "global_state", None)
+        if state is not None and getattr(state, "client", None) is not None:
+            return True
     try:
         jax.distributed.initialize(**kw)
     except ValueError as e:

@@ -39,6 +39,125 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from graphmine_tpu.graph.container import Graph, build_graph
 from graphmine_tpu.ops.segment import segment_mode
+from graphmine_tpu.pipeline.resilience import DivergenceError
+
+
+# ---- in-loop divergence tripwires -----------------------------------------
+# Cheap on-device guards inside the superstep loops (ISSUE 2): NaN/Inf
+# ranks, labels outside the padded vertex-id range, period-2 oscillation,
+# CC monotonicity violations. The guards are pure device reductions over
+# the replicated/sharded iterate; every K supersteps a host callback
+# records the FIRST firing (kind, offending shard, superstep), and the
+# non-jitted public wrappers raise a classified
+# :class:`~graphmine_tpu.pipeline.resilience.DivergenceError` (retryable —
+# the canonical cause is transient device corruption) instead of returning
+# silently-garbage labels. Armed only when ``tripwire_every > 0``: the
+# unarmed programs are byte-identical to the pre-tripwire ones.
+
+_TRIP_KINDS = (
+    "none", "label_out_of_range", "oscillation", "nonfinite_ranks",
+    "cc_nonmonotone",
+)
+_TRIP: list = []
+# One owner at a time for the trip buffer: the recorder callback's
+# identity is baked into the compiled program at trace time (a per-call
+# closure would defeat the jit cache and retrace every invocation), so
+# the buffer is process-global — and concurrent ARMED calls from
+# different threads could steal or erase each other's trips. Armed calls
+# serialize on this lock; unarmed calls never touch it.
+import threading as _threading
+
+_TRIP_LOCK = _threading.Lock()
+
+
+def _run_armed(thunk):
+    """Run an armed (tripwire_every > 0) computation with exclusive
+    ownership of the trip buffer, clearing stale state first and raising
+    the recorded DivergenceError after the flush."""
+    with _TRIP_LOCK:
+        _TRIP.clear()
+        return _raise_if_tripped(thunk())
+
+
+def _record_trip(kind_code, shard, iteration):
+    """Host side of the tripwire callback; keeps only the first event
+    (later supersteps of an already-poisoned iterate add no forensics)."""
+    if not _TRIP:
+        _TRIP.append((int(kind_code), int(shard), int(iteration)))
+
+
+def _fire_trip(fire, kind, shard, iteration):
+    """Invoke the host recorder only when a guard actually fired — the
+    clean path pays the reduction, never the callback."""
+    lax.cond(
+        fire,
+        lambda args: jax.debug.callback(_record_trip, *args),
+        lambda args: None,
+        (kind, shard, iteration),
+    )
+
+
+def _raise_if_tripped(outputs):
+    """Block on ``outputs``, flush pending callback effects, then surface
+    the recorded trip as a DivergenceError. block_until_ready alone only
+    waits for the OUTPUT buffers — under async dispatch a debug callback
+    can still be queued on the callback thread when they land, and an
+    unflushed exit-check firing would let corrupted labels escape."""
+    jax.block_until_ready(outputs)
+    barrier = getattr(jax, "effects_barrier", None)
+    if barrier is not None:
+        barrier()
+    if _TRIP:
+        code, shard, it = _TRIP[0]
+        _TRIP.clear()
+        raise DivergenceError(_TRIP_KINDS[code], shard, it)
+    return outputs
+
+
+def _label_tripwire(new, cur, prev, it, chunk_size, every):
+    """LPA guards: label-out-of-range (a wrapped gather index / corrupted
+    collective puts ids outside [0, v_pad)) and period-2 oscillation
+    (state t+1 == state t-1 while != state t — synchronous LPA's known
+    livelock; bounded max_iter hides it as a silently-wrong answer)."""
+    v_pad = new.shape[0]
+    bad = (new < 0) | (new >= v_pad)
+    oob = jnp.any(bad)
+    osc = jnp.all(new == prev) & jnp.any(new != cur)
+    kind = jnp.where(oob, 1, jnp.where(osc, 2, 0))
+    shard = (jnp.argmax(bad).astype(jnp.int32) // chunk_size)
+    fire = (kind > 0) & (((it + 1) % every) == 0)
+    _fire_trip(fire, kind, shard, it + 1)
+
+
+def _cc_tripwire(new, cur, it, chunk_size, every):
+    """CC guards: label range plus monotonicity — min-propagation labels
+    can only decrease; any increase means corrupted state."""
+    v_pad = new.shape[0]
+    bad = (new < 0) | (new >= v_pad)
+    mono = new > cur
+    kind = jnp.where(jnp.any(bad), 1, jnp.where(jnp.any(mono), 4, 0))
+    # Attribute the shard by the REPORTED kind: with simultaneous range
+    # and monotonicity violations in different shards, blaming a
+    # monotonicity-only shard for an out-of-range label would send
+    # device forensics to the wrong chip.
+    mask = jnp.where(jnp.any(bad), bad, mono)
+    shard = (jnp.argmax(mask).astype(jnp.int32) // chunk_size)
+    fire = (kind > 0) & (((it + 1) % every) == 0)
+    _fire_trip(fire, kind, shard, it + 1)
+
+
+def _rank_tripwire(new, it, chunk_size, every):
+    """PageRank guard: NaN/Inf anywhere in the rank vector. NaN is
+    absorbing through the power iteration AND satisfies no convergence
+    test (delta > tol is False for NaN), so an unguarded loop exits
+    'converged' with garbage."""
+    bad = ~jnp.isfinite(new)
+    kind = jnp.where(jnp.any(bad), 3, 0)
+    shard = (jnp.argmax(bad).astype(jnp.int32) // chunk_size)
+    fire = (kind > 0) & (((it + 1) % every) == 0)
+    _fire_trip(fire, kind, shard, it + 1)
+
+
 def _vertex_axes(mesh):
     """The mesh axes the vertex dimension is sharded over.
 
@@ -405,20 +524,49 @@ def _padded_init_labels(sg: ShardedGraph) -> jax.Array:
     return jnp.arange(v_pad, dtype=jnp.int32)
 
 
-def _scan_supersteps(step_fn, labels: jax.Array, max_iter: int) -> jax.Array:
-    """Fixed-count superstep driver (LPA semantics: exactly max_iter)."""
+def _scan_supersteps(
+    step_fn, labels: jax.Array, max_iter: int,
+    tripwire_every: int = 0, chunk_size: int = 0,
+) -> jax.Array:
+    """Fixed-count superstep driver (LPA semantics: exactly max_iter).
+    ``tripwire_every > 0`` arms the label tripwires every K supersteps
+    (the carry then also holds the previous iterate for the oscillation
+    guard); 0 keeps the original lean program."""
+    if not tripwire_every:
 
-    def step(labels, _):
-        return step_fn(labels), None
+        def step(labels, _):
+            return step_fn(labels), None
 
-    labels, _ = lax.scan(step, labels, None, length=max_iter)
+        labels, _ = lax.scan(step, labels, None, length=max_iter)
+        return labels
+
+    def step(carry, it):
+        cur, prev = carry
+        new = step_fn(cur)
+        _label_tripwire(new, cur, prev, it, chunk_size, tripwire_every)
+        return (new, cur), None
+
+    (labels, prev), _ = lax.scan(
+        step, (labels, labels), jnp.arange(max_iter, dtype=jnp.int32)
+    )
+    # Unconditional exit check (every=1): when max_iter is not a multiple
+    # of K the last supersteps run unchecked, and garbage must never
+    # leave the loop silently.
+    _label_tripwire(
+        labels, prev, prev, jnp.int32(max_iter - 1), chunk_size, 1
+    )
     return labels
 
 
-def _fixpoint_supersteps(step_fn, sg: ShardedGraph, max_iter: int) -> jax.Array:
+def _fixpoint_supersteps(
+    step_fn, sg: ShardedGraph, max_iter: int, tripwire_every: int = 0,
+    init_labels=None,
+) -> jax.Array:
     """Run supersteps until no label changes (CC semantics), bounded by
     ``max_iter`` when nonzero. Shared by the replicated-label and ring
-    schedules so the convergence logic has one home."""
+    schedules so the convergence logic has one home. ``tripwire_every``
+    arms the CC tripwires (range + monotonicity) every K supersteps;
+    ``init_labels`` resumes a checkpointed run mid-fixpoint."""
     limit = max_iter if max_iter > 0 else sg.num_vertices + 2
 
     def cond(state):
@@ -428,22 +576,52 @@ def _fixpoint_supersteps(step_fn, sg: ShardedGraph, max_iter: int) -> jax.Array:
     def loop_body(state):
         labels, _, it = state
         new = step_fn(labels)
+        if tripwire_every:
+            _cc_tripwire(new, labels, it, sg.chunk_size, tripwire_every)
         changed = jnp.sum(new != labels, dtype=jnp.int32)
         return new, changed, it + 1
 
-    labels0 = _padded_init_labels(sg)
-    labels, _, _ = lax.while_loop(cond, loop_body, (labels0, jnp.int32(1), jnp.int32(0)))
+    labels0 = (
+        _padded_init_labels(sg) if init_labels is None
+        else _pad_labels(init_labels, sg)
+    )
+    labels, _, it_end = lax.while_loop(
+        cond, loop_body, (labels0, jnp.int32(1), jnp.int32(0))
+    )
+    if tripwire_every:
+        # Exit check (every=1): a poisoned-but-stable state ends the
+        # fixpoint loop between two K-aligned checks; garbage must never
+        # leave the loop silently. Monotonicity needs history, so only
+        # the range guard applies here (cur=new disables it).
+        _cc_tripwire(labels, labels, it_end - 1, sg.chunk_size, 1)
     return labels[: sg.num_vertices]
 
 
-@partial(jax.jit, static_argnames=("max_iter", "mesh"))
 def sharded_label_propagation(
-    sg: ShardedGraph, mesh, max_iter: int = 5, init_labels: jax.Array | None = None
+    sg: ShardedGraph, mesh, max_iter: int = 5,
+    init_labels: jax.Array | None = None, tripwire_every: int = 0,
 ) -> jax.Array:
     """Distributed synchronous LPA; semantics identical to
     :func:`graphmine_tpu.ops.lpa.label_propagation` (asserted by the
     virtual-device parity tests). Returns int32 labels ``[V]``.
+
+    ``tripwire_every``: arm the in-loop divergence tripwires
+    (label-out-of-range, period-2 oscillation) every K supersteps — a
+    firing raises :class:`~graphmine_tpu.pipeline.resilience.DivergenceError`
+    (retryable, with the offending shard index) instead of returning
+    garbage labels. 0 (default) = off, the exact pre-tripwire program.
     """
+    if not tripwire_every:
+        return _sharded_lpa_jit(sg, mesh, max_iter, init_labels, 0)
+    return _run_armed(
+        lambda: _sharded_lpa_jit(sg, mesh, max_iter, init_labels, tripwire_every)
+    )
+
+
+@partial(jax.jit, static_argnames=("max_iter", "mesh", "tripwire_every"))
+def _sharded_lpa_jit(
+    sg: ShardedGraph, mesh, max_iter: int, init_labels, tripwire_every: int
+) -> jax.Array:
     _check_mesh(sg, mesh)
     axes = _vertex_axes(mesh)
     rep = P()
@@ -481,14 +659,36 @@ def sharded_label_propagation(
             l, sg.msg_recv_local, sg.msg_send, sg.degrees, sg.msg_weight
         )
     labels = _padded_init_labels(sg) if init_labels is None else _pad_labels(init_labels, sg)
-    labels = _scan_supersteps(step, labels, max_iter)
+    labels = _scan_supersteps(
+        step, labels, max_iter,
+        tripwire_every=tripwire_every, chunk_size=sg.chunk_size,
+    )
     return labels[: sg.num_vertices]
 
 
-@partial(jax.jit, static_argnames=("max_iter", "mesh"))
-def sharded_connected_components(sg: ShardedGraph, mesh, max_iter: int = 0) -> jax.Array:
+def sharded_connected_components(
+    sg: ShardedGraph, mesh, max_iter: int = 0, tripwire_every: int = 0,
+    init_labels: jax.Array | None = None,
+) -> jax.Array:
     """Distributed weakly-connected components (min-propagation + pointer
-    jumping); parity with :func:`graphmine_tpu.ops.cc.connected_components`."""
+    jumping); parity with :func:`graphmine_tpu.ops.cc.connected_components`.
+    ``tripwire_every``: arm the CC divergence tripwires (label range +
+    min-monotonicity) every K supersteps; see
+    :func:`sharded_label_propagation`. ``init_labels``: resume a
+    checkpointed fixpoint mid-run (min-propagation is monotone, so a
+    resumed trajectory converges to the identical fixpoint)."""
+    if not tripwire_every:
+        return _sharded_cc_jit(sg, mesh, max_iter, 0, init_labels)
+    return _run_armed(
+        lambda: _sharded_cc_jit(sg, mesh, max_iter, tripwire_every, init_labels)
+    )
+
+
+@partial(jax.jit, static_argnames=("max_iter", "mesh", "tripwire_every"))
+def _sharded_cc_jit(
+    sg: ShardedGraph, mesh, max_iter: int, tripwire_every: int,
+    init_labels=None,
+) -> jax.Array:
     _check_mesh(sg, mesh)
     in_specs, rep = _shard_specs(mesh)
     body = shard_map(
@@ -499,7 +699,8 @@ def sharded_connected_components(sg: ShardedGraph, mesh, max_iter: int = 0) -> j
         check_vma=False,
     )
     return _fixpoint_supersteps(
-        lambda l: body(l, sg.msg_recv_local, sg.msg_send, sg.degrees), sg, max_iter
+        lambda l: body(l, sg.msg_recv_local, sg.msg_send, sg.degrees), sg,
+        max_iter, tripwire_every=tripwire_every, init_labels=init_labels,
     )
 
 
@@ -575,7 +776,6 @@ def _pagerank_shard_body(state, recv_local, send, deg, weight=None, *,
     return lax.all_gather(new_own, axes, tiled=True)
 
 
-@partial(jax.jit, static_argnames=("max_iter", "mesh", "weighted"))
 def sharded_pagerank(
     sg: ShardedGraph,
     mesh,
@@ -584,6 +784,8 @@ def sharded_pagerank(
     max_iter: int = 100,
     tol: float = 1e-6,
     weighted: bool | None = None,
+    tripwire_every: int = 0,
+    init_ranks: jax.Array | None = None,
 ) -> jax.Array:
     """Distributed PageRank over the vertex-range-sharded message CSR.
 
@@ -600,8 +802,30 @@ def sharded_pagerank(
     pass ``weighted=False`` for unweighted ranks on a weighted graph.
     Parity with :func:`graphmine_tpu.ops.pagerank.pagerank` is asserted
     by the virtual-device tests. Returns float32 ranks ``[V]`` summing
-    to 1.
+    to 1. ``tripwire_every``: arm the NaN/Inf rank tripwire every K
+    power iterations (a NaN rank satisfies no convergence test —
+    ``delta > tol`` is False for NaN — so an unguarded loop exits
+    'converged' with garbage); see :func:`sharded_label_propagation`.
+    ``init_ranks``: resume a checkpointed power iteration mid-run (the
+    iteration is a fixed-point map, so a resumed trajectory matches the
+    uninterrupted one).
     """
+    if not tripwire_every:
+        return _sharded_pagerank_jit(
+            sg, mesh, out_degrees, alpha, max_iter, tol, weighted, 0,
+            init_ranks,
+        )
+    return _run_armed(lambda: _sharded_pagerank_jit(
+        sg, mesh, out_degrees, alpha, max_iter, tol, weighted,
+        tripwire_every, init_ranks,
+    ))
+
+
+@partial(jax.jit, static_argnames=("max_iter", "mesh", "weighted", "tripwire_every"))
+def _sharded_pagerank_jit(
+    sg: ShardedGraph, mesh, out_degrees, alpha, max_iter: int, tol,
+    weighted: bool | None, tripwire_every: int, init_ranks=None,
+) -> jax.Array:
     _check_mesh(sg, mesh)
     weighted = _check_pagerank_weighted(sg, out_degrees, weighted)
     inv_out, reset, dangling = _pagerank_terms(
@@ -635,8 +859,26 @@ def sharded_pagerank(
             (pr, inv_out, reset, dangling), sg.msg_recv_local, sg.msg_send,
             sg.degrees, *args,
         )
+        if tripwire_every:
+            _rank_tripwire(new, it, sg.chunk_size, tripwire_every)
         delta = jnp.abs(new - pr).sum()
         return new, delta, it + 1
 
-    pr, _, _ = lax.while_loop(cond, step, (reset, jnp.float32(1.0), jnp.int32(0)))
+    if init_ranks is None:
+        pr0 = reset
+    else:
+        # zero-pad: padded vertices carry exactly 0 rank in every
+        # uninterrupted iteration (reset/inv_out/dangling are all 0
+        # there), so a zero-padded resume matches it bit-for-bit
+        pr0 = jnp.zeros((sg.padded_vertices,), jnp.float32).at[
+            : sg.num_vertices
+        ].set(init_ranks.astype(jnp.float32))
+    pr, _, it_end = lax.while_loop(
+        cond, step, (pr0, jnp.float32(1.0), jnp.int32(0))
+    )
+    if tripwire_every:
+        # Exit check (every=1): a NaN delta FAILS `delta > tol` and ends
+        # the loop immediately — often before the K-th iteration check —
+        # so the final ranks are always re-guarded before they escape.
+        _rank_tripwire(pr, it_end - 1, sg.chunk_size, 1)
     return pr[: sg.num_vertices]

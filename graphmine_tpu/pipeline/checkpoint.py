@@ -2,15 +2,20 @@
 
 The reference's closest artifact is ``persist()`` (``Graphframes.py:82``) —
 in-memory caching only. Here the label state + iteration counter are saved
-so billion-edge LPA runs can resume (SURVEY §5 checkpoint/resume). The
-state is one int32 array + a counter; np.savez is the efficient, dependency-
-free representation (orbax would add sharded async saves for multi-host —
-noted as the upgrade path).
+so billion-edge LPA runs can resume (SURVEY §5 checkpoint/resume). Two
+formats, both dependency-free:
+
+- ``save_labels`` / ``load_labels``: one atomic npz (single-device runs);
+- ``save_sharded`` / ``load_sharded``: a manifest of per-shard files with
+  per-shard sha256 (distributed runs) — Pregel-style confined-recovery
+  checkpointing (Malewicz et al. SIGMOD'10), able to RE-SHARD ON RESTORE
+  so a checkpoint taken on D devices resumes on D' != D after a chip loss.
 """
 
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import zipfile
 import zlib
@@ -178,6 +183,65 @@ def _read_verified_confirmed(path: str, fingerprint: str | None):
             raise first
 
 
+def _load_with_rollback(path, prev, read_confirmed, sink, what, delete_hint):
+    """The generation-rollback state machine shared by BOTH formats
+    (npz files and sharded manifest directories — ``os.path.exists`` /
+    ``os.replace`` cover either): verify current; on corruption roll
+    back to ``prev``, promote it to the current slot so the next save's
+    rotation cannot demote the corrupt generation into the prev slot,
+    and set the condemned generation aside at a ``.corrupt`` name no
+    later incident overwrites (even after the confirming re-read, a
+    condemned NEWER checkpoint is evidence the operator may still want).
+    ``checkpoint_rollback`` is emitted only once a previous generation
+    exists to roll back TO — an unrecoverable corruption must not read
+    as a rollback in the metrics stream. FingerprintMismatch propagates
+    untouched (rolling back cannot fix a wrong-graph checkpoint)."""
+    if not os.path.exists(path) and not os.path.exists(prev):
+        return None
+    try:
+        if not os.path.exists(path):
+            raise CheckpointCorruptionError(
+                f"{what} at {path} is missing (previous generation "
+                f"exists at {prev})"
+            )
+        return read_confirmed(path)
+    except FingerprintMismatch:
+        raise
+    except _CORRUPTION_ERRORS as e:
+        primary_error = e
+    if not os.path.exists(prev):
+        raise CheckpointCorruptionError(
+            f"{what} at {path} is corrupt ({primary_error!r}) and no "
+            f"previous generation exists; {delete_hint}"
+        ) from primary_error
+    if sink is not None:
+        sink.emit(
+            "checkpoint_rollback", path=path, error=repr(primary_error),
+        )
+    try:
+        labels, iteration = read_confirmed(prev)
+    except FingerprintMismatch:
+        raise
+    except _CORRUPTION_ERRORS as e2:
+        raise CheckpointCorruptionError(
+            f"both {what} generations are corrupt: {path} "
+            f"({primary_error!r}) and {prev} ({e2!r}); {delete_hint}"
+        ) from e2
+    if os.path.exists(path):
+        condemned = path + ".corrupt"
+        n = 1
+        while os.path.exists(condemned):
+            condemned = f"{path}.corrupt.{n}"
+            n += 1
+        os.replace(path, condemned)
+    os.replace(prev, path)
+    if sink is not None:
+        sink.emit(
+            "checkpoint_rollback_ok", path=path, iteration=iteration,
+        )
+    return labels, iteration
+
+
 def load_labels(
     checkpoint_dir: str, tag: str = "lpa", fingerprint: str | None = None,
     sink=None,
@@ -200,120 +264,372 @@ def load_labels(
     vertex (raises :class:`FingerprintMismatch` instead).
     """
     path = os.path.join(checkpoint_dir, f"{tag}_labels.npz")
-    prev = _prev_path(path)
-    if not os.path.exists(path) and not os.path.exists(prev):
-        return None
+    return _load_with_rollback(
+        path, _prev_path(path),
+        lambda p: _read_verified_confirmed(p, fingerprint),
+        sink, "checkpoint",
+        f"delete {checkpoint_dir!r} to restart from scratch",
+    )
+
+
+# ---- shard-aware manifest checkpoints -------------------------------------
+# The distributed twin of save_labels/load_labels (ISSUE 2): per-shard .npy
+# files written atomic+fsync, a JSON manifest carrying the graph
+# fingerprint, mesh shape, iteration and per-shard sha256, two rotated
+# generations with the same rollback/forensic-preserve semantics as the
+# npz path — and RE-SHARD ON RESTORE: the loader returns the full label
+# vector, so a checkpoint taken on D devices resumes on D' != D (the
+# elastic path after losing a chip; the caller re-partitions the graph
+# onto the surviving mesh and passes the labels as init_labels). Per-shard
+# files are also the multi-host upgrade path: each host can write only the
+# shards it owns (orbax-style) without gathering the vector to one host.
+
+MANIFEST_NAME = "manifest.json"
+_MANIFEST_VERSION = 1
+
+
+def sharded_dir(checkpoint_dir: str, tag: str = "lpa") -> str:
+    """Current-generation directory of a sharded manifest checkpoint."""
+    return os.path.join(checkpoint_dir, f"{tag}_sharded")
+
+
+def shard_file(gen_dir: str, shard: int) -> str:
+    return os.path.join(gen_dir, f"shard_{shard:05d}.npy")
+
+
+def _sharded_prev_dir(gen_dir: str) -> str:
+    return gen_dir + ".prev"
+
+
+def _fsync_file(path: str) -> None:
+    with open(path, "rb+") as f:
+        os.fsync(f.fileno())
+
+
+def _fsync_dir(path: str) -> None:
+    dirfd = os.open(path, os.O_RDONLY)
     try:
-        if not os.path.exists(path):
+        os.fsync(dirfd)
+    finally:
+        os.close(dirfd)
+
+
+def _file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _manifest_checksum(body: dict) -> str:
+    """Content hash of the manifest payload (everything but the checksum
+    field itself) — a bit flip that still parses as JSON must not pass."""
+    canon = json.dumps(
+        {k: v for k, v in sorted(body.items()) if k != "checksum"},
+        sort_keys=True,
+    )
+    return hashlib.sha256(canon.encode()).hexdigest()
+
+
+def save_sharded(
+    checkpoint_dir: str,
+    labels,
+    iteration: int,
+    tag: str = "lpa",
+    fingerprint: str | None = None,
+    num_shards: int | None = None,
+) -> str:
+    """Durably save (labels, iteration) as a manifest of per-shard files.
+
+    ``num_shards``: how many shard files to split the label vector into —
+    pass the mesh size so each file is one device's chunk (defaults to the
+    label array's sharding when it is a committed jax array on a mesh,
+    else 1). Write protocol: every shard + the manifest land in a tmp
+    generation directory (each file fsync'd, manifest last), the previous
+    generation rotates to ``*.prev``, and one directory rename publishes
+    the new generation — a kill at any point leaves the old or the new
+    generation fully intact, never a torn mix. Returns the generation dir.
+    """
+    labels_np = np.asarray(labels)
+    if num_shards is None:
+        num_shards = max(
+            len(getattr(labels, "sharding", None).device_set)
+            if getattr(labels, "sharding", None) is not None else 1,
+            1,
+        )
+    if num_shards < 1:
+        raise ValueError("num_shards must be >= 1")
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    gen = sharded_dir(checkpoint_dir, tag)
+    tmp = f"{gen}.tmp.{os.getpid()}"
+    # Sweep EVERY stale tmp generation, not just this pid's: the crash-
+    # resume loop this format exists for leaves <gen>.tmp.<oldpid> behind
+    # on each SIGKILL mid-save, and restarted processes never reuse the
+    # old pid — without the sweep, preemptions leak one full label-vector
+    # copy per kill. One driver per checkpoint_dir is already the
+    # concurrency contract (the generation rotation assumes it).
+    import glob as _glob
+    import shutil
+
+    for stale in _glob.glob(gen + ".tmp.*"):
+        shutil.rmtree(stale, ignore_errors=True)
+    os.makedirs(tmp)
+
+    # Even chunking (last shard takes the remainder); boundaries are
+    # recorded in the manifest, so the loader never re-derives them.
+    v = len(labels_np)
+    chunk = -(-v // num_shards) if v else 0
+    sizes, shas = [], []
+    for s in range(num_shards):
+        part = labels_np[s * chunk: (s + 1) * chunk]
+        path = shard_file(tmp, s)
+        np.save(path, part)
+        _fsync_file(path)
+        sizes.append(int(len(part)))
+        shas.append(_file_sha256(path))
+
+    body = {
+        "version": _MANIFEST_VERSION,
+        "tag": tag,
+        "iteration": int(iteration),
+        "fingerprint": fingerprint or "",
+        "num_shards": int(num_shards),
+        "mesh_shape": [int(num_shards)],
+        "num_vertices": int(v),
+        "dtype": str(labels_np.dtype),
+        "shard_sizes": sizes,
+        "shard_sha256": shas,
+    }
+    body["checksum"] = _manifest_checksum(body)
+    man_tmp = os.path.join(tmp, MANIFEST_NAME + ".tmp")
+    with open(man_tmp, "w") as f:
+        json.dump(body, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(man_tmp, os.path.join(tmp, MANIFEST_NAME))
+    _fsync_dir(tmp)
+
+    # Publish: rotate current -> .prev, tmp -> current. Directory renames
+    # need a clear target, so a stale .prev is removed first — it is two
+    # generations old by now, strictly older than what replaces it.
+    prev = _sharded_prev_dir(gen)
+    if os.path.exists(gen):
+        if os.path.exists(prev):
+            shutil.rmtree(prev)
+        os.replace(gen, prev)
+    os.replace(tmp, gen)
+    _fsync_dir(checkpoint_dir)
+    return gen
+
+
+def _read_sharded_verified(gen_dir: str, fingerprint: str | None):
+    """Load one sharded generation, verifying manifest checksum, every
+    shard's sha256 and the assembled length, then the graph fingerprint.
+    Raises a :data:`_CORRUPTION_ERRORS` member on damaged bytes,
+    :class:`FingerprintMismatch` on a wrong-graph checkpoint."""
+    man_path = os.path.join(gen_dir, MANIFEST_NAME)
+    try:
+        with open(man_path) as f:
+            body = json.load(f)
+    except json.JSONDecodeError as e:
+        raise CheckpointCorruptionError(
+            f"manifest at {man_path} is not valid JSON ({e})"
+        ) from e
+    want = body.get("checksum", "")
+    got = _manifest_checksum(body)
+    if want != got:
+        raise CheckpointCorruptionError(
+            f"manifest at {man_path} failed its checksum "
+            f"({got[:12]}... != recorded {want[:12]}...)"
+        )
+    saved_fp = body.get("fingerprint", "")
+    if fingerprint and saved_fp and fingerprint != saved_fp:
+        raise FingerprintMismatch(
+            f"sharded checkpoint at {gen_dir} was written for a different "
+            f"graph or vertex-id assignment (fingerprint {saved_fp[:12]}... "
+            f"!= {fingerprint[:12]}...); delete the checkpoint or reload "
+            "the data the way the original run did"
+        )
+    parts = []
+    for s in range(body["num_shards"]):
+        path = shard_file(gen_dir, s)
+        sha = _file_sha256(path)
+        if sha != body["shard_sha256"][s]:
             raise CheckpointCorruptionError(
-                f"checkpoint at {path} is missing (previous generation "
-                f"exists at {prev})"
+                f"shard {s} at {path} failed its sha256 ({sha[:12]}... != "
+                f"manifest {body['shard_sha256'][s][:12]}...)"
             )
-        return _read_verified_confirmed(path, fingerprint)
-    except FingerprintMismatch:
-        raise
-    except _CORRUPTION_ERRORS as e:
-        primary_error = e
-    if not os.path.exists(prev):
+        part = np.load(path)
+        if len(part) != body["shard_sizes"][s]:
+            raise CheckpointCorruptionError(
+                f"shard {s} at {path} holds {len(part)} rows, manifest "
+                f"says {body['shard_sizes'][s]}"
+            )
+        parts.append(part)
+    labels = (
+        np.concatenate(parts) if parts
+        else np.empty(0, np.dtype(body["dtype"]))
+    )
+    if len(labels) != body["num_vertices"]:
         raise CheckpointCorruptionError(
-            f"checkpoint at {path} is corrupt ({primary_error!r}) and no "
-            f"previous generation exists; delete {checkpoint_dir!r} to "
-            "restart from scratch"
-        ) from primary_error
-    # Emitted only once a previous generation exists to roll back TO —
-    # an unrecoverable corruption must not read as a rollback in the
-    # metrics stream (checkpoint_rollback_ok still marks success).
-    if sink is not None:
-        sink.emit(
-            "checkpoint_rollback", path=path, error=repr(primary_error),
+            f"sharded checkpoint at {gen_dir} assembles to {len(labels)} "
+            f"vertices, manifest says {body['num_vertices']}"
         )
+    return labels.astype(np.dtype(body["dtype"]), copy=False), int(
+        body["iteration"]
+    )
+
+
+def _read_sharded_confirmed(gen_dir: str, fingerprint: str | None):
+    """One confirming re-read before a corruption verdict — same
+    transient-I/O-weather rationale as :func:`_read_verified_confirmed`."""
     try:
-        labels, iteration = _read_verified_confirmed(prev, fingerprint)
+        return _read_sharded_verified(gen_dir, fingerprint)
     except FingerprintMismatch:
         raise
-    except _CORRUPTION_ERRORS as e2:
-        raise CheckpointCorruptionError(
-            f"both checkpoint generations are corrupt: {path} "
-            f"({primary_error!r}) and {prev} ({e2!r}); delete "
-            f"{checkpoint_dir!r} to restart from scratch"
-        ) from e2
-    # Promote the good generation back to the current slot so the next
-    # save's rotation cannot demote the corrupt file into the prev slot.
-    # The suspect file is set aside, NOT destroyed — and at a name no
-    # later incident overwrites: even after the confirming re-read
-    # (_read_verified_confirmed), a condemned NEWER checkpoint is
-    # evidence the operator may still want.
-    if os.path.exists(path):
-        condemned = path + ".corrupt"
-        n = 1
-        while os.path.exists(condemned):
-            condemned = f"{path}.corrupt.{n}"
-            n += 1
-        os.replace(path, condemned)
-    os.replace(prev, path)
-    if sink is not None:
-        sink.emit(
-            "checkpoint_rollback_ok", path=path, iteration=iteration,
-        )
-    return labels, iteration
+    except _CORRUPTION_ERRORS as first:
+        try:
+            return _read_sharded_verified(gen_dir, fingerprint)
+        except FingerprintMismatch:
+            raise
+        except _CORRUPTION_ERRORS:
+            raise first
 
 
-def save_sharded(checkpoint_dir: str, labels, iteration: int, tag: str = "lpa") -> str:
-    """Orbax save of (labels, iteration) — the multi-host path.
+def load_sharded(
+    checkpoint_dir: str, tag: str = "lpa", sharding=None,
+    fingerprint: str | None = None, sink=None,
+):
+    """Restore a sharded manifest checkpoint; returns (labels, iteration)
+    or None when no generation exists.
 
-    Unlike :func:`save_labels` (single-host npz), orbax writes each shard
-    from its owning host (async-capable, atomic via its own finalization
-    protocol), so a DCN-spanning run checkpoints without gathering the
-    label vector to one host. Same state contents as the npz path; the two
-    are interchangeable for single-host runs.
+    Every shard's sha256 and the manifest checksum are re-verified. A
+    corrupt current generation **rolls back** to the rotated ``*.prev``
+    generation (promoted back to the current slot; the condemned
+    generation directory is preserved at ``*.corrupt`` for forensics),
+    emitting ``checkpoint_rollback`` / ``checkpoint_rollback_ok`` records
+    through ``sink``. A wrong ``fingerprint`` raises
+    :class:`FingerprintMismatch` WITHOUT rollback — every generation of
+    that checkpoint indexes the same wrong graph.
+
+    The returned labels are the full ``[V]`` vector regardless of how many
+    shards wrote it — restore is shard-count agnostic, so a checkpoint
+    taken on D devices resumes on D' != D (re-shard on restore).
+    ``sharding``: optional ``jax.sharding.Sharding`` to place the restored
+    labels onto directly.
     """
-    import orbax.checkpoint as ocp
-
-    path = os.path.abspath(os.path.join(checkpoint_dir, f"{tag}_orbax"))
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(
-            path,
-            # 0-d ndarray, not np.int64: orbax's StandardCheckpointHandler
-            # rejects numpy scalar types on some releases
-            {"labels": labels, "iteration": np.asarray(iteration, np.int64)},
-            force=True,
-        )
-    return path
-
-
-def load_sharded(checkpoint_dir: str, tag: str = "lpa", sharding=None):
-    """Restore an orbax checkpoint; returns (labels, iteration) or None.
-
-    ``sharding``: optional ``jax.sharding.Sharding`` to restore the label
-    array directly into (device-resident, correctly placed on the mesh —
-    no host bounce). Defaults to host numpy.
-    """
-    import orbax.checkpoint as ocp
-
-    path = os.path.abspath(os.path.join(checkpoint_dir, f"{tag}_orbax"))
-    if not os.path.exists(path):
+    gen = sharded_dir(checkpoint_dir, tag)
+    if not os.path.exists(gen) and not os.path.exists(_sharded_prev_dir(gen)):
+        # A checkpoint from the REMOVED orbax format must fail loudly,
+        # not read as "no checkpoint": silently restarting a multi-day
+        # run from iteration 0 across the upgrade would discard every
+        # superstep. (load_newest holds this error while it tries the
+        # npz format, so a dir that also has a valid npz still resumes.)
+        legacy = os.path.join(checkpoint_dir, f"{tag}_orbax")
+        if os.path.isdir(legacy):
+            raise CheckpointCorruptionError(
+                f"checkpoint at {legacy} uses the removed orbax format; "
+                "this release reads the sharded-manifest and npz formats "
+                "only. Finish the run with the previous release, or "
+                "convert: restore the orbax state with orbax.checkpoint."
+                "StandardCheckpointer().restore(...) and re-save it via "
+                "checkpoint.save_sharded(...)"
+            )
         return None
+    out = _load_with_rollback(
+        gen, _sharded_prev_dir(gen),
+        lambda p: _read_sharded_confirmed(p, fingerprint),
+        sink, "sharded checkpoint",
+        f"delete {gen!r} (and its .prev) to restart from scratch",
+    )
+    if out is None:
+        return None
+    labels, iteration = out
+    return _place(labels, sharding), iteration
+
+
+def _place(labels: np.ndarray, sharding):
+    if sharding is None:
+        return labels
     import jax
 
-    with ocp.StandardCheckpointer() as ckptr:
-        # StandardCheckpointer.metadata returns StepMetadata in newer
-        # orbax (tree under .item_metadata) and the raw tree in older.
-        meta = ckptr.metadata(path)
-        meta = getattr(meta, "item_metadata", meta)
-        if sharding is None:
-            # Restore into a host-numpy skeleton built from the saved
-            # metadata: orbax then validates the topology instead of
-            # warning that targetless restores are unsafe.
-            target = jax.tree.map(
-                lambda m: np.zeros(m.shape, m.dtype), dict(meta)
+    return jax.device_put(labels, sharding)
+
+
+def _peek_sharded_iteration(checkpoint_dir: str, tag: str):
+    """Cheap current-generation iteration read (manifest JSON only, no
+    shard hashing); None = unreadable/absent (the full loader may still
+    recover via rollback)."""
+    try:
+        with open(os.path.join(sharded_dir(checkpoint_dir, tag), MANIFEST_NAME)) as f:
+            return int(json.load(f)["iteration"])
+    except Exception:
+        return None
+
+
+def _peek_npz_iteration(checkpoint_dir: str, tag: str):
+    """Cheap current-generation iteration read (one npz member, no label
+    decompression or checksum); None = unreadable/absent."""
+    try:
+        with np.load(os.path.join(checkpoint_dir, f"{tag}_labels.npz")) as z:
+            return int(z["iteration"])
+    except Exception:
+        return None
+
+
+def load_newest(
+    checkpoint_dir: str, tag: str = "lpa", fingerprint: str | None = None,
+    sink=None,
+):
+    """Newest recoverable (labels, iteration) across BOTH checkpoint
+    formats — the sharded manifest (distributed saves) and the npz
+    (single-device saves); a run that walked the elastic ladder down to
+    one device leaves both in the directory, and the higher iteration
+    wins. The one owner of this rule (the driver's --resume and the
+    resume-check tool both call it).
+
+    The loser is not fully loaded: iterations are peeked first (manifest
+    JSON / one npz member), and a format provably no newer than what
+    already loaded is skipped — at north-star scale each full load
+    re-hashes the whole label vector, and paying that twice per resume
+    just to compare two counters would double resume I/O. A format whose
+    peek is unreadable is still tried (its rollback may recover), and a
+    loaded result BELOW its own peek (a rollback happened) re-opens the
+    comparison.
+
+    One format being corrupt beyond its own rollback must not veto the
+    other: per-format :class:`CheckpointCorruptionError` is held while
+    the other format is tried, and only re-raised when NOTHING loads.
+    :class:`FingerprintMismatch` always propagates — every format of
+    that checkpoint indexes the same wrong graph. Returns None when no
+    checkpoint exists in either format.
+    """
+    entries = [
+        (_peek_sharded_iteration(checkpoint_dir, tag), load_sharded),
+        (_peek_npz_iteration(checkpoint_dir, tag), load_labels),
+    ]
+    # Most-promising first; unknown peeks last (tried, not trusted).
+    entries.sort(
+        key=lambda t: float("-inf") if t[0] is None else t[0], reverse=True
+    )
+    found, errors = [], []
+    for peek, loader in entries:
+        if found and peek is not None and peek <= found[-1][1]:
+            break  # provably not newer than what already loaded
+        try:
+            out = loader(
+                checkpoint_dir, tag=tag, fingerprint=fingerprint, sink=sink
             )
-        else:
-            lbl = meta["labels"]
-            target = {
-                "labels": jax.ShapeDtypeStruct(
-                    lbl.shape, lbl.dtype, sharding=sharding
-                ),
-                "iteration": 0,
-            }
-        state = ckptr.restore(path, target)
-    return state["labels"], int(state["iteration"])
+        except FingerprintMismatch:
+            raise
+        except CheckpointCorruptionError as e:
+            errors.append(e)
+            continue
+        if out is not None:
+            found.append(out)
+    if found:
+        return max(found, key=lambda t: t[1])
+    if errors:
+        raise errors[0]
+    return None

@@ -69,12 +69,17 @@ class PipelineConfig:
     # Save every N supersteps (plus always the final one). 1 = every
     # superstep — right for maxIter=5 parity runs; long billion-edge runs
     # (the case checkpointing exists for, SURVEY §5) should raise it: at
-    # north-star scale each save is a ~64 MB npz.
+    # north-star scale each save is a ~64 MB npz. Multi-device rungs
+    # write the sharded MANIFEST format (per-shard files + sha256,
+    # re-shardable on restore — docs/RESILIENCE.md); single-device rungs
+    # write the npz. --resume reads both and takes the newer iteration.
     checkpoint_every: int = 1
     resume: bool = False
     # resilience (docs/RESILIENCE.md): retry/backoff budget, superstep
-    # watchdog, and degradation policy for every pipeline phase. CLI
-    # flags are flattened (--max-retries, --superstep-timeout-s, ...).
+    # watchdog, memory + elastic-device degradation policy, and the
+    # in-loop divergence tripwires for every pipeline phase. CLI flags
+    # are flattened (--max-retries, --superstep-timeout-s,
+    # --tripwire-every-k, ...).
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     # Count-and-set-aside malformed rows / NaN weights at ingestion
     # (emitted as a "quarantine" metrics record) instead of crashing.

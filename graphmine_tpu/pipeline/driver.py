@@ -376,13 +376,14 @@ def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
                 # for ~0.001 AUROC — see config.py)
                 def _score():
                     resilience.fault_point("outliers_lof")
-                    return lof_scores(feats, k=k, impl=config.lof_impl)
+                    return lof_scores(feats, k=k, impl=config.lof_impl, sink=m)
 
                 # OOM ladder: the exact all-pairs scorer's [V, V] distance
                 # tiles are the memory hog; the IVF index probes a bounded
                 # candidate set (bounded recall loss, see config.py)
                 ladder = (
-                    ("lof_ivf", lambda: lof_scores(feats, k=k, impl="ivf")),
+                    ("lof_ivf",
+                     lambda: lof_scores(feats, k=k, impl="ivf", sink=m)),
                 ) if config.lof_impl != "ivf" else ()
             scores = resilience.run_phase(
                 "outliers_lof", _score, config.resilience, m, ladder=ladder
@@ -433,10 +434,16 @@ def _run_lpa(
         if config.checkpoint_dir else None
     )
 
-    if config.resume and config.checkpoint_dir:
-        loaded = ckpt.load_labels(
+    def _reload_checkpoint():
+        """Newest recoverable state across BOTH checkpoint formats
+        (sharded manifest + npz; the higher iteration wins, one corrupt
+        format does not veto the other) — see checkpoint.load_newest."""
+        return ckpt.load_newest(
             config.checkpoint_dir, fingerprint=fingerprint, sink=m
         )
+
+    if config.resume and config.checkpoint_dir:
+        loaded = _reload_checkpoint()
         if loaded is not None:
             saved_labels, start_iter = loaded
             if start_iter > config.max_iter:
@@ -460,29 +467,74 @@ def _run_lpa(
     # from iteration 0 — supersteps are deterministic, so a resumed
     # trajectory is byte-identical to an uninterrupted one.
     state = {"labels": labels, "it": start_iter}
+    # The ACTIVE operating point: the elastic device rungs shrink "ndev"
+    # below the starting mesh, and the sharded-checkpoint writer splits
+    # by whatever is current (a checkpoint's shard count is metadata, not
+    # a restore constraint — load_sharded re-shards).
+    current = {"ndev": n_dev, "variant": run_plan.schedule}
+    # Device indices implicated in a device-loss error (parsed best-effort
+    # from its message): the runtime usually still LISTS a chip that just
+    # failed a collective, and a rung mesh built from the first N visible
+    # devices would re-enroll it — every halved rung would then die the
+    # same death, exhausting the elastic ladder without ever routing
+    # around the loss.
+    dead_devices: set = set()
 
-    def make_superstep(variant: str):
+    def _rung_mesh(ndev: int):
+        from graphmine_tpu.parallel.mesh import surviving_mesh
+
+        if dead_devices:
+            try:
+                return surviving_mesh(ndev, exclude=sorted(dead_devices))
+            except ValueError:
+                # exclusions leave too few survivors: better to try the
+                # first-N mesh (maybe the parse over-matched) than abort
+                pass
+        return make_mesh(ndev)
+
+    def _note_dead_devices() -> None:
+        """Harvest chip indices from the device-loss error that triggered
+        this descent (run_phase records it in the degrade event just
+        before invoking the rung). Message parsing is best-effort — an
+        unattributed loss still degrades, just without the exclusion."""
+        import re
+
+        device_degrades = [
+            r for r in m.of_phase("degrade") if r.get("kind") == "device"
+        ]
+        if device_degrades:
+            for tok in re.findall(
+                r"(?:chip|device)\s+#?(\d+)",
+                device_degrades[-1].get("error", ""),
+            ):
+                dead_devices.add(int(tok))
+
+    def make_superstep(variant: str, ndev: int):
         """Build the per-superstep callable for one operating point
-        (schedules, plus the planner's degradation rungs)."""
+        (schedule x device count: the planner's memory rungs keep the
+        mesh and lean the schedule; the elastic device rungs keep the
+        schedule and shrink the mesh)."""
         if variant == "ring":
             # Memory-scalable schedule: labels stay sharded, chunks rotate
             # over ICI (parallel/ring.py). Uses the sort-body message CSR.
             from graphmine_tpu.parallel.ring import ring_label_propagation
 
-            mesh = make_mesh(n_dev)
-            with m.timed("partition", shards=n_dev, schedule="ring"):
+            mesh = _rung_mesh(ndev)
+            with m.timed("partition", shards=ndev, schedule="ring"):
                 sg = shard_graph_arrays(partition_graph(graph, mesh=mesh), mesh)
+            current["chunk_size"] = sg.chunk_size
             return lambda lbl: ring_label_propagation(
                 sg, mesh, max_iter=1, init_labels=lbl
             )
         if variant == "replicated":
-            mesh = make_mesh(n_dev)
-            with m.timed("partition", shards=n_dev, schedule="replicated"):
+            mesh = _rung_mesh(ndev)
+            with m.timed("partition", shards=ndev, schedule="replicated"):
                 sg = shard_graph_arrays(
                     partition_graph(graph, mesh=mesh, build_bucket_plan=True),
                     mesh,
                     lpa_only=run_plan.lpa_only,
                 )
+            current["chunk_size"] = sg.chunk_size
             return lambda lbl: sharded_label_propagation(
                 sg, mesh, max_iter=1, init_labels=lbl
             )
@@ -492,6 +544,7 @@ def _run_lpa(
             # labels by construction (tests/test_lpa.py pins parity).
             from graphmine_tpu.ops.lpa import lpa_superstep
 
+            current["chunk_size"] = graph.num_vertices
             step = jax.jit(lpa_superstep)
             return lambda lbl: step(lbl, graph)
         # "single": fused degree-bucketed kernel (ops/bucketed_mode.py):
@@ -503,12 +556,24 @@ def _run_lpa(
         if plan_holder[0] is None:
             raise ValueError("single-device LPA requires the fused plan "
                              "built by run_pipeline (wants_plan)")
+        current["chunk_size"] = graph.num_vertices
         step = jax.jit(lpa_superstep_bucketed)
         plan = plan_holder[0]
         return lambda lbl: step(lbl, graph, plan)
 
     def save_ck(iteration: int) -> None:
-        if config.checkpoint_dir:
+        if not config.checkpoint_dir:
+            return
+        if current["ndev"] > 1:
+            # Distributed rungs write the shard-aware manifest format:
+            # per-shard files + sha256 manifest, re-shardable on restore
+            # (the elastic path after a chip loss resumes on D' != D).
+            ckpt.save_sharded(
+                config.checkpoint_dir, np.asarray(state["labels"]),
+                iteration, fingerprint=fingerprint,
+                num_shards=current["ndev"],
+            )
+        else:
             ckpt.save_labels(
                 config.checkpoint_dir, state["labels"], iteration,
                 fingerprint=fingerprint,
@@ -517,43 +582,137 @@ def _run_lpa(
     # Built supersteps survive retry re-entry: a transient failure at
     # superstep N must not repartition/reshard the whole graph (minutes
     # of host+device work at scale) nor emit a duplicate "partition"
-    # record before resuming at N.
+    # record before resuming at N. Keyed (variant, ndev): the elastic
+    # rungs rebuild the same schedule on a smaller mesh.
     superstep_cache: dict = {}
-    # Variants that have completed >=1 superstep in THIS build: the first
-    # superstep of a freshly built variant includes its XLA compile, which
-    # can dwarf the steady-state bound the operator sized the watchdog
-    # for — arming it there would kill the very rung a degradation just
-    # rescued the run with. The watchdog arms from the second superstep.
+    # Operating points that have completed >=1 superstep in THIS build:
+    # the first superstep of a freshly built point includes its XLA
+    # compile, which can dwarf the steady-state bound the operator sized
+    # the watchdog for — arming it there would kill the very rung a
+    # degradation just rescued the run with. The watchdog arms from the
+    # second superstep.
     warmed: set = set()
+    # Operating points whose entry preamble (cache purge, device-loss
+    # state salvage, mesh_degrade record) already ran: transient-retry
+    # re-entries must not re-salvage or re-emit.
+    entered: set = set()
+    trip_k = policy.tripwire_every_k
 
-    def make_runner(variant: str):
+    def check_tripwire(new, it: int, variant: str) -> None:
+        """Host-side divergence tripwire at the superstep boundary (the
+        driver already syncs each superstep for the labels-changed
+        counter, so the guard costs one more reduction every K steps).
+        Real vertices can only ever carry real vertex ids — the mode /
+        min of incoming real labels, or their own id — so anything
+        outside [0, V) means corrupted state. The in-memory iterate is
+        untrusted after a trip: roll back to the last checkpoint before
+        raising the (retryable) error, so the retry resumes from trusted
+        bytes instead of re-propagating the garbage."""
+        bad = (new < 0) | (new >= graph.num_vertices)
+        n_bad = int(bad.sum())
+        if not n_bad:
+            return
+        # The REAL per-device chunk (partition_graph's padded size,
+        # recorded by make_superstep) — a ceil(V/D) approximation would
+        # attribute boundary vertices to the wrong shard.
+        chunk = current.get("chunk_size") or graph.num_vertices
+        shard = int(jnp.argmax(bad)) // chunk
+        err = resilience.DivergenceError(
+            "label_out_of_range", shard, it + 1
+        )
+        m.tripwire(
+            err.kind, err.shard, err.iteration,
+            stage="lpa", bad_vertices=n_bad, variant=variant,
+        )
+        restored = (
+            _reload_checkpoint() if config.checkpoint_dir else None
+        )
+        if restored is not None:
+            state["labels"] = jnp.asarray(restored[0], dtype=jnp.int32)
+            state["it"] = restored[1]
+            m.emit("resume", iteration=restored[1], reason="tripwire")
+        raise err
+
+    def make_runner(variant: str | None, ndev: int | None = None):
         """The remaining-supersteps loop at one operating point. Runs
         iterations one jit call at a time so the labels-changed counter
         and edges/sec stay observable (the loop is device-resident; only
         the scalar counter syncs) and every superstep is a watchdog +
-        checkpoint boundary."""
+        checkpoint + tripwire boundary. ``ndev=None`` inherits the mesh
+        size current at entry (memory rungs lean the schedule wherever
+        the elastic ladder already moved the run); an explicit ``ndev``
+        is an elastic device rung. ``variant=None`` inherits the variant
+        current at entry: a device rung must rebuild the schedule the run
+        was ACTUALLY using — re-running the planner's original choice
+        would undo a memory degradation whose rung was already consumed
+        (replicated OOMs -> ring rescues -> chip dies -> the smaller mesh
+        must run ring, not replicated again)."""
 
         def run():
-            # The ladder degrades BECAUSE device memory ran out: before
-            # building this rung's superstep, release everything the
-            # failed rung held on device — its cached superstep closure
-            # (sharded label/bucket arrays) and, once the fused kernel is
-            # abandoned, the plan's padded bucket matrices. Retries
-            # re-enter the SAME variant, so its cache entry survives.
-            for stale in [k for k in superstep_cache if k != variant]:
+            nd = current["ndev"] if ndev is None else ndev
+            var = current["variant"] if variant is None else variant
+            key = (var, nd)
+            if key not in entered:
+                entered.add(key)
+                if nd < current["ndev"]:
+                    # Elastic descent: route the rung mesh around the
+                    # implicated chip(s), and salvage the loop state —
+                    # the failed mesh's device arrays may be GONE with
+                    # the lost chip. In-memory labels when the host
+                    # transfer still works, else the last sharded
+                    # checkpoint (re-shard on restore handles the new
+                    # device count).
+                    _note_dead_devices()
+                    try:
+                        host_labels = np.asarray(state["labels"])
+                        resumed_from = "memory"
+                    except Exception as salvage_err:
+                        restored = (
+                            _reload_checkpoint()
+                            if config.checkpoint_dir else None
+                        )
+                        if restored is None:
+                            raise RuntimeError(
+                                "device loss with no recoverable state: "
+                                "the in-memory labels died with the mesh "
+                                f"({salvage_err!r}) and no checkpoint "
+                                "exists — set checkpoint_dir to make "
+                                "device loss survivable"
+                            ) from salvage_err
+                        host_labels, state["it"] = restored
+                        resumed_from = "checkpoint"
+                    state["labels"] = jnp.asarray(
+                        host_labels, dtype=jnp.int32
+                    )
+                    m.emit(
+                        "mesh_degrade", from_devices=current["ndev"],
+                        to_devices=nd, schedule=var,
+                        iteration=state["it"], resumed_from=resumed_from,
+                        dead_devices=sorted(dead_devices),
+                    )
+            current["ndev"], current["variant"] = nd, var
+            # The ladder degrades BECAUSE device memory ran out (or a
+            # chip died): before building this rung's superstep, release
+            # everything the failed rung held on device — its cached
+            # superstep closure (sharded label/bucket arrays) and, once
+            # the fused kernel is abandoned, the plan's padded bucket
+            # matrices. Retries re-enter the SAME operating point, so its
+            # cache entry survives.
+            for stale in [k for k in superstep_cache if k != key]:
                 del superstep_cache[stale]
                 warmed.discard(stale)  # re-entry would recompile
-            if variant != "single":
+            if var != "single":
                 plan_holder[0] = None
-            if variant not in superstep_cache:
-                superstep_cache[variant] = make_superstep(variant)
-            one_iter = superstep_cache[variant]
+            if key not in superstep_cache:
+                superstep_cache[key] = make_superstep(var, nd)
+            one_iter = superstep_cache[key]
             while state["it"] < config.max_iter:
                 it = state["it"]
 
                 def step_sync():
                     resilience.fault_point(
-                        "lpa_superstep", iteration=it + 1, variant=variant
+                        "lpa_superstep", iteration=it + 1, variant=var,
+                        state=state, num_shards=nd,
                     )
                     new = one_iter(state["labels"])
                     new.block_until_ready()
@@ -563,12 +722,12 @@ def _run_lpa(
                 # Watchdog contract: checkpoint-then-abort. On a hung
                 # superstep the LAST GOOD labels (iteration `it`) are
                 # saved before SuperstepTimeout surfaces, so the run
-                # resumes exactly where it hung. Unarmed (None) for a
-                # variant's compile-bearing first superstep — see
+                # resumes exactly where it hung. Unarmed (None) for an
+                # operating point's compile-bearing first superstep — see
                 # ``warmed`` above.
                 new = resilience.run_with_watchdog(
                     "lpa_superstep", step_sync,
-                    policy.superstep_timeout_s if variant in warmed else None,
+                    policy.superstep_timeout_s if key in warmed else None,
                     m,
                     # no hook at all without a checkpoint_dir: the timeout
                     # message/record must not claim a checkpoint was saved
@@ -578,29 +737,59 @@ def _run_lpa(
                     ),
                 )
                 dt = time.perf_counter() - t0
-                warmed.add(variant)
+                warmed.add(key)
+                # Cadence (r3): every Nth superstep, plus always the final
+                # one so a completed run's checkpoint is never stale.
+                will_save = config.checkpoint_dir and (
+                    (it + 1) % config.checkpoint_every == 0
+                    or it + 1 == config.max_iter
+                )
+                # A superstep that will CHECKPOINT is always guarded too
+                # (when tripwires are armed): persisting unverified labels
+                # would rotate the last tripwire-validated generation away,
+                # and the rollback the tripwire promises would restore
+                # intact-but-garbage bytes.
+                if trip_k and ((it + 1) % trip_k == 0 or will_save):
+                    check_tripwire(new, it, var)
                 changed = int((new != state["labels"]).sum())
                 state["labels"] = new
                 state["it"] = it + 1
                 m.lpa_iteration(it + 1, changed, graph.num_edges, dt, chips)
-                # Cadence (r3): every Nth superstep, plus always the final
-                # one so a completed run's checkpoint is never stale.
-                if config.checkpoint_dir and (
-                    (it + 1) % config.checkpoint_every == 0
-                    or it + 1 == config.max_iter
-                ):
+                if will_save:
                     save_ck(it + 1)
             return state["labels"]
 
         return run
 
-    from graphmine_tpu.pipeline.planner import degradation_ladder
+    from graphmine_tpu.pipeline.planner import (
+        degradation_ladder,
+        elastic_device_ladder,
+    )
 
     rungs = degradation_ladder(run_plan.schedule, n_dev)
+    # Elastic device rungs (DEGRADABLE_DEVICE failures): halved mesh,
+    # resumed from salvage/checkpoint, running the variant CURRENT at
+    # descent time (variant=None) — a memory degradation that already
+    # moved the run off the planner's original schedule must survive the
+    # descent (replicated OOMs -> ring rescues -> chip dies -> ring@2dev,
+    # never replicated again). The 1-device floor runs the sort-based
+    # single kernel — only when the full graph fits one device (in
+    # scale-out mode there is no such floor).
+    device_rungs = []
+    for d2 in elastic_device_ladder(run_plan.schedule, n_dev):
+        if d2 > 1:
+            device_rungs.append(
+                (f"elastic@{d2}dev", make_runner(None, d2))
+            )
+        elif run_plan.estimates.get("single", 0) <= run_plan.hbm_bytes:
+            device_rungs.append(
+                ("single_sort@1dev", make_runner("single_sort", 1))
+            )
     with maybe_profile(config.profile_dir):
         labels = resilience.run_phase(
             "lpa", make_runner(run_plan.schedule), policy, m,
             ladder=tuple((v, make_runner(v)) for v in rungs),
+            device_ladder=tuple(device_rungs),
             # supersteps advanced since the last failure => a NEW incident:
             # the retry budget bounds attempts per incident, not per run
             progress=lambda: state["it"],

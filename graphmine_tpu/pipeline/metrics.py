@@ -90,6 +90,16 @@ class MetricsSink:
                 return path
         return self.write_jsonl(path)
 
+    def tripwire(self, kind: str, shard: int, iteration: int, **kv):
+        """Structured record for an in-loop divergence-tripwire firing
+        (docs/RESILIENCE.md): which guard, the offending shard index, the
+        superstep it fired at — one fixed shape so offline triage can
+        filter `of_phase("tripwire")` without per-caller key guessing."""
+        return self.emit(
+            "tripwire", kind=kind, shard=int(shard),
+            iteration=int(iteration), **kv,
+        )
+
     def lpa_iteration(self, it: int, changed: int, num_edges: int, seconds: float, chips: int):
         """Per-superstep record with the headline edges/sec/chip metric."""
         eps = num_edges / seconds if seconds > 0 else float("inf")

@@ -166,6 +166,30 @@ def degradation_ladder(schedule: str, num_devices: int) -> list[str]:
     return []
 
 
+def elastic_device_ladder(schedule: str, num_devices: int) -> list[int]:
+    """Surviving-device rungs after a device/ICI loss under ``schedule``
+    — the ELASTIC family (DEGRADABLE_DEVICE errors), orthogonal to the
+    memory ladder above: a lost chip leaves the survivors with the same
+    per-device HBM, so the answer is not a leaner schedule but a smaller
+    mesh — re-partition via ``partition_graph`` onto D' devices and
+    resume from the last sharded checkpoint.
+
+    Rungs halve (D//2, D//4, ..., 1): after one loss the surviving count
+    is D-1, but meshes want the even chunking the partitioner pads for,
+    halving bounds the rung count to log D (each re-partition is minutes
+    of host work at scale), and a halved mesh tolerates further losses
+    before the next descent. Single-device runs have no mesh to shrink.
+    """
+    if schedule == "single" or num_devices <= 1:
+        return []
+    rungs = []
+    d = num_devices // 2
+    while d >= 1:
+        rungs.append(d)
+        d //= 2
+    return rungs
+
+
 def plan_run(
     num_vertices: int,
     num_edges: int,

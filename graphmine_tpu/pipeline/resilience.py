@@ -34,6 +34,13 @@ from dataclasses import dataclass
 
 RETRYABLE = "retryable"
 DEGRADABLE = "degradable"
+# A device (or its ICI link) died: the operating point itself is gone, not
+# merely too big. Retrying cannot help (the chip stays dead) and the memory
+# ladder is the wrong move (the survivors have the same HBM) — the only way
+# forward is an ELASTIC rung: re-partition onto the surviving device count
+# and resume from the last sharded checkpoint (pipeline/planner.py
+# elastic_device_ladder + the driver's device rungs).
+DEGRADABLE_DEVICE = "degradable_device"
 FATAL = "fatal"
 
 # Transient runtime weather: the work is sound, the attempt was unlucky.
@@ -51,6 +58,24 @@ _RETRYABLE_PHRASES = ("socket closed", "connection reset", "transport closed")
 # the only way forward is a smaller operating point (degradation ladder).
 _DEGRADABLE_STATUS = ("RESOURCE_EXHAUSTED",)
 _DEGRADABLE_PHRASES = ("Out of memory", "out of memory")
+
+# Device/ICI loss: a chip or its interconnect left the mesh. Checked BEFORE
+# the retryable markers — real device-loss reports often ride otherwise
+# transient-looking statuses ("UNAVAILABLE: ... device failure"), and
+# retrying onto a dead chip just burns the retry budget. The injected
+# device-loss fault (testing/faults.py) uses the same message shapes so the
+# classifier under test is this one.
+_DEVICE_LOSS_STATUS = ("DATA_LOSS",)
+_DEVICE_LOSS_PHRASES = (
+    "device failure", "ICI link", "interconnect failure",
+    "device is lost", "chip halted",
+)
+
+# Divergence tripwires (parallel/sharded.py) raise DivergenceError, but a
+# trip detected by an on-device guard surfaces through an XLA host-callback
+# wrapper that may re-wrap it (XlaRuntimeError quoting the message) — the
+# marker token classifies the wrapped form identically to the original.
+_DIVERGENCE_MARKER = "GRAPHMINE_DIVERGENCE"
 
 
 def _status_prefixed(msg: str, codes: tuple) -> bool:
@@ -74,25 +99,60 @@ class SuperstepTimeout(ResilienceError):
     before this was raised — the message says which case applies."""
 
 
+class DivergenceError(ResilienceError):
+    """An in-loop divergence tripwire fired: the iterate (labels / ranks)
+    is numerically or structurally garbage — NaN/Inf ranks, labels outside
+    the vertex id range, a period-2 oscillation, a CC monotonicity
+    violation. Classified RETRYABLE: the canonical cause is transient
+    device corruption (a bit flip, a torn collective), and the driver
+    rolls the loop state back to the last checkpoint before the retry so
+    the re-attempt starts from trusted bytes, not from the garbage that
+    tripped. ``kind`` / ``shard`` / ``iteration`` carry the forensics."""
+
+    graphmine_error_class = RETRYABLE
+
+    def __init__(self, kind: str, shard: int, iteration: int):
+        super().__init__(
+            f"{_DIVERGENCE_MARKER}: {kind} detected in shard {shard} at "
+            f"superstep {iteration}; the iterate is untrusted — resume "
+            "from the last good checkpoint"
+        )
+        self.kind = kind
+        self.shard = int(shard)
+        self.iteration = int(iteration)
+
+
 def classify_error(exc: BaseException) -> str:
-    """Map an exception to RETRYABLE / DEGRADABLE / FATAL.
+    """Map an exception to RETRYABLE / DEGRADABLE / DEGRADABLE_DEVICE /
+    FATAL.
 
     Precedence: an explicit ``graphmine_error_class`` attribute (the
     protocol for injected faults and our own error types) wins; then
-    degradable resource-exhaustion markers (checked before retryable ones:
-    an OOM status string may also mention a retryable-looking transport
-    detail); then transient markers and connection errors; else fatal.
+    device-loss markers (a dead chip can masquerade as transient
+    UNAVAILABLE weather — retrying onto it cannot help); then degradable
+    resource-exhaustion markers (checked before retryable ones: an OOM
+    status string may also mention a retryable-looking transport detail);
+    then transient markers and connection errors; else fatal. The
+    divergence-tripwire marker is matched anywhere in the message so a
+    :class:`DivergenceError` re-wrapped by an XLA callback boundary still
+    classifies retryable.
     """
     explicit = getattr(exc, "graphmine_error_class", None)
-    if explicit in (RETRYABLE, DEGRADABLE, FATAL):
+    if explicit in (RETRYABLE, DEGRADABLE, DEGRADABLE_DEVICE, FATAL):
         return explicit
     if isinstance(exc, MemoryError):
         return DEGRADABLE
     msg = str(exc)
+    if _status_prefixed(msg, _DEVICE_LOSS_STATUS) or any(
+        m in msg for m in _DEVICE_LOSS_PHRASES
+    ):
+        return DEGRADABLE_DEVICE
     if _status_prefixed(msg, _DEGRADABLE_STATUS) or any(
         m in msg for m in _DEGRADABLE_PHRASES
     ):
         return DEGRADABLE
+    if _DIVERGENCE_MARKER in msg:
+        return RETRYABLE
     if isinstance(exc, ConnectionError):
         return RETRYABLE
     if _status_prefixed(msg, _RETRYABLE_STATUS) or any(
@@ -118,7 +178,12 @@ class ResilienceConfig:
     XLA compilation (which can dwarf a steady-state step) never trips it.
     ``degradation`` is ``"auto"`` (walk the ladder on degradable errors) or
     ``"off"`` (surface the error; an operator who sized the run wants the
-    OOM, not a silently slower schedule).
+    OOM, not a silently slower schedule); it governs BOTH ladder families
+    — the memory rungs and the elastic device rungs.
+    ``tripwire_every_k`` arms the in-loop divergence tripwires (NaN/Inf
+    ranks, label-out-of-range, oscillation — docs/RESILIENCE.md) every K
+    supersteps; 0 (the default) leaves them off. K trades detection
+    latency against one extra reduction + host sync per checked superstep.
     """
 
     max_retries: int = 2
@@ -127,6 +192,7 @@ class ResilienceConfig:
     jitter: float = 0.5
     superstep_timeout_s: float | None = None
     degradation: str = "auto"
+    tripwire_every_k: int = 0
 
     def validate(self) -> "ResilienceConfig":
         if self.max_retries < 0:
@@ -139,6 +205,8 @@ class ResilienceConfig:
             raise ValueError("superstep_timeout_s must be positive")
         if self.degradation not in ("auto", "off"):
             raise ValueError(f"unknown degradation policy {self.degradation!r}")
+        if self.tripwire_every_k < 0:
+            raise ValueError("tripwire_every_k must be >= 0 (0 = off)")
         return self
 
 
@@ -194,13 +262,22 @@ def run_phase(
     ladder: tuple = (),
     sleep=time.sleep,
     progress=None,
+    device_ladder: tuple = (),
 ):
     """Run ``fn()`` with the full retry/degrade/fail taxonomy applied.
 
-    ``ladder``: ordered ``(label, thunk)`` fallbacks for degradable
-    failures — each rung is itself retried on transient errors. Thunks that
-    share mutable state (e.g. the LPA loop's labels + iteration counter)
-    make a rung *resume* rather than restart; see the driver.
+    ``ladder``: ordered ``(label, thunk)`` fallbacks for DEGRADABLE
+    (memory) failures — each rung is itself retried on transient errors.
+    Thunks that share mutable state (e.g. the LPA loop's labels +
+    iteration counter) make a rung *resume* rather than restart; see the
+    driver.
+
+    ``device_ladder``: ordered ``(label, thunk)`` fallbacks for
+    DEGRADABLE_DEVICE (device/ICI loss) failures — the elastic rungs that
+    re-partition onto fewer devices. The two families advance
+    independently: an OOM steps the memory ladder, a device loss steps
+    the device ladder, and a run may walk both (lose a chip, then OOM on
+    the smaller mesh).
 
     ``progress``: optional zero-arg callable sampled at each failure; when
     its value has advanced since the previous failure the retry budget
@@ -208,30 +285,42 @@ def run_phase(
     lifetime (see :func:`_retry_loop`).
 
     Emits ``retry`` / ``retries_exhausted`` / ``degrade`` records through
-    ``metrics``. Raises the classified-fatal error, the degradable error
-    when the ladder is exhausted (or degradation is off), or
-    :class:`RetriesExhausted`.
+    ``metrics`` (device rungs carry ``kind="device"``). Raises the
+    classified-fatal error, the degradable error when its ladder is
+    exhausted (or degradation is off), or :class:`RetriesExhausted`.
     """
     # Jitter stream seeded per (phase, process): reproducible within one
     # process, but DIFFERENT across a fleet — N preempted workers retrying
     # a shared dependency must not wake in lockstep (the thundering herd
     # jitter exists to prevent).
     rng = random.Random(f"{name}:{os.getpid()}")
-    steps = [(None, fn), *ladder]
-    for depth, (label, thunk) in enumerate(steps):
+    mem = list(ladder)
+    dev = list(device_ladder)
+    thunk = fn
+    depth = 0
+    while True:
         try:
             return _retry_loop(
                 name, thunk, policy, metrics, sleep, rng, progress
             )
         except Exception as e:
-            if (
-                classify_error(e) == DEGRADABLE
-                and policy.degradation == "auto"
-                and depth < len(steps) - 1
-            ):
+            cls = classify_error(e)
+            if policy.degradation != "auto":
+                raise
+            if cls == DEGRADABLE and mem:
+                label, thunk = mem.pop(0)
+                depth += 1
                 metrics.emit(
-                    "degrade", stage=name, to=steps[depth + 1][0],
-                    depth=depth + 1, error=repr(e),
+                    "degrade", stage=name, to=label, depth=depth,
+                    error=repr(e),
+                )
+                continue
+            if cls == DEGRADABLE_DEVICE and dev:
+                label, thunk = dev.pop(0)
+                depth += 1
+                metrics.emit(
+                    "degrade", stage=name, to=label, depth=depth,
+                    kind="device", error=repr(e),
                 )
                 continue
             raise

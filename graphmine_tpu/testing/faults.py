@@ -23,7 +23,15 @@ under test — not a test double.
 
 File corruptors (:func:`corrupt_file`, :func:`truncate_file`) damage
 checkpoints/parquet bytes in place to exercise checksum rollback and
-ingestion failure paths.
+ingestion failure paths; :func:`corrupt_shard` / :func:`corrupt_manifest`
+target one shard file / the manifest of a sharded-manifest checkpoint.
+
+Device-level faults (ISSUE 2): :func:`device_loss` mimics a dead chip /
+torn ICI link (classified DEGRADABLE_DEVICE — drives the elastic
+mesh-degradation rungs), and :func:`poison_labels` is a ctx-aware
+*mutator* that silently corrupts one shard of the driver's label state —
+exercising the divergence tripwires, which must catch corruption that
+announces nothing.
 """
 
 from __future__ import annotations
@@ -54,6 +62,11 @@ class InjectedHang(Exception):
     """Marker used via :func:`hang` (sleeps, never raises)."""
 
 
+class InjectedDeviceLoss(Exception):
+    """Looks like a dead chip / torn ICI link; classified
+    DEGRADABLE_DEVICE — the elastic mesh-degradation rungs respond."""
+
+
 def transient_error() -> Exception:
     return InjectedTransientError(
         "UNAVAILABLE: socket closed; failed to connect to remote runtime "
@@ -70,6 +83,44 @@ def oom_error() -> Exception:
 
 def preemption() -> Exception:
     return SimulatedPreemption("worker preempted (injected fault)")
+
+
+def device_loss(chip: int = 2) -> Exception:
+    """A device/ICI failure mid-collective — classified by MESSAGE through
+    the real classifier (DATA_LOSS status + device-failure phrase), like
+    the other factories: the production taxonomy is the code under test."""
+    return InjectedDeviceLoss(
+        f"DATA_LOSS: device failure on chip {chip}: ICI link down during "
+        "all-gather (injected fault)"
+    )
+
+
+def poison_labels(shard: int, num_shards: int, value: int = -7):
+    """A ctx-aware MUTATOR (not an error factory): silently corrupts the
+    driver's in-memory label state — shard ``shard`` of a ``num_shards``
+    split is overwritten with ``value`` (an out-of-vertex-range id, i.e. a
+    wrapped gather index / torn collective) — and lets the superstep run.
+    Nothing raises here: the point is exercising the divergence TRIPWIRES,
+    which must catch the garbage the fault did NOT announce. Install at a
+    site whose ctx carries ``state`` (the driver's ``lpa_superstep``)."""
+
+    def _mutate(**ctx):
+        import numpy as np
+
+        state = ctx.get("state")
+        if state is None or "labels" not in state:
+            raise ValueError(
+                "poison_labels needs a fault site whose ctx carries the "
+                "driver's mutable state (lpa_superstep)"
+            )
+        labels = np.asarray(state["labels"]).copy()
+        chunk = -(-len(labels) // num_shards)
+        labels[shard * chunk: (shard + 1) * chunk] = value
+        state["labels"] = labels
+        return None  # no error raised — the corruption is silent
+
+    _mutate.wants_ctx = True
+    return _mutate
 
 
 # Parked hang() sleepers, each waiting on its OWN event. A single shared
@@ -152,8 +203,13 @@ class FaultInjector:
         for r in self.rules:
             if r.site == site and r.at <= n < r.at + r.repeat:
                 r.fired += 1
-                out = r.factory()
-                if out is not None:  # hang() sleepers return None
+                # ctx-aware mutators (poison_labels) corrupt state in
+                # place instead of raising; plain factories get no ctx.
+                if getattr(r.factory, "wants_ctx", False):
+                    out = r.factory(**ctx)
+                else:
+                    out = r.factory()
+                if out is not None:  # hang()/mutators return None
                     raise out
 
     @contextlib.contextmanager
@@ -192,3 +248,29 @@ def truncate_file(path: str, keep_fraction: float = 0.5) -> None:
     size = os.path.getsize(path)
     with open(path, "r+b") as f:
         f.truncate(int(size * keep_fraction))
+
+
+def corrupt_shard(checkpoint_dir: str, shard: int, tag: str = "lpa") -> str:
+    """Flip bytes inside ONE shard file of the current sharded-checkpoint
+    generation (manifest format, ``pipeline/checkpoint.py:save_sharded``)
+    — the torn-multi-file case the per-shard sha256 exists for. Returns
+    the damaged path."""
+    from graphmine_tpu.pipeline import checkpoint as ckpt
+
+    path = ckpt.shard_file(ckpt.sharded_dir(checkpoint_dir, tag), shard)
+    corrupt_file(path)
+    return path
+
+
+def corrupt_manifest(checkpoint_dir: str, tag: str = "lpa") -> str:
+    """Flip bytes inside the manifest of the current sharded-checkpoint
+    generation (still-parseable JSON with a wrong checksum, or broken
+    JSON, depending on where the flip lands — both must roll back).
+    Returns the damaged path."""
+    from graphmine_tpu.pipeline import checkpoint as ckpt
+
+    path = os.path.join(
+        ckpt.sharded_dir(checkpoint_dir, tag), ckpt.MANIFEST_NAME
+    )
+    corrupt_file(path)
+    return path

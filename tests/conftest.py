@@ -59,8 +59,10 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
-        "faults: fault-injection resilience suite (tests/test_resilience.py); "
-        "runs in the default CPU pass — select with -m faults",
+        "faults: fault-injection resilience suite (tests/test_resilience.py "
+        "plus the tripwire/reshard cases in tests/test_sharded.py); runs in "
+        "the default CPU pass — select with -m faults or "
+        "tools/run_tier1.sh --faults-only",
     )
     if not (_needs_reexec() and _invoked_as_pytest_cli()):
         return

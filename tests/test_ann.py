@@ -144,3 +144,31 @@ def test_lof_ivf_tracks_exact(clouds):
         approx = np.asarray(lof_scores(pts, k=32, impl="ivf"))
         frac_close = np.mean(np.abs(exact - approx) < 0.05 * np.abs(exact) + 0.01)
         assert frac_close > 0.95, (cloud, frac_close)
+
+
+def test_ivf_guard_fallback_warns_and_records():
+    """ADVICE r5: a pathology guard routing ivf_knn to the exact path
+    must warn and (with a sink) emit an ivf_fallback record naming the
+    guard — a silent bypass once mislabeled bench timings as 'ivf'."""
+    from graphmine_tpu.ops.ann import ivf_knn
+    from graphmine_tpu.ops.knn import knn
+    from graphmine_tpu.pipeline.metrics import MetricsSink
+
+    rng = np.random.default_rng(0)
+    pts = rng.normal(size=(64, 4)).astype(np.float32)
+    m = MetricsSink()
+    with pytest.warns(UserWarning, match="ivf_knn guard"):
+        d2, idx = ivf_knn(pts, k=40, n_clusters=8, sink=m)
+    rec = m.of_phase("ivf_fallback")
+    assert rec and rec[0]["guard"] == "k_unfillable"
+    assert "k=40" in rec[0]["detail"]
+    # the fallback result IS the exact result
+    d2x, _ = knn(pts, 40, impl="auto")
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(d2x), atol=1e-5)
+    # lof_scores threads the sink through to the same record
+    from graphmine_tpu.ops.lof import lof_scores
+
+    m2 = MetricsSink()
+    with pytest.warns(UserWarning, match="ivf_knn guard"):
+        lof_scores(pts, k=40, impl="ivf", sink=m2)
+    assert m2.of_phase("ivf_fallback")

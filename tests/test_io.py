@@ -488,3 +488,31 @@ def test_ingestion_paths_fuzz_agreement(tmp_path):
                 native.load_edge_list_chunked(
                     bad_path, weight_col=wc, chunk_bytes=chunk
                 )
+
+
+def test_column_codes_is_null_safe_standalone():
+    """ADVICE r5: _column_codes must never intern None as a vertex id —
+    nulls are dropped in BOTH the dictionary fast path and the per-row
+    fallback, so a caller that forgot the row filter cannot poison the
+    vocabulary (the loaders still pre-filter for row alignment)."""
+    import pytest
+
+    pa = pytest.importorskip("pyarrow")
+
+    from graphmine_tpu.io.edges import _column_codes
+    from graphmine_tpu.io.factorize import IncrementalFactorizer
+
+    # per-row (non-dictionary) path with nulls
+    interner = IncrementalFactorizer()
+    codes = _column_codes(
+        pa.chunked_array([pa.array(["a", None, "b", "a", None])]), interner
+    )
+    assert codes.tolist() == [0, 1, 0]  # 3 non-null rows, a -> 0, b -> 1
+    assert all(isinstance(n, str) for n in interner.names())
+
+    # dictionary-encoded path with nulls takes the fast path post-drop
+    dcol = pa.array(["x", None, "y", "x"]).dictionary_encode()
+    codes2 = _column_codes(dcol, interner)
+    assert len(codes2) == 3
+    names = list(interner.names())
+    assert names == ["a", "b", "x", "y"] and None not in names

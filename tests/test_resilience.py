@@ -736,3 +736,395 @@ def test_quarantine_null_rows_parquet(tmp_path):
     # streaming ingestion counts the same quarantine
     et_s = load_parquet_edges(p, batch_rows=2)
     assert et_s.quarantine == {"null_rows": 3}
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 2: device-loss taxonomy, elastic mesh degradation, shard-aware
+# checkpoints, divergence tripwires — every new fault site exercised here
+# under the `faults` marker (file-level pytestmark)
+# ---------------------------------------------------------------------------
+
+
+def test_classify_device_loss_and_divergence():
+    assert classify_error(faults.device_loss()) == resilience.DEGRADABLE_DEVICE
+    # message-classified, like real PJRT reports — status prefix or phrase
+    assert classify_error(
+        RuntimeError("DATA_LOSS: checkpoint shard unreadable")
+    ) == resilience.DEGRADABLE_DEVICE
+    assert classify_error(
+        RuntimeError("UNAVAILABLE: device failure on chip 0")
+    ) == resilience.DEGRADABLE_DEVICE  # device markers beat retryable ones
+    # a fatal error QUOTING a status token must not classify as device loss
+    assert classify_error(
+        ValueError("failed reading /data/DATA_LOSS_run/x")
+    ) == FATAL
+
+    de = resilience.DivergenceError("nonfinite_ranks", 3, 7)
+    assert classify_error(de) == RETRYABLE
+    assert de.kind == "nonfinite_ranks" and de.shard == 3 and de.iteration == 7
+    # re-wrapped by an XLA callback boundary: still retryable via marker
+    assert classify_error(
+        RuntimeError(f"INTERNAL: CpuCallback error: {de}")
+    ) == RETRYABLE
+
+
+def test_tripwire_config_validation():
+    ResilienceConfig(tripwire_every_k=4).validate()
+    with pytest.raises(ValueError):
+        ResilienceConfig(tripwire_every_k=-1).validate()
+
+
+def test_run_phase_device_ladder_is_independent_of_memory_ladder():
+    """An OOM walks the memory rungs, a device loss walks the device
+    rungs; one run can walk both without either family consuming the
+    other's rungs."""
+    m = MetricsSink()
+
+    def primary():
+        raise faults.oom_error()
+
+    def mem_rung():
+        raise faults.device_loss()
+
+    out = run_phase(
+        "p", primary, ResilienceConfig(), m,
+        ladder=(("leaner", mem_rung),),
+        device_ladder=(("half-mesh", lambda: "elastic-ok"),),
+        sleep=_no_sleep,
+    )
+    assert out == "elastic-ok"
+    deg = m.of_phase("degrade")
+    assert [d["to"] for d in deg] == ["leaner", "half-mesh"]
+    assert "kind" not in deg[0] and deg[1]["kind"] == "device"
+
+    # device ladder exhausted -> the device-loss error surfaces
+    with pytest.raises(faults.InjectedDeviceLoss):
+        run_phase(
+            "p", lambda: (_ for _ in ()).throw(faults.device_loss()),
+            ResilienceConfig(), MetricsSink(), sleep=_no_sleep,
+        )
+
+    # degradation="off" surfaces device loss without touching the ladder
+    with pytest.raises(faults.InjectedDeviceLoss):
+        run_phase(
+            "p", lambda: (_ for _ in ()).throw(faults.device_loss()),
+            ResilienceConfig(degradation="off"), MetricsSink(),
+            device_ladder=(("half-mesh", lambda: "nope"),),
+            sleep=_no_sleep,
+        )
+
+
+# ---------------------------------------------------------------------------
+# sharded manifest checkpoints (API level)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_checkpoint_shard_corruption_rolls_back(tmp_path):
+    """Corrupting any single shard file triggers rollback to the .prev
+    generation (condemned generation preserved), never a crash or a
+    silent bad resume."""
+    d = str(tmp_path)
+    good = np.arange(64, dtype=np.int32) % 11
+    ckpt.save_sharded(d, good, 3, fingerprint="fp", num_shards=4)
+    ckpt.save_sharded(d, good * 0, 4, fingerprint="fp", num_shards=4)
+    faults.corrupt_shard(d, shard=2)
+    m = MetricsSink()
+    labels, it = ckpt.load_sharded(d, fingerprint="fp", sink=m)
+    np.testing.assert_array_equal(labels, good)
+    assert it == 3
+    assert m.of_phase("checkpoint_rollback") and m.of_phase("checkpoint_rollback_ok")
+    # promoted back to the current slot; condemned dir kept for forensics
+    labels2, it2 = ckpt.load_sharded(d, fingerprint="fp")
+    assert it2 == 3
+    assert os.path.isdir(ckpt.sharded_dir(d) + ".corrupt")
+
+
+def test_sharded_checkpoint_manifest_corruption_rolls_back(tmp_path):
+    d = str(tmp_path)
+    good = np.arange(32, dtype=np.int32)
+    ckpt.save_sharded(d, good, 1, num_shards=2)
+    ckpt.save_sharded(d, good + 1, 2, num_shards=2)
+    faults.corrupt_manifest(d)
+    labels, it = ckpt.load_sharded(d, sink=MetricsSink())
+    assert it == 1
+    np.testing.assert_array_equal(labels, good)
+
+
+def test_sharded_checkpoint_both_generations_corrupt_is_clean_failure(tmp_path):
+    d = str(tmp_path)
+    ckpt.save_sharded(d, np.arange(8, dtype=np.int32), 1, num_shards=2)
+    ckpt.save_sharded(d, np.arange(8, dtype=np.int32), 2, num_shards=2)
+    faults.corrupt_shard(d, shard=0)
+    faults.corrupt_file(os.path.join(
+        ckpt.sharded_dir(d) + ".prev", "shard_00001.npy"
+    ))
+    with pytest.raises(ckpt.CheckpointCorruptionError, match="both"):
+        ckpt.load_sharded(d)
+
+
+def test_sharded_checkpoint_wrong_fingerprint_refuses_without_rollback(tmp_path):
+    """A wrong-graph manifest must refuse — and must NOT roll back (every
+    generation indexes the same wrong graph)."""
+    d = str(tmp_path)
+    ckpt.save_sharded(d, np.arange(8, dtype=np.int32), 1, fingerprint="A",
+                      num_shards=2)
+    ckpt.save_sharded(d, np.arange(8, dtype=np.int32), 2, fingerprint="A",
+                      num_shards=2)
+    m = MetricsSink()
+    with pytest.raises(ckpt.FingerprintMismatch, match="different graph"):
+        ckpt.load_sharded(d, fingerprint="B", sink=m)
+    assert not m.of_phase("checkpoint_rollback")
+    # both generations intact afterwards
+    labels, it = ckpt.load_sharded(d, fingerprint="A")
+    assert it == 2
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: elastic mesh degradation + tripwires through the driver
+# (8 virtual CPU devices via conftest; runs use a 4-device mesh)
+# ---------------------------------------------------------------------------
+
+
+def _cfg4(**kw):
+    base = dict(num_devices=4, max_iter=5)
+    base.update(kw)
+    return _cfg(**base)
+
+
+def test_device_loss_mid_lpa_degrades_mesh_and_completes(tmp_path):
+    """A device-loss error at superstep 3 on a 4-device mesh walks the
+    ELASTIC ladder: re-partition onto 2 devices, resume from the last
+    good superstep, finish with labels identical to the no-fault run —
+    and the distributed run checkpoints in the sharded manifest format."""
+    from graphmine_tpu.pipeline.driver import run_pipeline
+
+    ck = str(tmp_path / "ck")
+    inj = faults.FaultInjector()
+    inj.add("lpa_superstep", faults.device_loss, at=3)
+    with inj.installed():
+        res = run_pipeline(_cfg4(checkpoint_dir=ck))
+    np.testing.assert_array_equal(res.labels, _baseline_labels())
+    deg = res.metrics.of_phase("degrade")
+    assert deg and deg[0]["kind"] == "device"
+    assert deg[0]["to"] == "elastic@2dev"
+    md0 = res.metrics.of_phase("mesh_degrade")[0]
+    assert md0["schedule"] == "replicated"  # the variant current at descent
+    md = res.metrics.of_phase("mesh_degrade")
+    assert md and md[0]["from_devices"] == 4 and md[0]["to_devices"] == 2
+    assert md[0]["iteration"] == 2  # resumed from the last good superstep
+    # the implicated chip (parsed from the error message) is excluded
+    # from the rebuilt rung meshes (mesh.surviving_mesh routing)
+    assert md[0]["dead_devices"] == [2]
+    iters = [r["iteration"] for r in res.metrics.of_phase("lpa_iter")]
+    assert iters == [1, 2, 3, 4, 5]
+    # the distributed rungs wrote the manifest format
+    assert os.path.isdir(ckpt.sharded_dir(ck))
+    saved = ckpt.load_sharded(ck)
+    assert saved is not None and saved[1] == 5
+
+
+def test_repeated_device_loss_walks_to_one_device(tmp_path):
+    """Losing chips twice descends 4 -> 2 -> 1 (the single-device sort
+    kernel floor) and still completes identically."""
+    from graphmine_tpu.pipeline.driver import run_pipeline
+
+    inj = faults.FaultInjector()
+    inj.add("lpa_superstep", faults.device_loss, at=2)
+    inj.add("lpa_superstep", faults.device_loss, at=4)
+    with inj.installed():
+        res = run_pipeline(_cfg4(checkpoint_dir=str(tmp_path / "ck")))
+    np.testing.assert_array_equal(res.labels, _baseline_labels())
+    md = res.metrics.of_phase("mesh_degrade")
+    assert [(r["from_devices"], r["to_devices"]) for r in md] == [(4, 2), (2, 1)]
+
+
+def test_kill_at_superstep_resumes_on_fewer_devices(tmp_path):
+    """Acceptance: kill (preemption) at superstep 3 of a 4-device run ->
+    a NEW run restores the sharded checkpoint onto 2 devices (re-shard on
+    restore) -> final labels bit-identical to the uninterrupted run."""
+    from graphmine_tpu.pipeline.driver import run_pipeline
+
+    ck = str(tmp_path / "ck")
+    inj = faults.FaultInjector()
+    inj.add("lpa_superstep", faults.preemption, at=3)
+    with inj.installed():
+        with pytest.raises(faults.SimulatedPreemption):
+            run_pipeline(_cfg4(checkpoint_dir=ck))
+    saved = ckpt.load_sharded(ck)
+    assert saved is not None and saved[1] == 2  # last good superstep
+    # the replacement mesh has half the chips
+    res = run_pipeline(_cfg4(checkpoint_dir=ck, resume=True, num_devices=2))
+    np.testing.assert_array_equal(res.labels, _baseline_labels())
+    resume = res.metrics.of_phase("resume")
+    assert resume and resume[0]["iteration"] == 2
+
+
+def test_poisoned_shard_trips_wire_rolls_back_and_completes(tmp_path):
+    """Acceptance: silently corrupted labels in one shard (no error
+    raised by the fault!) are caught by the tripwire within K supersteps,
+    recorded with the offending shard index, rolled back to the last
+    checkpoint, and the retried run completes identically."""
+    from graphmine_tpu.pipeline.driver import run_pipeline
+
+    ck = str(tmp_path / "ck")
+    inj = faults.FaultInjector()
+    inj.add("lpa_superstep", faults.poison_labels(shard=1, num_shards=4), at=3)
+    cfg = _cfg4(
+        checkpoint_dir=ck,
+        resilience=ResilienceConfig(
+            backoff_base_s=0.001, backoff_max_s=0.01, tripwire_every_k=1,
+        ),
+    )
+    with inj.installed():
+        res = run_pipeline(cfg)
+    assert inj.fired() == 1
+    np.testing.assert_array_equal(res.labels, _baseline_labels())
+    tw = res.metrics.of_phase("tripwire")
+    assert tw and tw[0]["kind"] == "label_out_of_range"
+    assert tw[0]["iteration"] == 3 and 0 <= tw[0]["shard"] < 4
+    assert tw[0]["bad_vertices"] > 0
+    # rolled back to the superstep-2 checkpoint, then retried through
+    resume = res.metrics.of_phase("resume")
+    assert resume and resume[0]["iteration"] == 2
+    assert resume[0]["reason"] == "tripwire"
+    assert res.metrics.of_phase("retry")
+
+
+def test_poisoned_shard_without_checkpoint_still_raises(tmp_path):
+    """No checkpoint_dir: the tripwire still refuses to return garbage —
+    the run dies with the classified DivergenceError (here: retries
+    exhausted re-deriving from the same poisoned state) rather than
+    silently converging to nonsense."""
+    from graphmine_tpu.pipeline.driver import run_pipeline
+
+    inj = faults.FaultInjector()
+    inj.add("lpa_superstep", faults.poison_labels(shard=0, num_shards=4), at=2)
+    cfg = _cfg4(resilience=ResilienceConfig(
+        max_retries=1, backoff_base_s=0.001, backoff_max_s=0.01,
+        tripwire_every_k=1,
+    ))
+    with inj.installed():
+        with pytest.raises(resilience.RetriesExhausted) as ei:
+            run_pipeline(cfg)
+    assert isinstance(ei.value.__cause__, resilience.DivergenceError)
+
+
+def test_load_newest_survives_one_corrupt_format(tmp_path):
+    """One checkpoint format corrupt beyond its own rollback must not
+    veto the other: load_newest holds the corruption error, tries the
+    other format, and only re-raises when NOTHING loads."""
+    d = str(tmp_path)
+    # sharded format: both generations destroyed
+    ckpt.save_sharded(d, np.arange(16, dtype=np.int32), 3, num_shards=2)
+    ckpt.save_sharded(d, np.arange(16, dtype=np.int32), 4, num_shards=2)
+    faults.corrupt_shard(d, shard=0)
+    faults.corrupt_file(os.path.join(
+        ckpt.sharded_dir(d) + ".prev", "shard_00000.npy"
+    ))
+    # npz format: intact, older iteration — still the right answer
+    good = np.arange(16, dtype=np.int32) * 2
+    ckpt.save_labels(d, good, 2)
+    labels, it = ckpt.load_newest(d, sink=MetricsSink())
+    assert it == 2
+    np.testing.assert_array_equal(labels, good)
+    # the higher iteration wins when both formats are healthy
+    ckpt.save_sharded(d, good + 1, 9, num_shards=2)
+    labels2, it2 = ckpt.load_newest(d)
+    assert it2 == 9
+    # nothing loadable at all -> the held corruption error surfaces
+    import shutil
+
+    shutil.rmtree(ckpt.sharded_dir(d))
+    shutil.rmtree(ckpt.sharded_dir(d) + ".prev", ignore_errors=True)
+    ckpt.save_sharded(d, good, 1, num_shards=2)
+    faults.corrupt_shard(d, shard=0)
+    for f in ("lpa_labels.npz", "lpa_labels.prev.npz"):
+        if os.path.exists(os.path.join(d, f)):
+            os.remove(os.path.join(d, f))
+    with pytest.raises(ckpt.CheckpointCorruptionError):
+        ckpt.load_newest(d)
+    # empty dir -> None
+    assert ckpt.load_newest(str(tmp_path / "nothing")) is None
+
+
+def test_save_sharded_sweeps_orphaned_tmp_generations(tmp_path):
+    """A SIGKILL mid-save leaves <gen>.tmp.<pid> behind; the next save —
+    from a NEW pid in the crash-resume loop — must sweep it rather than
+    leak one label-vector copy per kill."""
+    d = str(tmp_path)
+    orphan = ckpt.sharded_dir(d) + ".tmp.99999"
+    os.makedirs(orphan)
+    with open(os.path.join(orphan, "shard_00000.npy"), "wb") as f:
+        f.write(b"leftover bytes from a killed save")
+    ckpt.save_sharded(d, np.arange(8, dtype=np.int32), 1, num_shards=2)
+    assert not os.path.exists(orphan)
+    leftovers = [p for p in os.listdir(d) if ".tmp." in p]
+    assert leftovers == []
+
+
+def test_device_loss_after_memory_degradation_keeps_the_leaner_schedule(tmp_path):
+    """A memory degradation already moved the run replicated -> ring;
+    a later chip loss must rebuild RING on the smaller mesh — re-running
+    the schedule that just OOM'd would burn the descent on a rung whose
+    memory ladder is already consumed."""
+    from graphmine_tpu.pipeline.driver import run_pipeline
+
+    inj = faults.FaultInjector()
+    inj.add("lpa_superstep", faults.oom_error, at=2)
+    inj.add("lpa_superstep", faults.device_loss, at=4)
+    with inj.installed():
+        res = run_pipeline(_cfg4(checkpoint_dir=str(tmp_path / "ck")))
+    np.testing.assert_array_equal(res.labels, _baseline_labels())
+    deg = res.metrics.of_phase("degrade")
+    assert [(d["to"], d.get("kind")) for d in deg] == [
+        ("ring", None), ("elastic@2dev", "device"),
+    ]
+    md = res.metrics.of_phase("mesh_degrade")
+    assert md and md[0]["schedule"] == "ring"  # the rescued variant survives
+
+
+def test_checkpointed_supersteps_are_always_tripwire_guarded(tmp_path):
+    """A superstep that will checkpoint is guarded even off the K
+    cadence: persisting unverified labels would rotate the last
+    validated generation away, and the tripwire's rollback would then
+    restore intact-but-garbage bytes."""
+    from graphmine_tpu.pipeline.driver import run_pipeline
+
+    inj = faults.FaultInjector()
+    # poison lands at superstep 3 — NOT a multiple of tripwire_every_k=2,
+    # but checkpoint_every=1 means superstep 3 would be persisted
+    inj.add("lpa_superstep", faults.poison_labels(shard=1, num_shards=4), at=3)
+    cfg = _cfg4(
+        checkpoint_dir=str(tmp_path / "ck"),
+        resilience=ResilienceConfig(
+            backoff_base_s=0.001, backoff_max_s=0.01, tripwire_every_k=2,
+        ),
+    )
+    with inj.installed():
+        res = run_pipeline(cfg)
+    np.testing.assert_array_equal(res.labels, _baseline_labels())
+    tw = res.metrics.of_phase("tripwire")
+    assert tw and tw[0]["iteration"] == 3  # caught AT the save boundary
+    # the rollback restored superstep 2, proving no garbage was persisted
+    resume = res.metrics.of_phase("resume")
+    assert resume and resume[0]["iteration"] == 2
+
+
+def test_legacy_orbax_checkpoint_refuses_loudly(tmp_path):
+    """A checkpoint written by the removed orbax format must not read as
+    'no checkpoint' — silently restarting a multi-day run from iteration
+    0 across the upgrade would discard every superstep."""
+    d = str(tmp_path)
+    os.makedirs(os.path.join(d, "lpa_orbax"))
+    with pytest.raises(ckpt.CheckpointCorruptionError, match="orbax"):
+        ckpt.load_sharded(d)
+    with pytest.raises(ckpt.CheckpointCorruptionError, match="orbax"):
+        ckpt.load_newest(d)
+    # a valid checkpoint in a CURRENT format still wins (the orbax dir is
+    # then stale leftovers, not the resume point)
+    ckpt.save_labels(d, np.arange(8, dtype=np.int32), 4)
+    labels, it = ckpt.load_newest(d)
+    assert it == 4
+    ckpt.save_sharded(d, np.arange(8, dtype=np.int32), 6, num_shards=2)
+    _, it2 = ckpt.load_newest(d)
+    assert it2 == 6

@@ -315,3 +315,208 @@ def test_bucket_plan_matches_class_rows_reference():
             np.testing.assert_array_equal(np.asarray(a), b)
         for a, b in zip(sg.bucket_target, ref_tgt):
             np.testing.assert_array_equal(np.asarray(a), b)
+
+
+# ---------------------------------------------------------------------------
+# shard-aware checkpoint: reshard-on-restore parity (ISSUE 2)
+# ---------------------------------------------------------------------------
+
+
+def test_reshard_restore_parity_lpa_cc(mesh8, rng, tmp_path):
+    """Acceptance: kill at superstep N on 4 devices -> sharded manifest
+    checkpoint -> restore onto 2 devices -> final LPA/CC labels
+    bit-identical to the uninterrupted 4-device run."""
+    import jax.numpy as jnp
+
+    from graphmine_tpu.parallel.sharded import sharded_connected_components
+    from graphmine_tpu.pipeline import checkpoint as ckpt
+
+    mesh4, mesh2 = make_mesh(4), make_mesh(2)
+    v, e = 120, 600
+    src, dst = _random_graph(rng, v, e)
+    g = build_graph(src, dst, num_vertices=v)
+    sg4 = shard_graph_arrays(partition_graph(g, mesh=mesh4), mesh4)
+    sg2 = shard_graph_arrays(partition_graph(g, mesh=mesh2), mesh2)
+
+    # --- LPA: 6 supersteps uninterrupted vs 3 + (checkpoint, reshard) + 3
+    want = np.asarray(sharded_label_propagation(sg4, mesh4, max_iter=6))
+    mid = np.asarray(sharded_label_propagation(sg4, mesh4, max_iter=3))
+    d = str(tmp_path / "ck_lpa")
+    ckpt.save_sharded(d, mid, 3, num_shards=4)
+    restored, it = ckpt.load_sharded(d)
+    assert it == 3
+    got = np.asarray(sharded_label_propagation(
+        sg2, mesh2, max_iter=3, init_labels=jnp.asarray(restored)
+    ))
+    np.testing.assert_array_equal(got, want)
+
+    # --- CC: fixpoint uninterrupted vs 2 bounded supersteps + resume
+    want_cc = np.asarray(sharded_connected_components(sg4, mesh4))
+    mid_cc = np.asarray(sharded_connected_components(sg4, mesh4, max_iter=2))
+    d2 = str(tmp_path / "ck_cc")
+    ckpt.save_sharded(d2, mid_cc, 2, num_shards=4)
+    restored_cc, _ = ckpt.load_sharded(d2)
+    got_cc = np.asarray(sharded_connected_components(
+        sg2, mesh2, init_labels=jnp.asarray(restored_cc)
+    ))
+    np.testing.assert_array_equal(got_cc, want_cc)
+
+
+def test_reshard_restore_parity_pagerank(mesh8, tmp_path):
+    """PageRank mid-run reshard-restore (4 -> 2 devices): the resumed
+    power iteration matches the uninterrupted trajectory."""
+    import jax.numpy as jnp
+
+    from graphmine_tpu.ops.degrees import out_degrees
+    from graphmine_tpu.parallel.sharded import sharded_pagerank
+    from graphmine_tpu.pipeline import checkpoint as ckpt
+
+    mesh4, mesh2 = make_mesh(4), make_mesh(2)
+    rng = np.random.default_rng(23)
+    v, e = 150, 700
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    g = build_graph(src, dst, num_vertices=v, symmetric=False)
+    od = out_degrees(g)
+    sg4 = shard_graph_arrays(partition_graph(g, mesh=mesh4), mesh4)
+    sg2 = shard_graph_arrays(partition_graph(g, mesh=mesh2), mesh2)
+
+    # tol=0 pins the iteration count so 30 == 10 + 20 exactly
+    want = np.asarray(sharded_pagerank(sg4, mesh4, od, max_iter=30, tol=0.0))
+    mid = np.asarray(sharded_pagerank(sg4, mesh4, od, max_iter=10, tol=0.0))
+    d = str(tmp_path / "ck_pr")
+    ckpt.save_sharded(d, mid, 10, num_shards=4)
+    restored, it = ckpt.load_sharded(d)
+    assert it == 10 and restored.dtype == np.float32
+    got = np.asarray(sharded_pagerank(
+        sg2, mesh2, od, max_iter=20, tol=0.0,
+        init_ranks=jnp.asarray(restored),
+    ))
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    assert abs(got.sum() - 1.0) < 1e-4
+
+
+# ---------------------------------------------------------------------------
+# in-loop divergence tripwires (ISSUE 2) — direct sharded-op API
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.faults
+def test_tripwires_are_silent_on_clean_runs(mesh8, rng):
+    """Armed tripwires must not change the labels/ranks of a healthy run
+    (the guard is observation-only until it fires)."""
+    from graphmine_tpu.parallel.sharded import sharded_connected_components
+
+    v, e = 80, 350
+    src, dst = _random_graph(rng, v, e)
+    g = build_graph(src, dst, num_vertices=v)
+    sg = shard_graph_arrays(partition_graph(g, mesh=mesh8), mesh8)
+    np.testing.assert_array_equal(
+        np.asarray(sharded_label_propagation(sg, mesh8, max_iter=4)),
+        np.asarray(sharded_label_propagation(
+            sg, mesh8, max_iter=4, tripwire_every=2
+        )),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sharded_connected_components(sg, mesh8)),
+        np.asarray(sharded_connected_components(sg, mesh8, tripwire_every=3)),
+    )
+
+
+@pytest.mark.faults
+def test_lpa_tripwire_catches_label_out_of_range(mesh8, rng):
+    import jax.numpy as jnp
+
+    from graphmine_tpu.pipeline.resilience import DivergenceError
+
+    mesh4 = make_mesh(4)
+    v, e = 64, 300
+    src, dst = _random_graph(rng, v, e)
+    g = build_graph(src, dst, num_vertices=v)
+    sg = shard_graph_arrays(partition_graph(g, mesh=mesh4), mesh4)
+    bad = np.arange(v, dtype=np.int32)
+    bad[40:48] = 10_000  # wrapped gather index / torn collective
+    with pytest.raises(DivergenceError) as ei:
+        sharded_label_propagation(
+            sg, mesh4, max_iter=4, init_labels=jnp.asarray(bad),
+            tripwire_every=1,
+        )
+    assert ei.value.kind == "label_out_of_range"
+    assert 0 <= ei.value.shard < 4 and ei.value.iteration >= 1
+
+
+@pytest.mark.faults
+def test_lpa_tripwire_catches_oscillation(mesh8):
+    """Synchronous LPA livelock (bipartite period-2 swap) is detected
+    instead of burning max_iter and returning a silently-unstable state."""
+    from graphmine_tpu.pipeline.resilience import DivergenceError
+
+    mesh2 = make_mesh(2)
+    # K2: the two labels swap every superstep, forever
+    src = np.array([0, 1], np.int32)
+    dst = np.array([1, 0], np.int32)
+    g = build_graph(src, dst, num_vertices=2)
+    sg = shard_graph_arrays(partition_graph(g, mesh=mesh2), mesh2)
+    with pytest.raises(DivergenceError) as ei:
+        sharded_label_propagation(sg, mesh2, max_iter=6, tripwire_every=1)
+    assert ei.value.kind == "oscillation"
+    # unarmed: the historical behavior (runs to max_iter) is untouched
+    out = np.asarray(sharded_label_propagation(sg, mesh2, max_iter=6))
+    assert out.shape == (2,)
+
+
+@pytest.mark.faults
+def test_pagerank_tripwire_catches_nan_with_shard_attribution(mesh8):
+    """NaN injected into ONE shard's messages is caught and attributed to
+    that shard — NaN ends the loop 'converged' (delta>tol is False), so
+    the exit guard must catch what the cadence guard misses."""
+    import dataclasses
+
+    from graphmine_tpu.ops.degrees import out_weights
+    from graphmine_tpu.parallel.sharded import sharded_pagerank
+    from graphmine_tpu.pipeline.resilience import DivergenceError
+
+    mesh4 = make_mesh(4)
+    rng = np.random.default_rng(3)
+    v, e = 64, 300
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    w = rng.uniform(0.5, 2.0, e).astype(np.float32)
+    g = build_graph(src, dst, num_vertices=v, edge_weights=w, symmetric=False)
+    sg_host = partition_graph(g, mesh=mesh4)
+    mw = np.asarray(sg_host.msg_weight).copy()
+    mw[2, :4] = np.nan  # poison shard 2
+    sg = shard_graph_arrays(
+        dataclasses.replace(sg_host, msg_weight=mw), mesh4
+    )
+    ow = out_weights(g)
+    # clean weighted run passes with the wire armed
+    clean = np.asarray(sharded_pagerank(
+        shard_graph_arrays(sg_host, mesh4), mesh4, ow, max_iter=20,
+        tripwire_every=2,
+    ))
+    assert np.isfinite(clean).all()
+    with pytest.raises(DivergenceError) as ei:
+        sharded_pagerank(sg, mesh4, ow, max_iter=20, tripwire_every=2)
+    assert ei.value.kind == "nonfinite_ranks" and ei.value.shard == 2
+
+
+@pytest.mark.faults
+def test_cc_tripwire_catches_out_of_range_init(mesh8):
+    import jax.numpy as jnp
+
+    from graphmine_tpu.parallel.sharded import sharded_connected_components
+    from graphmine_tpu.pipeline.resilience import DivergenceError
+
+    mesh4 = make_mesh(4)
+    src = np.arange(0, 30, dtype=np.int32)
+    dst = (src + 1) % 31
+    g = build_graph(src, dst, num_vertices=31)
+    sg = shard_graph_arrays(partition_graph(g, mesh=mesh4), mesh4)
+    bad = np.arange(31, dtype=np.int32)
+    bad[5] = -9  # min-propagation keeps a negative forever
+    with pytest.raises(DivergenceError) as ei:
+        sharded_connected_components(
+            sg, mesh4, init_labels=jnp.asarray(bad), tripwire_every=1
+        )
+    assert ei.value.kind == "label_out_of_range"

@@ -4,16 +4,25 @@
 # Runs the full CPU test suite (excluding @slow) with collection errors
 # surfaced instead of aborting the run, and prints the passed-dot count
 # the roadmap uses as its no-regression floor. The fault-injection suite
-# (-m faults, tests/test_resilience.py) is part of this default pass.
+# (-m faults: tests/test_resilience.py + the tripwire/reshard cases in
+# tests/test_sharded.py) is part of this default pass.
 #
-# Usage: tools/run_tier1.sh [extra pytest args...]
+# Usage: tools/run_tier1.sh [--faults-only] [extra pytest args...]
+#   --faults-only  run just the `faults`-marked recovery suite — the fast
+#                  pre-commit loop when iterating on resilience paths
 set -o pipefail
 cd "$(dirname "$0")/.."
+
+MARKER='not slow'
+if [ "${1:-}" = "--faults-only" ]; then
+    shift
+    MARKER='faults and not slow'
+fi
 
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 rm -f "$LOG"
 timeout -k 10 "${TIER1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu \
-    python -m pytest tests/ -q -m 'not slow' \
+    python -m pytest tests/ -q -m "$MARKER" \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly \
     "$@" 2>&1 | tee "$LOG"

@@ -81,9 +81,27 @@ def _cli(data: str, ckpt_dir: str, resume: bool = False) -> list[str]:
     return argv
 
 
+def _ckpt_artifacts(ckpt_dir: str) -> list[str]:
+    """Paths whose existence marks a landed checkpoint: the npz
+    (single-device runs) or the sharded manifest (multi-device runs write
+    the manifest format since ISSUE 2)."""
+    return [
+        os.path.join(ckpt_dir, "lpa_labels.npz"),
+        os.path.join(ckpt_dir, "lpa_sharded", "manifest.json"),
+    ]
+
+
 def _load_ckpt(ckpt_dir: str):
-    with np.load(os.path.join(ckpt_dir, "lpa_labels.npz")) as z:
-        return z["labels"].copy(), int(z["iteration"])
+    """Newest state across both checkpoint formats — the same
+    checkpoint.load_newest the driver's --resume uses, so this tool can
+    never accept a checkpoint the driver would reject."""
+    from graphmine_tpu.pipeline import checkpoint as ckpt
+
+    out = ckpt.load_newest(ckpt_dir)
+    if out is None:
+        raise FileNotFoundError(f"no checkpoint in {ckpt_dir!r}")
+    labels, it = out
+    return np.asarray(labels), it
 
 
 def main() -> int:
@@ -107,13 +125,13 @@ def main() -> int:
 
         # 2. killed run: SIGKILL as soon as the first checkpoint lands
         # (plus one beat so the kill interrupts a LIVE superstep)
-        npz = os.path.join(dirs["killed"], "lpa_labels.npz")
+        marks = _ckpt_artifacts(dirs["killed"])
         p = subprocess.Popen(
             _cli(data, dirs["killed"]), cwd=_REPO,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         )
         deadline = time.time() + 1200
-        while not os.path.exists(npz) and time.time() < deadline:
+        while not any(os.path.exists(mk) for mk in marks) and time.time() < deadline:
             if p.poll() is not None:
                 raise RuntimeError(
                     f"run finished (rc={p.returncode}) before the kill — "
