@@ -1,0 +1,21 @@
+"""Run-correlated tracing & telemetry (docs/OBSERVABILITY.md).
+
+Stdlib-only leaf package — safe to import from anywhere in the pipeline
+(nothing here imports jax, and :mod:`graphmine_tpu.pipeline.metrics`
+builds on it, not the other way around):
+
+- :mod:`graphmine_tpu.obs.spans`      hierarchical span context
+  (run_id -> phase -> rung -> superstep) with monotonic timings;
+- :mod:`graphmine_tpu.obs.registry`   counter/gauge registry with a
+  Prometheus-textfile exporter;
+- :mod:`graphmine_tpu.obs.heartbeat`  periodic liveness records (a hung
+  run is distinguishable from a dead one);
+- :mod:`graphmine_tpu.obs.schema`     the record-schema registry every
+  emitted phase name must be declared in (validated in tests and by
+  ``tools/obs_report.py``).
+"""
+
+from graphmine_tpu.obs.registry import Registry
+from graphmine_tpu.obs.spans import Span, Tracer, new_run_id
+
+__all__ = ["Registry", "Span", "Tracer", "new_run_id"]

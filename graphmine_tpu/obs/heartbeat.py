@@ -1,0 +1,128 @@
+"""Periodic liveness records: a hung run must read differently from a
+dead one.
+
+A preempted or OOM-killed process simply stops appending to the metrics
+stream; so does one wedged inside a hung collective. Without a
+heartbeat, offline triage cannot tell which happened — the stream just
+*ends*. The :class:`Heartbeat` daemon thread emits a ``heartbeat``
+record every ``every_s`` seconds carrying the current span path (which
+phase), the registry's gauge/counter snapshot (which superstep, how many
+devices alive), process RSS and uptime — so a stream whose heartbeats
+continue past its last phase record is *hung*, and one whose heartbeats
+stop is *dead* (``tools/obs_report.py`` renders the verdict).
+
+Records ride the sink's existing crash-safe line-buffered stream; when a
+``prom_path`` is given each beat also republishes the Prometheus
+textfile (:meth:`Registry.write_textfile`). Stdlib-only — devices-alive
+comes from the driver-maintained gauge, never from a jax call on the
+heartbeat thread (a probe into a wedged runtime would hang the very
+thread that exists to report the hang).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+log = logging.getLogger("graphmine_tpu")
+
+_PAGESIZE = None
+
+
+def rss_mb() -> float | None:
+    """Resident set size in MiB via ``/proc/self/statm`` (Linux), None
+    where unavailable — a missing gauge, not a crash, off-Linux."""
+    global _PAGESIZE
+    try:
+        if _PAGESIZE is None:
+            import resource  # noqa: F401  (cheap; also warms errno paths)
+            import os
+
+            _PAGESIZE = os.sysconf("SC_PAGESIZE")
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return round(pages * _PAGESIZE / (1024 * 1024), 1)
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+class Heartbeat:
+    """Emit liveness records on a daemon thread until :meth:`stop`.
+
+    ``sink``: a :class:`~graphmine_tpu.pipeline.metrics.MetricsSink`
+    (its ``tracer``/``registry``, when present, supply the phase path
+    and the gauge snapshot). ``extra``: optional zero-arg callable whose
+    dict merges into each record (driver-specific status).
+    """
+
+    def __init__(self, sink, every_s: float = 10.0, prom_path: str | None = None,
+                 extra=None):
+        if every_s <= 0:
+            raise ValueError("every_s must be positive")
+        self.sink = sink
+        self.every_s = float(every_s)
+        self.prom_path = prom_path
+        self.extra = extra
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._t0 = time.perf_counter()
+        self.beats = 0
+
+    def beat(self) -> dict:
+        """Emit one heartbeat record now (the thread's body; callable
+        directly from tests and from the driver at phase boundaries)."""
+        kv = {"uptime_s": round(time.perf_counter() - self._t0, 2)}
+        tracer = getattr(self.sink, "tracer", None)
+        if tracer is not None:
+            kv["busy"] = tracer.latest().path
+        registry = getattr(self.sink, "registry", None)
+        if registry is not None:
+            kv["gauges"] = registry.values()
+        rss = rss_mb()
+        if rss is not None:
+            kv["rss_mb"] = rss
+        if self.extra is not None:
+            kv.update(self.extra())
+        self.beats += 1
+        rec = self.sink.emit("heartbeat", **kv)
+        if self.prom_path and registry is not None:
+            try:
+                labels = {"run_id": tracer.run_id} if tracer else None
+                registry.write_textfile(self.prom_path, labels=labels)
+            except OSError:
+                pass  # a full disk must not kill the liveness signal
+        return rec
+
+    def _loop(self) -> None:
+        warned = False
+        while not self._stop.wait(self.every_s):
+            # One failing beat (a raising `extra` callable, a transient
+            # sink error) must not kill the liveness loop: dead-silent
+            # heartbeats on a live process are exactly the misdiagnosis
+            # ("DEAD") this thread exists to prevent.
+            try:
+                self.beat()
+            except Exception as e:
+                if not warned:
+                    warned = True
+                    log.warning("heartbeat beat failed (will keep "
+                                "trying): %r", e)
+
+    def start(self) -> "Heartbeat":
+        if self._thread is not None:
+            raise RuntimeError("heartbeat already started")
+        self._t0 = time.perf_counter()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="graphmine-heartbeat"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Idempotent; joins the thread briefly so a final in-flight beat
+        cannot interleave with stream finalization."""
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=max(2.0, self.every_s))

@@ -1,0 +1,141 @@
+"""Counter/gauge registry + Prometheus textfile exporter.
+
+The JSONL record stream (``MetricsSink``) is the *event* surface; this is
+the *level* surface — monotonically increasing counters and
+last-value gauges a scrape can read without replaying the event log. Two
+exporters:
+
+- the heartbeat thread folds a :meth:`Registry.values` snapshot into each
+  ``heartbeat`` record (the JSONL exporter — rides the existing
+  crash-safe stream);
+- :meth:`Registry.write_textfile` renders the Prometheus *textfile
+  collector* format atomically (tmp + ``os.replace``), the standard
+  hand-off to a node_exporter sidecar for runs with no scrape endpoint.
+
+Stdlib-only, thread-safe (one registry lock; counters/gauges are touched
+from the driver loop, the resilience layer and the heartbeat thread).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+class _Metric:
+    __slots__ = ("name", "help", "kind", "_value", "_lock")
+
+    def __init__(self, name: str, help: str, kind: str):
+        self.name = name
+        self.help = help
+        self.kind = kind
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    @property
+    def value(self):
+        with self._lock:
+            v = self._value
+        return int(v) if float(v).is_integer() else v
+
+
+class Counter(_Metric):
+    """Monotonic event count. ``inc`` only — a counter that can go down
+    is a gauge wearing the wrong TYPE line."""
+
+    def __init__(self, name: str, help: str = ""):
+        super().__init__(name, help, "counter")
+
+    def inc(self, n: float = 1) -> None:
+        if n < 0:
+            raise ValueError("counters only increase; use a Gauge")
+        with self._lock:
+            self._value += n
+
+
+class Gauge(_Metric):
+    """Last-observed value (current superstep, devices alive, RSS)."""
+
+    def __init__(self, name: str, help: str = ""):
+        super().__init__(name, help, "gauge")
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1) -> None:
+        with self._lock:
+            self._value += n
+
+
+class Registry:
+    """Get-or-create metric registry. Re-requesting a name returns the
+    same object; re-requesting it as a different kind raises (one name,
+    one TYPE — Prometheus scrapers reject anything else)."""
+
+    def __init__(self):
+        self._metrics: dict = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, help: str, cls):
+        if not _NAME_RE.match(name):
+            raise ValueError(
+                f"invalid metric name {name!r} (want [a-zA-Z_:][a-zA-Z0-9_:]*)"
+            )
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help)
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as {m.kind}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(name, help, Counter)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(name, help, Gauge)
+
+    def values(self) -> dict:
+        """Snapshot of every metric's current value, name-keyed."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {m.name: m.value for m in metrics}
+
+    def render_textfile(self, labels: dict | None = None) -> str:
+        """Prometheus text exposition (HELP/TYPE + one sample per metric).
+        ``labels`` (e.g. ``{"run_id": ...}``) attach to every sample so a
+        scrape distinguishes runs sharing one textfile directory."""
+        lab = ""
+        if labels:
+            parts = ",".join(
+                '%s="%s"' % (k, str(v).replace("\\", "\\\\").replace('"', '\\"'))
+                for k, v in sorted(labels.items())
+            )
+            lab = "{%s}" % parts
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        lines = []
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.append(f"{m.name}{lab} {m.value}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def write_textfile(self, path: str, labels: dict | None = None) -> str:
+        """Atomically publish :meth:`render_textfile` at ``path`` — the
+        node_exporter textfile collector reads whole files, so a torn
+        write mid-scrape must be impossible (tmp + ``os.replace``)."""
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(self.render_textfile(labels))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path

@@ -1,0 +1,123 @@
+"""Record-schema registry: every emitted phase name is declared here.
+
+The metrics stream is an append-only JSONL of heterogeneous records; its
+consumers (``tools/obs_report.py``, dashboards, the tests) key on phase
+names and required fields. Nothing stops a new call site from emitting a
+typo'd phase or dropping a key — except this registry, validated over
+every e2e run's records in ``tests/test_obs.py`` (marker ``obs``):
+an **unknown phase name fails loudly** instead of rotting the JSONL, and
+a registered phase missing a required key does too.
+
+Required keys are the *always-present* set; optional keys are free-form
+(records routinely carry extra context). Two cross-cutting rules:
+
+- every record needs ``phase`` (str) and ``t`` (epoch seconds);
+- trace identity is all-or-nothing: a record carrying any of
+  ``run_id`` / ``trace_id`` / ``span_id`` / ``span_path`` must carry all
+  four (a half-stamped record would silently fall out of timeline joins).
+
+Extend with :func:`register` (e.g. from tools that emit their own
+records) — registration is the contract, not a fixed builtin list.
+"""
+
+from __future__ import annotations
+
+_TRACE_KEYS = ("run_id", "trace_id", "span_id", "span_path")
+
+# phase name -> frozenset of required keys (beyond phase/t).
+SCHEMAS: dict = {}
+
+
+def register(phase: str, *required: str) -> None:
+    """Declare a phase and its always-present keys (idempotent; a
+    re-registration unions the key sets so split declarations merge)."""
+    SCHEMAS[phase] = frozenset(required) | SCHEMAS.get(phase, frozenset())
+
+
+# ---- run lifecycle --------------------------------------------------------
+register("run_start", "pid")
+register("run_end", "ok")
+register("span", "name", "seconds", "status")
+register("heartbeat", "uptime_s")
+register("profile_capture", "dir", "ok")
+
+# ---- pipeline phases (timed records carry `seconds`) ----------------------
+register("load", "seconds")
+register("counts", "rows_raw", "edges", "vertices")
+register("quarantine")
+register("plan", "schedule", "bytes_per_device", "hbm_budget", "reason")
+register("scale_out", "message")
+register("warning", "message")
+register("build_graph", "seconds")
+register("partition", "seconds", "shards", "schedule")
+register("lpa", "seconds")            # timed record (graphframes backend)
+register("louvain", "seconds", "gamma")
+register("leiden", "seconds", "gamma")
+register("lpa_iter", "iteration", "labels_changed", "seconds",
+         "edges_per_sec", "edges_per_sec_per_chip")
+register("superstep_telemetry", "iteration", "labels_changed", "frontier",
+         "shard_changed", "imbalance", "devices", "variant")
+register("census", "seconds")
+register("communities", "count", "largest", "modularity")
+register("outliers_recursive_lpa", "seconds")
+register("outliers_lof", "seconds", "k", "devices", "features")
+register("outlier_summary", "method")
+register("ivf_fallback", "guard", "detail")
+
+# ---- recovery / resilience records (docs/RESILIENCE.md) -------------------
+register("retry", "stage", "attempt", "backoff_s", "error")
+register("retries_exhausted", "stage", "attempts", "error")
+register("degrade", "stage", "to", "depth", "error")
+register("mesh_degrade", "from_devices", "to_devices", "schedule",
+         "iteration", "resumed_from", "dead_devices")
+register("tripwire", "kind", "shard", "iteration")
+register("watchdog_timeout", "stage", "timeout_s", "checkpointed")
+register("resume", "iteration")
+register("checkpoint_save", "iteration", "format", "path")
+register("checkpoint_rollback", "path", "error")
+register("checkpoint_rollback_ok", "path", "iteration")
+
+# The recovery phases obs_report joins into the causal timeline.
+RECOVERY_PHASES = frozenset((
+    "retry", "retries_exhausted", "degrade", "mesh_degrade", "tripwire",
+    "watchdog_timeout", "resume", "checkpoint_rollback",
+    "checkpoint_rollback_ok", "ivf_fallback", "quarantine",
+))
+
+
+def validate_record(rec) -> list:
+    """Problems with one record (empty list = valid)."""
+    problems = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not dict"]
+    phase = rec.get("phase")
+    if not isinstance(phase, str) or not phase:
+        return [f"missing/empty phase in {rec!r}"]
+    if not isinstance(rec.get("t"), (int, float)):
+        problems.append(f"{phase}: missing numeric t")
+    required = SCHEMAS.get(phase)
+    if required is None:
+        problems.append(
+            f"unknown phase {phase!r} — register it in "
+            "graphmine_tpu/obs/schema.py with its required keys"
+        )
+    else:
+        missing = sorted(k for k in required if k not in rec)
+        if missing:
+            problems.append(f"{phase}: missing required keys {missing}")
+    present = [k for k in _TRACE_KEYS if k in rec]
+    if present and len(present) != len(_TRACE_KEYS):
+        absent = sorted(set(_TRACE_KEYS) - set(present))
+        problems.append(
+            f"{phase}: partial trace identity (has {present}, lacks {absent})"
+        )
+    return problems
+
+
+def validate_records(records) -> list:
+    """Flat problem list over a record iterable, each prefixed with its
+    position — the loud-failure hook tests run over every e2e stream."""
+    problems = []
+    for i, rec in enumerate(records):
+        problems.extend(f"record {i}: {p}" for p in validate_record(rec))
+    return problems

@@ -1,0 +1,162 @@
+"""Hierarchical span context: run_id -> phase -> rung -> superstep.
+
+The resilience machine (PRs 1-2) emits every recovery decision as a flat
+JSONL record — but with no run, trace, or span identity an operator
+cannot reconstruct *which* retry belonged to *which* phase on *which*
+mesh rung. A :class:`Tracer` owns one run's identity (``run_id`` +
+``trace_id``) and a thread-local stack of open :class:`Span`\\ s; the
+:class:`~graphmine_tpu.pipeline.metrics.MetricsSink` stamps every record
+with the current span's ids and slash-joined path, so retry / degrade /
+mesh_degrade / tripwire / checkpoint records join into one causal
+timeline (``tools/obs_report.py``).
+
+Timings are **monotonic** (``time.perf_counter``) — span durations never
+go negative under NTP steps; the wall-clock ``start_t`` exists only so
+offline reports can align spans with record ``t`` values.
+
+Stdlib-only. :func:`xla_annotation` opportunistically enters a
+``jax.profiler.TraceAnnotation`` named by the span path — but only when
+jax is *already imported*, so host-side tooling that never touches a
+device pays nothing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import secrets
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+def new_run_id() -> str:
+    """Sortable-by-start, collision-safe run identity:
+    ``YYYYMMDDTHHMMSS-<6 hex>`` (UTC)."""
+    return time.strftime("%Y%m%dT%H%M%S", time.gmtime()) + "-" + secrets.token_hex(3)
+
+
+def _new_id(nbytes: int = 4) -> str:
+    return secrets.token_hex(nbytes)
+
+
+@dataclass
+class Span:
+    """One timed node of the span tree. ``path`` is the slash-joined name
+    chain from the root (``run/lpa/rung:ring@4/superstep``) — records
+    carry it verbatim so offline triage needs no id-graph walk."""
+
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: str | None
+    path: str
+    start_t: float                      # wall clock, for report alignment
+    start_mono: float                   # perf_counter, for durations
+    end_mono: float | None = None
+    attrs: dict = field(default_factory=dict)
+    status: str = "ok"
+
+    @property
+    def seconds(self) -> float:
+        """Monotonic duration; an open span reports its age so far."""
+        end = self.end_mono if self.end_mono is not None else time.perf_counter()
+        return end - self.start_mono
+
+
+class Tracer:
+    """One run's span tree. The root span ("run") opens at construction
+    and closes via :meth:`close`; :meth:`span` nests under the current
+    thread's innermost open span.
+
+    Thread model: each thread has its own open-span stack; a thread with
+    no open span (the heartbeat thread, a watchdog worker) falls back to
+    the **root** span, so records emitted there still carry the run and
+    trace ids. :meth:`latest` returns the most recently entered open span
+    across all threads — what the heartbeat reports as the current phase
+    without the emitting thread needing any span of its own.
+    """
+
+    def __init__(self, run_id: str | None = None):
+        self.run_id = run_id or new_run_id()
+        self.trace_id = _new_id(8)
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        now = time.time()
+        self.root = Span(
+            name="run", trace_id=self.trace_id, span_id=_new_id(),
+            parent_id=None, path="run", start_t=now,
+            start_mono=time.perf_counter(),
+        )
+        self._latest: Span = self.root
+
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def current(self) -> Span:
+        """This thread's innermost open span (the root when none)."""
+        stack = self._stack()
+        return stack[-1] if stack else self.root
+
+    def latest(self) -> Span:
+        """Most recently entered open span across all threads."""
+        with self._lock:
+            return self._latest
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        """Open a child span of the current one for the ``with`` block.
+        An escaping exception marks ``status="error"`` (and propagates);
+        the span always closes with a monotonic end time."""
+        parent = self.current()
+        sp = Span(
+            name=name, trace_id=self.trace_id, span_id=_new_id(),
+            parent_id=parent.span_id, path=f"{parent.path}/{name}",
+            start_t=time.time(), start_mono=time.perf_counter(),
+            attrs=dict(attrs),
+        )
+        stack = self._stack()
+        stack.append(sp)
+        with self._lock:
+            self._latest = sp
+        try:
+            yield sp
+        except BaseException:
+            sp.status = "error"
+            raise
+        finally:
+            sp.end_mono = time.perf_counter()
+            if stack and stack[-1] is sp:
+                stack.pop()
+            else:  # defensive: never let a mismatched exit corrupt the stack
+                try:
+                    stack.remove(sp)
+                except ValueError:
+                    pass
+            with self._lock:
+                if self._latest is sp:
+                    self._latest = self.current()
+
+    def close(self) -> Span:
+        """End the root span (idempotent); returns it for the run record."""
+        if self.root.end_mono is None:
+            self.root.end_mono = time.perf_counter()
+        return self.root
+
+
+def xla_annotation(name: str):
+    """A ``jax.profiler.TraceAnnotation`` named by the span path — the
+    bridge that lines XLA profiler traces up with the span tree — or a
+    null context when jax is not already imported (a tracer used by
+    host-only tooling must not drag the runtime in) or the profiler
+    API is unavailable."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return contextlib.nullcontext()
+    try:
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return contextlib.nullcontext()

@@ -158,6 +158,78 @@ def _rank_tripwire(new, it, chunk_size, every):
     _fire_trip(fire, kind, shard, it + 1)
 
 
+# ---- on-device superstep telemetry ----------------------------------------
+# Cheap counters ACCUMULATED IN THE LOOP CARRY (ISSUE 3): labels-changed /
+# frontier size per superstep, per-shard active counts (the load-imbalance
+# ratio GraphBLAST-style frontier telemetry makes sparse iteration
+# debuggable with), and rank-residual norms for the power iteration. They
+# ride the scan/while carry and come back WITH the final labels in the one
+# existing device->host transfer — zero extra host syncs, zero extra
+# collectives (the reductions run on the replicated/gathered iterate every
+# device already holds). Off by default: the telemetry=False programs are
+# byte-identical to the pre-telemetry ones.
+
+
+@dataclass(frozen=True)
+class SuperstepTelemetry:
+    """Per-superstep counters from a sharded LPA/CC run.
+
+    ``labels_changed[t]``: vertices whose label changed at superstep t
+    (synchronous label propagation's frontier — exactly the vertices
+    whose neighbors must re-reduce next step). ``shard_changed[t, d]``:
+    the same count split by owning shard — the max/mean ratio is the
+    load-imbalance signal (a power-law hub shard staying hot while the
+    rest converge). ``iterations``: supersteps actually run (== rows for
+    LPA's fixed count; the converged prefix for CC)."""
+
+    labels_changed: np.ndarray      # [T] int32
+    shard_changed: np.ndarray       # [T, D] int32
+    iterations: int
+
+    @property
+    def frontier(self) -> np.ndarray:
+        return self.labels_changed
+
+    def imbalance_ratio(self) -> np.ndarray:
+        """Per-superstep max-shard / mean-shard activity (1.0 = perfectly
+        balanced; quiescent supersteps report 1.0, not NaN)."""
+        mean = self.shard_changed.mean(axis=1)
+        peak = self.shard_changed.max(axis=1, initial=0)
+        return np.where(mean > 0, peak / np.maximum(mean, 1e-9), 1.0)
+
+
+@dataclass(frozen=True)
+class PowerIterTelemetry:
+    """Per-iteration residuals from a sharded PageRank run:
+    ``residuals[t]`` is the global L1 delta, ``shard_residuals[t, d]``
+    its per-shard split (imbalance + where mass is still moving), over
+    the ``iterations`` actually run before convergence/max_iter."""
+
+    residuals: np.ndarray           # [T] float32
+    shard_residuals: np.ndarray     # [T, D] float32
+    iterations: int
+
+
+def _telemetry_row(new, cur, chunk_size):
+    """One superstep's counters, on device: (changed total, per-shard
+    changed). Operates on the padded [D*Vc] iterate, so the reshape is
+    exact; padding vertices never change (their label is their id)."""
+    diff = new != cur
+    d = new.shape[0] // chunk_size
+    per_shard = jnp.sum(
+        diff.reshape(d, chunk_size), axis=1, dtype=jnp.int32
+    )
+    return jnp.sum(per_shard), per_shard
+
+
+def _residual_row(new, pr, chunk_size):
+    """One power iteration's residuals: (L1 delta, per-shard L1)."""
+    diff = jnp.abs(new - pr)
+    d = new.shape[0] // chunk_size
+    per_shard = diff.reshape(d, chunk_size).sum(axis=1)
+    return jnp.sum(per_shard), per_shard
+
+
 def _vertex_axes(mesh):
     """The mesh axes the vertex dimension is sharded over.
 
@@ -526,13 +598,16 @@ def _padded_init_labels(sg: ShardedGraph) -> jax.Array:
 
 def _scan_supersteps(
     step_fn, labels: jax.Array, max_iter: int,
-    tripwire_every: int = 0, chunk_size: int = 0,
-) -> jax.Array:
+    tripwire_every: int = 0, chunk_size: int = 0, collect: bool = False,
+):
     """Fixed-count superstep driver (LPA semantics: exactly max_iter).
     ``tripwire_every > 0`` arms the label tripwires every K supersteps
     (the carry then also holds the previous iterate for the oscillation
-    guard); 0 keeps the original lean program."""
-    if not tripwire_every:
+    guard); ``collect`` stacks :func:`_telemetry_row` as scan outputs and
+    returns ``(labels, (changed[T], shard_changed[T, D]))`` — the
+    counters travel with the result, no extra syncs. With both off the
+    program is the original lean one."""
+    if not tripwire_every and not collect:
 
         def step(labels, _):
             return step_fn(labels), None
@@ -540,44 +615,77 @@ def _scan_supersteps(
         labels, _ = lax.scan(step, labels, None, length=max_iter)
         return labels
 
+    if not tripwire_every:
+        # collect-only: no oscillation guard, so don't thread a second
+        # [D*Vc] prev-labels buffer through the carry just to ignore it
+        # — telemetry targets exactly the large-graph runs where that
+        # extra HBM would hurt.
+        def step_c(cur, _):
+            new = step_fn(cur)
+            return new, _telemetry_row(new, cur, chunk_size)
+
+        labels, ys = lax.scan(step_c, labels, None, length=max_iter)
+        return labels, ys
+
     def step(carry, it):
         cur, prev = carry
         new = step_fn(cur)
-        _label_tripwire(new, cur, prev, it, chunk_size, tripwire_every)
-        return (new, cur), None
+        if tripwire_every:
+            _label_tripwire(new, cur, prev, it, chunk_size, tripwire_every)
+        ys = _telemetry_row(new, cur, chunk_size) if collect else None
+        return (new, cur), ys
 
-    (labels, prev), _ = lax.scan(
+    (labels, prev), ys = lax.scan(
         step, (labels, labels), jnp.arange(max_iter, dtype=jnp.int32)
     )
-    # Unconditional exit check (every=1): when max_iter is not a multiple
-    # of K the last supersteps run unchecked, and garbage must never
-    # leave the loop silently.
-    _label_tripwire(
-        labels, prev, prev, jnp.int32(max_iter - 1), chunk_size, 1
-    )
-    return labels
+    if tripwire_every:
+        # Unconditional exit check (every=1): when max_iter is not a
+        # multiple of K the last supersteps run unchecked, and garbage
+        # must never leave the loop silently.
+        _label_tripwire(
+            labels, prev, prev, jnp.int32(max_iter - 1), chunk_size, 1
+        )
+    return (labels, ys) if collect else labels
+
+
+# Telemetry ring-buffer bound for unbounded (max_iter=0) fixpoint runs:
+# pointer jumping converges in O(log V) supersteps, so 4096 rows is far
+# past any real trajectory; a pathological overrun overwrites the last
+# row rather than growing an O(V)-row buffer alongside the labels.
+_FIXPOINT_TELEMETRY_CAP = 4096
 
 
 def _fixpoint_supersteps(
     step_fn, sg: ShardedGraph, max_iter: int, tripwire_every: int = 0,
-    init_labels=None,
-) -> jax.Array:
+    init_labels=None, collect: bool = False,
+):
     """Run supersteps until no label changes (CC semantics), bounded by
     ``max_iter`` when nonzero. Shared by the replicated-label and ring
     schedules so the convergence logic has one home. ``tripwire_every``
     arms the CC tripwires (range + monotonicity) every K supersteps;
-    ``init_labels`` resumes a checkpointed run mid-fixpoint."""
+    ``init_labels`` resumes a checkpointed run mid-fixpoint. ``collect``
+    accumulates :func:`_telemetry_row` into a fixed-size buffer carried
+    through the while_loop and returns
+    ``(labels, (changed[cap], shard_changed[cap, D], it_end))``."""
     limit = max_iter if max_iter > 0 else sg.num_vertices + 2
+    cap = min(limit, _FIXPOINT_TELEMETRY_CAP)
 
     def cond(state):
-        _, changed, it = state
+        changed, it = state[1], state[2]
         return (changed > 0) & (it < limit)
 
     def loop_body(state):
-        labels, _, it = state
+        labels = state[0]
+        it = state[2]
         new = step_fn(labels)
         if tripwire_every:
             _cc_tripwire(new, labels, it, sg.chunk_size, tripwire_every)
+        if collect:
+            total, per_shard = _telemetry_row(new, labels, sg.chunk_size)
+            row = jnp.minimum(it, cap - 1)
+            buf_c = state[3].at[row].set(total)
+            buf_s = state[4].at[row].set(per_shard)
+            return new, total, it + 1, buf_c, buf_s
         changed = jnp.sum(new != labels, dtype=jnp.int32)
         return new, changed, it + 1
 
@@ -585,22 +693,30 @@ def _fixpoint_supersteps(
         _padded_init_labels(sg) if init_labels is None
         else _pad_labels(init_labels, sg)
     )
-    labels, _, it_end = lax.while_loop(
-        cond, loop_body, (labels0, jnp.int32(1), jnp.int32(0))
-    )
+    state0 = (labels0, jnp.int32(1), jnp.int32(0))
+    if collect:
+        state0 = state0 + (
+            jnp.zeros((cap,), jnp.int32),
+            jnp.zeros((cap, sg.num_shards), jnp.int32),
+        )
+    out = lax.while_loop(cond, loop_body, state0)
+    labels, it_end = out[0], out[2]
     if tripwire_every:
         # Exit check (every=1): a poisoned-but-stable state ends the
         # fixpoint loop between two K-aligned checks; garbage must never
         # leave the loop silently. Monotonicity needs history, so only
         # the range guard applies here (cur=new disables it).
         _cc_tripwire(labels, labels, it_end - 1, sg.chunk_size, 1)
+    if collect:
+        return labels[: sg.num_vertices], (out[3], out[4], it_end)
     return labels[: sg.num_vertices]
 
 
 def sharded_label_propagation(
     sg: ShardedGraph, mesh, max_iter: int = 5,
     init_labels: jax.Array | None = None, tripwire_every: int = 0,
-) -> jax.Array:
+    telemetry: bool = False,
+):
     """Distributed synchronous LPA; semantics identical to
     :func:`graphmine_tpu.ops.lpa.label_propagation` (asserted by the
     virtual-device parity tests). Returns int32 labels ``[V]``.
@@ -610,18 +726,33 @@ def sharded_label_propagation(
     firing raises :class:`~graphmine_tpu.pipeline.resilience.DivergenceError`
     (retryable, with the offending shard index) instead of returning
     garbage labels. 0 (default) = off, the exact pre-tripwire program.
+
+    ``telemetry``: also return a :class:`SuperstepTelemetry` —
+    ``(labels, telemetry)`` — whose per-superstep counters accumulate in
+    the scan carry and come back with the labels in the same transfer
+    (no per-iteration host syncs; labels are bit-identical either way).
     """
     if not tripwire_every:
-        return _sharded_lpa_jit(sg, mesh, max_iter, init_labels, 0)
-    return _run_armed(
-        lambda: _sharded_lpa_jit(sg, mesh, max_iter, init_labels, tripwire_every)
+        out = _sharded_lpa_jit(sg, mesh, max_iter, init_labels, 0, telemetry)
+    else:
+        out = _run_armed(
+            lambda: _sharded_lpa_jit(
+                sg, mesh, max_iter, init_labels, tripwire_every, telemetry
+            )
+        )
+    if not telemetry:
+        return out
+    labels, (changed, per_shard) = out
+    return labels, SuperstepTelemetry(
+        np.asarray(changed), np.asarray(per_shard), int(max_iter)
     )
 
 
-@partial(jax.jit, static_argnames=("max_iter", "mesh", "tripwire_every"))
+@partial(jax.jit, static_argnames=("max_iter", "mesh", "tripwire_every", "telemetry"))
 def _sharded_lpa_jit(
-    sg: ShardedGraph, mesh, max_iter: int, init_labels, tripwire_every: int
-) -> jax.Array:
+    sg: ShardedGraph, mesh, max_iter: int, init_labels, tripwire_every: int,
+    telemetry: bool = False,
+):
     _check_mesh(sg, mesh)
     axes = _vertex_axes(mesh)
     rep = P()
@@ -659,36 +790,53 @@ def _sharded_lpa_jit(
             l, sg.msg_recv_local, sg.msg_send, sg.degrees, sg.msg_weight
         )
     labels = _padded_init_labels(sg) if init_labels is None else _pad_labels(init_labels, sg)
-    labels = _scan_supersteps(
+    out = _scan_supersteps(
         step, labels, max_iter,
         tripwire_every=tripwire_every, chunk_size=sg.chunk_size,
+        collect=telemetry,
     )
-    return labels[: sg.num_vertices]
+    if telemetry:
+        labels, ys = out
+        return labels[: sg.num_vertices], ys
+    return out[: sg.num_vertices]
 
 
 def sharded_connected_components(
     sg: ShardedGraph, mesh, max_iter: int = 0, tripwire_every: int = 0,
-    init_labels: jax.Array | None = None,
-) -> jax.Array:
+    init_labels: jax.Array | None = None, telemetry: bool = False,
+):
     """Distributed weakly-connected components (min-propagation + pointer
     jumping); parity with :func:`graphmine_tpu.ops.cc.connected_components`.
     ``tripwire_every``: arm the CC divergence tripwires (label range +
     min-monotonicity) every K supersteps; see
     :func:`sharded_label_propagation`. ``init_labels``: resume a
     checkpointed fixpoint mid-run (min-propagation is monotone, so a
-    resumed trajectory converges to the identical fixpoint)."""
+    resumed trajectory converges to the identical fixpoint).
+    ``telemetry``: return ``(labels, SuperstepTelemetry)`` — counters
+    ride the while-loop carry (rows past the converged prefix are
+    trimmed host-side; no extra device syncs)."""
     if not tripwire_every:
-        return _sharded_cc_jit(sg, mesh, max_iter, 0, init_labels)
-    return _run_armed(
-        lambda: _sharded_cc_jit(sg, mesh, max_iter, tripwire_every, init_labels)
+        out = _sharded_cc_jit(sg, mesh, max_iter, 0, init_labels, telemetry)
+    else:
+        out = _run_armed(
+            lambda: _sharded_cc_jit(
+                sg, mesh, max_iter, tripwire_every, init_labels, telemetry
+            )
+        )
+    if not telemetry:
+        return out
+    labels, (changed, per_shard, it_end) = out
+    n = min(int(it_end), changed.shape[0])
+    return labels, SuperstepTelemetry(
+        np.asarray(changed)[:n], np.asarray(per_shard)[:n], int(it_end)
     )
 
 
-@partial(jax.jit, static_argnames=("max_iter", "mesh", "tripwire_every"))
+@partial(jax.jit, static_argnames=("max_iter", "mesh", "tripwire_every", "telemetry"))
 def _sharded_cc_jit(
     sg: ShardedGraph, mesh, max_iter: int, tripwire_every: int,
-    init_labels=None,
-) -> jax.Array:
+    init_labels=None, telemetry: bool = False,
+):
     _check_mesh(sg, mesh)
     in_specs, rep = _shard_specs(mesh)
     body = shard_map(
@@ -701,6 +849,7 @@ def _sharded_cc_jit(
     return _fixpoint_supersteps(
         lambda l: body(l, sg.msg_recv_local, sg.msg_send, sg.degrees), sg,
         max_iter, tripwire_every=tripwire_every, init_labels=init_labels,
+        collect=telemetry,
     )
 
 
@@ -786,7 +935,8 @@ def sharded_pagerank(
     weighted: bool | None = None,
     tripwire_every: int = 0,
     init_ranks: jax.Array | None = None,
-) -> jax.Array:
+    telemetry: bool = False,
+):
     """Distributed PageRank over the vertex-range-sharded message CSR.
 
     ``sg`` must be partitioned from a **directed** graph
@@ -808,24 +958,37 @@ def sharded_pagerank(
     'converged' with garbage); see :func:`sharded_label_propagation`.
     ``init_ranks``: resume a checkpointed power iteration mid-run (the
     iteration is a fixed-point map, so a resumed trajectory matches the
-    uninterrupted one).
+    uninterrupted one). ``telemetry``: return
+    ``(ranks, PowerIterTelemetry)`` — per-iteration L1 residuals (global
+    + per-shard) accumulated in the loop carry, fetched with the ranks
+    (no extra syncs; a NaN-poisoned run's residual trail shows WHERE the
+    iteration went wrong, not just that it did).
     """
     if not tripwire_every:
-        return _sharded_pagerank_jit(
+        out = _sharded_pagerank_jit(
             sg, mesh, out_degrees, alpha, max_iter, tol, weighted, 0,
-            init_ranks,
+            init_ranks, telemetry,
         )
-    return _run_armed(lambda: _sharded_pagerank_jit(
-        sg, mesh, out_degrees, alpha, max_iter, tol, weighted,
-        tripwire_every, init_ranks,
-    ))
+    else:
+        out = _run_armed(lambda: _sharded_pagerank_jit(
+            sg, mesh, out_degrees, alpha, max_iter, tol, weighted,
+            tripwire_every, init_ranks, telemetry,
+        ))
+    if not telemetry:
+        return out
+    ranks, (res, shard_res, it_end) = out
+    n = min(int(it_end), res.shape[0])
+    return ranks, PowerIterTelemetry(
+        np.asarray(res)[:n], np.asarray(shard_res)[:n], int(it_end)
+    )
 
 
-@partial(jax.jit, static_argnames=("max_iter", "mesh", "weighted", "tripwire_every"))
+@partial(jax.jit, static_argnames=("max_iter", "mesh", "weighted", "tripwire_every", "telemetry"))
 def _sharded_pagerank_jit(
     sg: ShardedGraph, mesh, out_degrees, alpha, max_iter: int, tol,
     weighted: bool | None, tripwire_every: int, init_ranks=None,
-) -> jax.Array:
+    telemetry: bool = False,
+):
     _check_mesh(sg, mesh)
     weighted = _check_pagerank_weighted(sg, out_degrees, weighted)
     inv_out, reset, dangling = _pagerank_terms(
@@ -848,12 +1011,14 @@ def _sharded_pagerank_jit(
         check_vma=False,
     )
 
+    cap = max(max_iter, 1)
+
     def cond(state):
-        _, delta, it = state
+        delta, it = state[1], state[2]
         return (delta > tol) & (it < max_iter)
 
     def step(state):
-        pr, _, it = state
+        pr, it = state[0], state[2]
         args = (sg.msg_weight,) if weighted else ()
         new = body(
             (pr, inv_out, reset, dangling), sg.msg_recv_local, sg.msg_send,
@@ -861,6 +1026,12 @@ def _sharded_pagerank_jit(
         )
         if tripwire_every:
             _rank_tripwire(new, it, sg.chunk_size, tripwire_every)
+        if telemetry:
+            delta, per_shard = _residual_row(new, pr, sg.chunk_size)
+            row = jnp.minimum(it, cap - 1)
+            return (new, delta, it + 1,
+                    state[3].at[row].set(delta),
+                    state[4].at[row].set(per_shard))
         delta = jnp.abs(new - pr).sum()
         return new, delta, it + 1
 
@@ -873,12 +1044,19 @@ def _sharded_pagerank_jit(
         pr0 = jnp.zeros((sg.padded_vertices,), jnp.float32).at[
             : sg.num_vertices
         ].set(init_ranks.astype(jnp.float32))
-    pr, _, it_end = lax.while_loop(
-        cond, step, (pr0, jnp.float32(1.0), jnp.int32(0))
-    )
+    state0 = (pr0, jnp.float32(1.0), jnp.int32(0))
+    if telemetry:
+        state0 = state0 + (
+            jnp.zeros((cap,), jnp.float32),
+            jnp.zeros((cap, sg.num_shards), jnp.float32),
+        )
+    out = lax.while_loop(cond, step, state0)
+    pr, it_end = out[0], out[2]
     if tripwire_every:
         # Exit check (every=1): a NaN delta FAILS `delta > tol` and ends
         # the loop immediately — often before the K-th iteration check —
         # so the final ranks are always re-guarded before they escape.
         _rank_tripwire(pr, it_end - 1, sg.chunk_size, 1)
+    if telemetry:
+        return pr[: sg.num_vertices], (out[3], out[4], it_end)
     return pr[: sg.num_vertices]

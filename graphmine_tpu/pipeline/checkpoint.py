@@ -74,9 +74,28 @@ def graph_fingerprint(src, dst, weights=None) -> str:
     return h.hexdigest()
 
 
+def _emit_save(sink, path: str, iteration: int, fmt: str, shards: int) -> None:
+    """``checkpoint_save`` record: every durable save joins the run's
+    causal timeline (span-stamped by the sink), so offline triage can see
+    exactly which generation a later rollback/resume landed on."""
+    if sink is not None:
+        sink.emit(
+            "checkpoint_save", path=path, iteration=int(iteration),
+            format=fmt, shards=int(shards), bytes=_tree_bytes(path),
+        )
+
+
+def _tree_bytes(path: str) -> int:
+    if os.path.isdir(path):
+        return sum(
+            os.path.getsize(os.path.join(path, f)) for f in os.listdir(path)
+        )
+    return os.path.getsize(path)
+
+
 def save_labels(
     checkpoint_dir: str, labels, iteration: int, tag: str = "lpa",
-    fingerprint: str | None = None,
+    fingerprint: str | None = None, sink=None,
 ) -> str:
     """Durably save (labels, iteration) — torn-write-proof.
 
@@ -85,7 +104,8 @@ def save_labels(
     any point leaves either the old checkpoint or the new one fully intact,
     never a truncated ``.npz``; the rotation keeps the last good state
     available for :func:`load_labels`'s corruption rollback. The embedded
-    ``checksum`` covers labels + iteration + fingerprint.
+    ``checksum`` covers labels + iteration + fingerprint. ``sink``: emits
+    a ``checkpoint_save`` record per save.
     """
     os.makedirs(checkpoint_dir, exist_ok=True)
     path = os.path.join(checkpoint_dir, f"{tag}_labels.npz")
@@ -110,6 +130,7 @@ def save_labels(
         os.fsync(dirfd)
     finally:
         os.close(dirfd)
+    _emit_save(sink, path, iteration, "npz", 1)
     return path
 
 
@@ -339,6 +360,7 @@ def save_sharded(
     tag: str = "lpa",
     fingerprint: str | None = None,
     num_shards: int | None = None,
+    sink=None,
 ) -> str:
     """Durably save (labels, iteration) as a manifest of per-shard files.
 
@@ -349,7 +371,8 @@ def save_sharded(
     generation directory (each file fsync'd, manifest last), the previous
     generation rotates to ``*.prev``, and one directory rename publishes
     the new generation — a kill at any point leaves the old or the new
-    generation fully intact, never a torn mix. Returns the generation dir.
+    generation fully intact, never a torn mix. ``sink``: emits a
+    ``checkpoint_save`` record per save. Returns the generation dir.
     """
     labels_np = np.asarray(labels)
     if num_shards is None:
@@ -420,6 +443,7 @@ def save_sharded(
         os.replace(gen, prev)
     os.replace(tmp, gen)
     _fsync_dir(checkpoint_dir)
+    _emit_save(sink, gen, iteration, "sharded", num_shards)
     return gen
 
 

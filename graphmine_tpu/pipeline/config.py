@@ -57,13 +57,26 @@ class PipelineConfig:
     # 262K points; AUROC 0.9895 vs 0.9905 on the harness) for ~3x wall
     # (docs/DESIGN.md "Exact kNN is at the sort roofline").
     lof_impl: str = "auto"  # auto | xla | pallas | ivf
-    # observability
+    # observability (docs/OBSERVABILITY.md)
     show: int = 10  # .show(10) parity
     profile_dir: str | None = None  # jax.profiler trace output
     # write every metrics record (incl. retry/degrade/quarantine/rollback
     # recovery events, docs/RESILIENCE.md) as JSON lines to this path at
-    # the end of the run — the on-disk twin of the logging stream
+    # the end of the run — the on-disk twin of the logging stream. Opened
+    # in APPEND mode: a resumed run reusing the path adds a new
+    # run_start-delimited segment instead of clobbering the prior trail.
     metrics_out: str | None = None
+    # run identity stamped on every record/span (tools/obs_report.py joins
+    # on it); None autogenerates a sortable UTC id. Set it explicitly to
+    # correlate with an external scheduler's job id.
+    run_id: str | None = None
+    # emit a `heartbeat` record every N seconds (phase, gauges, RSS) so a
+    # hung run is distinguishable from a dead one; None/0 = off.
+    heartbeat_every_s: float | None = None
+    # publish the counter/gauge registry as a Prometheus textfile at this
+    # path (atomically, each heartbeat + once at exit) — the node_exporter
+    # textfile-collector hand-off for runs with no scrape endpoint.
+    prom_out: str | None = None
     # checkpoint / resume
     checkpoint_dir: str | None = None
     # Save every N supersteps (plus always the final one). 1 = every
@@ -122,6 +135,8 @@ class PipelineConfig:
             raise ValueError("decile must be in (0, 1)")
         if self.checkpoint_every < 1:
             raise ValueError("checkpoint_every must be >= 1")
+        if self.heartbeat_every_s is not None and self.heartbeat_every_s <= 0:
+            raise ValueError("heartbeat_every_s must be positive (or unset)")
         return self
 
 

@@ -68,23 +68,67 @@ class PipelineResult:
 
 def run_pipeline(config: PipelineConfig) -> PipelineResult:
     config.validate()
+    from graphmine_tpu.obs.spans import Tracer
+
     # Records stream to --metrics-out AS EMITTED (MetricsSink.emit), not
     # only at exit: a preemption or OOM-kill skips every finally block,
     # and those are exactly the runs whose retry/degrade/rollback trail
-    # the operator needs for offline triage.
-    m = MetricsSink(stream_path=config.metrics_out)
+    # the operator needs for offline triage. Every record carries the
+    # tracer's run/trace/span identity (docs/OBSERVABILITY.md), and the
+    # stream begins with a run_start header delimiting this run's segment
+    # of a (possibly reused, append-mode) metrics file.
+    tracer = Tracer(run_id=config.run_id)
+    m = MetricsSink(stream_path=config.metrics_out, tracer=tracer)
+    m.emit(
+        "run_start", pid=os.getpid(), data_path=config.data_path,
+        backend=config.backend, schedule=config.schedule,
+        community_method=config.community_method, max_iter=config.max_iter,
+    )
+    hb = None
+    if config.heartbeat_every_s:
+        from graphmine_tpu.obs.heartbeat import Heartbeat
+
+        hb = Heartbeat(
+            m, every_s=config.heartbeat_every_s, prom_path=config.prom_out
+        ).start()
+    run_err: BaseException | None = None
     try:
         return _run_pipeline(config, m)
+    except BaseException as e:
+        run_err = e
+        raise
     finally:
-        # Finalized on EVERY exit, not just success: closes the live
-        # stream, or writes the whole file when streaming was off/failed.
-        # A failed flush must not mask the pipeline's own outcome.
+        # Finalized on EVERY exit, not just success: stop the heartbeat,
+        # close the run with a run_end record (so offline triage can tell
+        # a finished run from a killed one), publish the registry, close
+        # the live stream or append what it never persisted. A failed
+        # flush must not mask the pipeline's own outcome.
+        if hb is not None:
+            hb.stop()
+        if run_err is None:
+            m.emit("run_end", ok=True)
+        else:
+            m.emit(
+                "run_end", ok=False, error=resilience.classify_error(run_err),
+                error_detail=repr(run_err),
+            )
+        tracer.close()
+        import logging
+
+        if config.prom_out:
+            try:
+                m.registry.write_textfile(
+                    config.prom_out, labels={"run_id": tracer.run_id}
+                )
+            except OSError as prom_err:
+                logging.getLogger("graphmine_tpu").warning(
+                    "could not write --prom-out %s: %r",
+                    config.prom_out, prom_err,
+                )
         if config.metrics_out:
             try:
                 m.finalize(config.metrics_out)
             except OSError as flush_err:
-                import logging
-
                 logging.getLogger("graphmine_tpu").warning(
                     "could not write --metrics-out %s: %r",
                     config.metrics_out, flush_err,
@@ -104,7 +148,9 @@ def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
             quarantine=config.quarantine_inputs,
         )
 
-    with m.timed("load", path=config.data_path, format=config.data_format):
+    with m.span("load"), m.timed(
+        "load", path=config.data_path, format=config.data_format
+    ):
         table = resilience.run_phase("load", _load, config.resilience, m)
     m.emit(
         "counts",  # parity with the prints at Graphframes.py:18 and :54
@@ -187,7 +233,7 @@ def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
             return g, [plan]
         return graph_from_edge_table(table, to_device=not scale_out), [None]
 
-    with m.timed("build_graph"):
+    with m.span("build_graph"), m.timed("build_graph"):
         graph, plan_holder = resilience.run_phase(
             "build_graph", _build, config.resilience, m
         )
@@ -200,10 +246,15 @@ def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
             m.emit("warning", message="checkpoint/resume applies to LPA only; "
                    f"{config.community_method} runs are not checkpointed")
         algo = leiden if config.community_method == "leiden" else louvain
-        with m.timed(config.community_method, gamma=config.gamma):
+        with m.span(config.community_method), m.timed(
+            config.community_method, gamma=config.gamma
+        ):
             labels, q = algo(graph, gamma=config.gamma)
     else:
-        labels = _run_lpa(config, table, graph, m, plan_holder, n_dev, run_plan)
+        with m.span("lpa"):
+            labels = _run_lpa(
+                config, table, graph, m, plan_holder, n_dev, run_plan
+            )
         q = None
 
     # ---- CS-4 census ----------------------------------------------------
@@ -220,7 +271,7 @@ def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
         )
         return n, table_, qq
 
-    with m.timed("census"):
+    with m.span("census"), m.timed("census"):
         n_comm, (present, sizes, edge_counts), q = resilience.run_phase(
             "census", _census, config.resilience, m
         )
@@ -268,7 +319,9 @@ def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
             resilience.fault_point("outliers_recursive")
             return scorer()
 
-        with m.timed("outliers_recursive_lpa", **timing_kv):
+        with m.span("outliers_recursive_lpa"), m.timed(
+            "outliers_recursive_lpa", **timing_kv
+        ):
             result.outliers = resilience.run_phase(
                 "outliers_recursive", _outliers, config.resilience, m
             )
@@ -336,7 +389,8 @@ def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
                     f"{wedge_budget:,} (~28 B/wedge host scratch); using "
                     "the wedge-sampled estimator",
                 )
-        with m.timed("outliers_lof", k=config.lof_k,
+        with m.span("outliers_lof"), m.timed(
+                     "outliers_lof", k=config.lof_k,
                      devices=n_dev if use_sharded_lof else 1,
                      features="host-8-sampled" if scale_out else feature_mode):
             if scale_out:
@@ -396,6 +450,49 @@ def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
             over_1_5=int((result.lof > 1.5).sum()),
         )
     return result
+
+
+def _emit_superstep_telemetry(
+    m: MetricsSink, new, old, chunk: int, ndev: int, variant: str,
+    iteration: int,
+) -> int:
+    """``superstep_telemetry`` record: per-shard active counts and the
+    load-imbalance ratio for one superstep. Called only at the existing
+    tripwire/checkpoint cadence boundaries, where the driver already
+    syncs per superstep — the reduction runs on device and only a
+    [D]-int vector crosses to the host. Shards are the REAL partition
+    chunks (``chunk`` is partition_graph's padded size); shard count is
+    clamped to the chunks that actually cover real vertices, so the
+    per-shard counts sum to exactly the labels-changed total — which is
+    returned, sparing the caller a second full-vertex diff pass."""
+    import jax.numpy as jnp
+
+    d = max(1, min(int(ndev), -(-int(new.shape[0]) // max(chunk, 1))))
+    diff = new != old
+    pad = d * chunk - int(diff.shape[0])
+    if pad > 0:
+        diff = jnp.concatenate([diff, jnp.zeros((pad,), diff.dtype)])
+    per = np.asarray(
+        jnp.sum(jnp.reshape(diff, (d, chunk)), axis=1, dtype=jnp.int32)
+    )
+    changed = int(per.sum())
+    mean = changed / d
+    imbalance = float(per.max()) / mean if mean > 0 else 1.0
+    m.emit(
+        "superstep_telemetry",
+        iteration=iteration,
+        labels_changed=changed,
+        # synchronous LPA's frontier IS the changed set: exactly the
+        # vertices whose neighbors must re-reduce next superstep
+        frontier=changed,
+        shard_changed=per.tolist(),
+        shard_max=int(per.max()),  # per is never empty: d >= 1
+        shard_min=int(per.min()),
+        imbalance=round(imbalance, 3),
+        devices=int(ndev),
+        variant=variant,
+    )
+    return changed
 
 
 def _run_lpa(
@@ -571,12 +668,12 @@ def _run_lpa(
             ckpt.save_sharded(
                 config.checkpoint_dir, np.asarray(state["labels"]),
                 iteration, fingerprint=fingerprint,
-                num_shards=current["ndev"],
+                num_shards=current["ndev"], sink=m,
             )
         else:
             ckpt.save_labels(
                 config.checkpoint_dir, state["labels"], iteration,
-                fingerprint=fingerprint,
+                fingerprint=fingerprint, sink=m,
             )
 
     # Built supersteps survive retry re-entry: a transient failure at
@@ -706,6 +803,10 @@ def _run_lpa(
             if key not in superstep_cache:
                 superstep_cache[key] = make_superstep(var, nd)
             one_iter = superstep_cache[key]
+            m.registry.gauge(
+                "graphmine_devices_alive",
+                "devices in the active LPA mesh",
+            ).set(nd)
             while state["it"] < config.max_iter:
                 it = state["it"]
 
@@ -718,45 +819,83 @@ def _run_lpa(
                     new.block_until_ready()
                     return new
 
-                t0 = time.perf_counter()
-                # Watchdog contract: checkpoint-then-abort. On a hung
-                # superstep the LAST GOOD labels (iteration `it`) are
-                # saved before SuperstepTimeout surfaces, so the run
-                # resumes exactly where it hung. Unarmed (None) for an
-                # operating point's compile-bearing first superstep — see
-                # ``warmed`` above.
-                new = resilience.run_with_watchdog(
-                    "lpa_superstep", step_sync,
-                    policy.superstep_timeout_s if key in warmed else None,
-                    m,
-                    # no hook at all without a checkpoint_dir: the timeout
-                    # message/record must not claim a checkpoint was saved
-                    on_timeout=(
-                        (lambda it=it: save_ck(it))
-                        if config.checkpoint_dir else None
-                    ),
-                )
-                dt = time.perf_counter() - t0
-                warmed.add(key)
-                # Cadence (r3): every Nth superstep, plus always the final
-                # one so a completed run's checkpoint is never stale.
-                will_save = config.checkpoint_dir and (
-                    (it + 1) % config.checkpoint_every == 0
-                    or it + 1 == config.max_iter
-                )
-                # A superstep that will CHECKPOINT is always guarded too
-                # (when tripwires are armed): persisting unverified labels
-                # would rotate the last tripwire-validated generation away,
-                # and the rollback the tripwire promises would restore
-                # intact-but-garbage bytes.
-                if trip_k and ((it + 1) % trip_k == 0 or will_save):
-                    check_tripwire(new, it, var)
-                changed = int((new != state["labels"]).sum())
-                state["labels"] = new
-                state["it"] = it + 1
-                m.lpa_iteration(it + 1, changed, graph.num_edges, dt, chips)
-                if will_save:
-                    save_ck(it + 1)
+                # Superstep span (emit=False: lpa_iter IS the superstep
+                # record, already carrying this span's identity — a span
+                # record per superstep would double the stream). The
+                # TraceAnnotation names the XLA profiler slice after the
+                # span path, lining device traces up with the span tree.
+                with m.span("superstep", emit=False, iteration=it + 1):
+                    t0 = time.perf_counter()
+                    # Watchdog contract: checkpoint-then-abort. On a hung
+                    # superstep the LAST GOOD labels (iteration `it`) are
+                    # saved before SuperstepTimeout surfaces, so the run
+                    # resumes exactly where it hung. Unarmed (None) for an
+                    # operating point's compile-bearing first superstep —
+                    # see ``warmed`` above.
+                    new = resilience.run_with_watchdog(
+                        "lpa_superstep", step_sync,
+                        policy.superstep_timeout_s if key in warmed else None,
+                        m,
+                        # no hook at all without a checkpoint_dir: the
+                        # timeout message/record must not claim a
+                        # checkpoint was saved
+                        on_timeout=(
+                            (lambda it=it: save_ck(it))
+                            if config.checkpoint_dir else None
+                        ),
+                    )
+                    dt = time.perf_counter() - t0
+                    warmed.add(key)
+                    # Cadence (r3): every Nth superstep, plus always the
+                    # final one so a completed run's checkpoint is never
+                    # stale.
+                    will_save = config.checkpoint_dir and (
+                        (it + 1) % config.checkpoint_every == 0
+                        or it + 1 == config.max_iter
+                    )
+                    # A superstep that will CHECKPOINT is always guarded
+                    # too (when tripwires are armed): persisting
+                    # unverified labels would rotate the last
+                    # tripwire-validated generation away, and the rollback
+                    # the tripwire promises would restore
+                    # intact-but-garbage bytes.
+                    if trip_k and ((it + 1) % trip_k == 0 or will_save):
+                        check_tripwire(new, it, var)
+                    # Superstep telemetry piggybacks on the EXISTING
+                    # cadence (tripwire / checkpoint boundaries, plus the
+                    # final superstep): the driver already syncs each
+                    # superstep for the labels-changed counter, so the
+                    # per-shard [D] fetch adds no sync point — and
+                    # off-cadence supersteps pay nothing. At a telemetry
+                    # boundary the changed count comes from the per-shard
+                    # sums (one diff pass, not two).
+                    if will_save or it + 1 == config.max_iter or (
+                        trip_k and (it + 1) % trip_k == 0
+                    ):
+                        changed = _emit_superstep_telemetry(
+                            m, new, state["labels"],
+                            current.get("chunk_size") or graph.num_vertices,
+                            nd, var, it + 1,
+                        )
+                    else:
+                        changed = int((new != state["labels"]).sum())
+                    state["labels"] = new
+                    state["it"] = it + 1
+                    reg = m.registry
+                    reg.gauge(
+                        "graphmine_superstep", "last completed LPA superstep"
+                    ).set(it + 1)
+                    reg.gauge(
+                        "graphmine_labels_changed",
+                        "labels changed in the last superstep",
+                    ).set(changed)
+                    reg.counter(
+                        "graphmine_supersteps_total",
+                        "LPA supersteps completed this run",
+                    ).inc()
+                    m.lpa_iteration(it + 1, changed, graph.num_edges, dt, chips)
+                    if will_save:
+                        save_ck(it + 1)
             return state["labels"]
 
         return run
@@ -785,7 +924,7 @@ def _run_lpa(
             device_rungs.append(
                 ("single_sort@1dev", make_runner("single_sort", 1))
             )
-    with maybe_profile(config.profile_dir):
+    with maybe_profile(config.profile_dir, sink=m):
         labels = resilience.run_phase(
             "lpa", make_runner(run_plan.schedule), policy, m,
             ladder=tuple((v, make_runner(v)) for v in rungs),

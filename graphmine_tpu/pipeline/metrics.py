@@ -4,6 +4,15 @@ The reference's only observability was ``print``/``show`` calls
 (``Graphframes.py:18,32,54,68,74,82,85,120``). Here every pipeline phase
 emits a structured JSON record, and LPA reports the driver's headline
 metric — **edges/sec/chip** per iteration (BASELINE.json ``"metric"``).
+
+Run-correlated tracing (docs/OBSERVABILITY.md): a sink constructed with a
+:class:`~graphmine_tpu.obs.spans.Tracer` stamps every record with
+``run_id`` / ``trace_id`` / ``span_id`` / ``span_path``, so the
+resilience machine's retry / degrade / mesh_degrade / tripwire /
+checkpoint records are joinable into one causal timeline
+(``tools/obs_report.py``). The sink also owns a counter/gauge
+:class:`~graphmine_tpu.obs.registry.Registry` (the level surface the
+heartbeat and the Prometheus textfile exporter read).
 """
 
 from __future__ import annotations
@@ -11,8 +20,13 @@ from __future__ import annotations
 import contextlib
 import json
 import logging
+import os
+import threading
 import time
 from dataclasses import dataclass, field
+
+from graphmine_tpu.obs.registry import Registry
+from graphmine_tpu.obs.spans import xla_annotation
 
 log = logging.getLogger("graphmine_tpu")
 
@@ -26,51 +40,126 @@ class MetricsSink:
     would lose exactly the records that matter most — a preemption or
     OOM-kill ends the process without running any ``finally`` block, and
     those are the runs whose retry/degrade/rollback trail the operator
-    needs. A stream write failure disables streaming with one warning
-    (the in-memory records remain for the exit-time fallback)."""
+    needs. The stream opens in **append** mode: a resumed run reusing the
+    same ``--metrics-out`` path must not clobber the prior attempt's
+    trail (each run's records begin at its ``run_start`` header and carry
+    its ``run_id``). A stream write failure disables streaming with one
+    warning (the in-memory records remain for the exit-time fallback).
+
+    ``tracer``: optional :class:`~graphmine_tpu.obs.spans.Tracer`; when
+    set, every record carries the current span's identity. ``registry``:
+    the run's counter/gauge registry (always present — callers increment
+    unconditionally; it only *exports* when asked).
+
+    Emission is thread-safe (the heartbeat thread and the driver thread
+    share one sink); each record is appended and streamed under one lock.
+    """
 
     records: list = field(default_factory=list)
     stream_path: str | None = None
+    tracer: object | None = None
+    registry: Registry = field(default_factory=Registry, repr=False)
     _stream: object = field(default=None, repr=False)
     _stream_ok: bool = field(default=True, repr=False)
+    _streamed: int = field(default=0, repr=False)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
-    def emit(self, phase: str, **kv) -> dict:
-        rec = {"phase": phase, "t": time.time(), **kv}
-        self.records.append(rec)
+    def emit(self, phase: str, _span=None, **kv) -> dict:
+        """Append one record (and stream it). ``_span`` pins the record
+        to a specific :class:`~graphmine_tpu.obs.spans.Span` instead of
+        the thread-current one — used for ``span`` records, which must
+        carry their *own* identity, emitted after the span closed."""
+        rec = {"phase": phase, "t": time.time()}
+        tr = self.tracer
+        if tr is not None:
+            sp = _span if _span is not None else tr.current()
+            rec["run_id"] = tr.run_id
+            rec["trace_id"] = tr.trace_id
+            rec["span_id"] = sp.span_id
+            rec["span_path"] = sp.path
+            if _span is not None and sp.parent_id is not None:
+                rec["parent_span_id"] = sp.parent_id
+        rec.update(kv)
         line = json.dumps(rec, default=str)
         log.info("%s", line)
-        if self.stream_path is not None and self._stream_ok:
-            try:
-                if self._stream is None:
-                    self._stream = open(self.stream_path, "w")
-                self._stream.write(line + "\n")
-                self._stream.flush()
-            except OSError as e:
-                self._stream_ok = False
-                log.warning(
-                    "metrics stream to %s failed: %r; records will be "
-                    "written at exit instead", self.stream_path, e,
-                )
+        with self._lock:
+            self.records.append(rec)
+            if self.stream_path is not None and self._stream_ok:
+                try:
+                    if self._stream is None:
+                        self._stream = open(self.stream_path, "a")
+                    self._stream.write(line + "\n")
+                    self._stream.flush()
+                    self._streamed += 1
+                except OSError as e:
+                    self._stream_ok = False
+                    log.warning(
+                        "metrics stream to %s failed: %r; records will be "
+                        "written at exit instead", self.stream_path, e,
+                    )
         return rec
 
     @contextlib.contextmanager
     def timed(self, phase: str, **kv):
+        """Timed phase record. When the body raises, the record keeps its
+        failure identity — ``ok=false`` plus ``error`` (the classified
+        kind from the resilience taxonomy) and ``error_detail`` — instead
+        of being indistinguishable from a success; the exception always
+        propagates."""
         t0 = time.perf_counter()
         try:
             yield
+        except BaseException as e:
+            from graphmine_tpu.pipeline.resilience import classify_error
+
+            self.emit(
+                phase, seconds=round(time.perf_counter() - t0, 4),
+                ok=False, error=classify_error(e), error_detail=repr(e),
+                **kv,
+            )
+            raise
+        self.emit(phase, seconds=round(time.perf_counter() - t0, 4), **kv)
+
+    @contextlib.contextmanager
+    def span(self, name: str, emit: bool = True, annotate: bool = True, **attrs):
+        """Open a tracer span for the block (no-op yielding None without
+        a tracer). ``emit``: write a ``span`` record at close (the phase
+        waterfall's raw material) — superstep spans pass False so a long
+        run is not doubled by per-superstep span records (``lpa_iter``
+        already carries the superstep span's identity). ``annotate``:
+        also enter a ``jax.profiler.TraceAnnotation`` named by the span
+        path, so XLA profiler traces line up with the span tree."""
+        if self.tracer is None:
+            yield None
+            return
+        sp = None
+        try:
+            with self.tracer.span(name, **attrs) as sp:
+                if annotate:
+                    with xla_annotation(sp.path):
+                        yield sp
+                else:
+                    yield sp
         finally:
-            self.emit(phase, seconds=round(time.perf_counter() - t0, 4), **kv)
+            if emit and sp is not None:
+                self.emit(
+                    "span", _span=sp, name=sp.name,
+                    seconds=round(sp.seconds, 4), status=sp.status,
+                    **sp.attrs,
+                )
 
     def of_phase(self, phase: str) -> list:
         """All records for one phase name — recovery events (``retry``,
         ``degrade``, ``quarantine``, ``checkpoint_rollback``, ...) are
         phases like any other, so observability tooling and tests filter
-        them the same way."""
+        them the same way (span-tagged records filter identically: the
+        trace keys ride alongside ``phase``, never replace it)."""
         return [r for r in self.records if r.get("phase") == phase]
 
     def write_jsonl(self, path: str) -> str:
-        """Dump every record as JSON lines (the on-disk twin of the
-        logging stream; one file per run for offline triage)."""
+        """Dump every record as JSON lines (full-file rewrite — the
+        explicit export API; run-appending persistence is
+        :meth:`finalize`)."""
         with open(path, "w") as f:
             for rec in self.records:
                 f.write(json.dumps(rec, default=str) + "\n")
@@ -79,7 +168,9 @@ class MetricsSink:
     def finalize(self, path: str) -> str:
         """End-of-run persistence: when the live stream wrote every
         record, just close it; otherwise (streaming off, or it failed
-        mid-run) write the whole file in one pass."""
+        mid-run, or a different target path) **append** the records the
+        stream never persisted — never truncate, the file may hold prior
+        runs' records (a resumed run reusing one ``--metrics-out``)."""
         if self._stream is not None:
             try:
                 self._stream.close()
@@ -88,7 +179,23 @@ class MetricsSink:
             self._stream = None
             if self._stream_ok and self.stream_path == path:
                 return path
-        return self.write_jsonl(path)
+        start = self._streamed if path == self.stream_path else 0
+        # A stream that died mid-write (ENOSPC, EIO) can leave a torn
+        # final line; appending straight after it would merge the torn
+        # prefix with the first record below into one unparseable line.
+        needs_nl = False
+        try:
+            with open(path, "rb") as rf:
+                rf.seek(-1, os.SEEK_END)
+                needs_nl = rf.read(1) != b"\n"
+        except (OSError, ValueError):
+            pass  # missing or empty file: nothing to repair
+        with open(path, "a") as f:
+            if needs_nl:
+                f.write("\n")
+            for rec in self.records[start:]:
+                f.write(json.dumps(rec, default=str) + "\n")
+        return path
 
     def tripwire(self, kind: str, shard: int, iteration: int, **kv):
         """Structured record for an in-loop divergence-tripwire firing
@@ -114,15 +221,43 @@ class MetricsSink:
 
 
 @contextlib.contextmanager
-def maybe_profile(profile_dir: str | None):
-    """jax.profiler trace around a pipeline phase (SURVEY §5 tracing)."""
+def maybe_profile(profile_dir: str | None, sink: MetricsSink | None = None):
+    """jax.profiler trace around a pipeline phase (SURVEY §5 tracing).
+
+    Hardened (ISSUE 3 satellite): a failing ``start_trace`` runs the body
+    unprofiled instead of aborting the run, and ``stop_trace`` failures
+    are contained — a raise out of the ``finally`` would *mask the
+    body's own error*, which is the one the operator needs. Either
+    outcome is recorded as a ``profile_capture`` record carrying the
+    trace dir, so offline reports can link the XLA trace (or its
+    absence) to the run.
+    """
     if not profile_dir:
         yield
         return
     import jax
 
-    jax.profiler.start_trace(profile_dir)
+    try:
+        jax.profiler.start_trace(profile_dir)
+    except Exception as e:
+        log.warning("profiler start_trace(%s) failed: %r; running "
+                    "unprofiled", profile_dir, e)
+        if sink is not None:
+            sink.emit("profile_capture", dir=profile_dir, ok=False,
+                      error=repr(e))
+        yield
+        return
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            log.warning("profiler stop_trace failed: %r (trace dir %s may "
+                        "be incomplete)", e, profile_dir)
+            if sink is not None:
+                sink.emit("profile_capture", dir=profile_dir, ok=False,
+                          error=repr(e))
+        else:
+            if sink is not None:
+                sink.emit("profile_capture", dir=profile_dir, ok=True)

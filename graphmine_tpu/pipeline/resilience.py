@@ -26,6 +26,7 @@ Everything here is stdlib-only and host-side; nothing imports jax.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import random
 import threading
@@ -210,6 +211,27 @@ class ResilienceConfig:
         return self
 
 
+def _count(metrics, name: str) -> None:
+    """Bump a counter on the sink's registry when it has one — the
+    resilience layer stays duck-typed over ``metrics`` (tests pass bare
+    stubs), so the level surface is best-effort by design."""
+    reg = getattr(metrics, "registry", None)
+    if reg is not None:
+        reg.counter(name).inc()
+
+
+def _rung_span(metrics, label: str):
+    """A tracer span around one ladder rung's execution (no-op for sinks
+    without span support). ``emit=False``: the rung's identity matters —
+    every retry/fault record inside carries ``rung:<label>`` in its span
+    path — but a span *record* per rung attempt would double the stream
+    for phases that never degrade."""
+    span = getattr(metrics, "span", None)
+    if span is None:
+        return contextlib.nullcontext()
+    return span(f"rung:{label}", emit=False)
+
+
 def backoff_s(policy: ResilienceConfig, attempt: int, rng: random.Random) -> float:
     """Jittered exponential delay before retry ``attempt`` (1-based)."""
     base = min(policy.backoff_base_s * (2 ** (attempt - 1)), policy.backoff_max_s)
@@ -247,6 +269,7 @@ def _retry_loop(name, thunk, policy, metrics, sleep, rng, progress=None):
                     f"{attempt} attempts with no progress: {e!r}"
                 ) from e
             delay = backoff_s(policy, attempt, rng)
+            _count(metrics, "graphmine_retries_total")
             metrics.emit(
                 "retry", stage=name, attempt=attempt,
                 backoff_s=round(delay, 4), error=repr(e),
@@ -298,28 +321,35 @@ def run_phase(
     dev = list(device_ladder)
     thunk = fn
     depth = 0
+    # The rung label names the span every record inside executes under
+    # ("rung:primary", then the ladder labels) — the span-path join key
+    # that ties a retry record to the operating point it retried AT.
+    rung = "primary"
     while True:
         try:
-            return _retry_loop(
-                name, thunk, policy, metrics, sleep, rng, progress
-            )
+            with _rung_span(metrics, rung):
+                return _retry_loop(
+                    name, thunk, policy, metrics, sleep, rng, progress
+                )
         except Exception as e:
             cls = classify_error(e)
             if policy.degradation != "auto":
                 raise
             if cls == DEGRADABLE and mem:
-                label, thunk = mem.pop(0)
+                rung, thunk = mem.pop(0)
                 depth += 1
+                _count(metrics, "graphmine_degrades_total")
                 metrics.emit(
-                    "degrade", stage=name, to=label, depth=depth,
+                    "degrade", stage=name, to=rung, depth=depth,
                     error=repr(e),
                 )
                 continue
             if cls == DEGRADABLE_DEVICE and dev:
-                label, thunk = dev.pop(0)
+                rung, thunk = dev.pop(0)
                 depth += 1
+                _count(metrics, "graphmine_degrades_total")
                 metrics.emit(
-                    "degrade", stage=name, to=label, depth=depth,
+                    "degrade", stage=name, to=rung, depth=depth,
                     kind="device", error=repr(e),
                 )
                 continue
@@ -365,6 +395,7 @@ def run_with_watchdog(name, fn, timeout_s, metrics, on_timeout=None):
                 checkpointed = True
             except Exception as e:
                 save_err = e
+        _count(metrics, "graphmine_watchdog_timeouts_total")
         metrics.emit(
             "watchdog_timeout", stage=name, timeout_s=timeout_s,
             checkpointed=checkpointed,

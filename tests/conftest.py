@@ -64,6 +64,13 @@ def pytest_configure(config):
         "the default CPU pass — select with -m faults or "
         "tools/run_tier1.sh --faults-only",
     )
+    config.addinivalue_line(
+        "markers",
+        "obs: tracing/telemetry suite (tests/test_obs.py: spans, record "
+        "schema, heartbeat, superstep telemetry, obs_report e2e); runs in "
+        "the default CPU pass — select with -m obs or "
+        "tools/run_tier1.sh --obs-only",
+    )
     if not (_needs_reexec() and _invoked_as_pytest_cli()):
         return
     cap = config.pluginmanager.getplugin("capturemanager")

@@ -1,0 +1,335 @@
+#!/usr/bin/env python
+"""Offline triage: join a metrics JSONL into a human report.
+
+The metrics stream (``--metrics-out``) is an append-mode JSONL whose
+records carry run/trace/span identity (docs/OBSERVABILITY.md). This tool
+reconstructs, **from the JSONL alone** (no repo state, no checkpoint
+dir):
+
+- the run header: run_id, start time, wall clock, and the liveness
+  verdict — ``ok`` / ``error`` from the ``run_end`` record, or, when the
+  stream just *ends*, ``HUNG`` (heartbeats outlived the last phase
+  record) vs ``DEAD`` (everything stopped together);
+- the **phase waterfall** from ``span`` records (offset + duration bars);
+- the **per-superstep throughput table** (``lpa_iter``: labels changed,
+  seconds, edges/sec/chip with a trend bar);
+- **superstep telemetry**: frontier size and per-shard load-imbalance
+  ratios at the tripwire/checkpoint cadence;
+- the **recovery timeline**: every retry / degrade / mesh_degrade /
+  tripwire / watchdog_timeout / checkpoint rollback / resume, in causal
+  order, each with its span path — *which* incident hit *which* phase on
+  *which* mesh rung.
+
+Usage::
+
+    python tools/obs_report.py METRICS.jsonl [--run-id ID] [--out PATH]
+
+A reused metrics file holds several ``run_start``-delimited segments; the
+default is the most recent run (``--run-id`` selects another). Exit code
+0 on success, 2 when the file is missing/empty or the run id is unknown.
+Stdlib-only (usable on a machine with no jax at all).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+_REPO = __file__.rsplit("/", 2)[0]
+if _REPO not in sys.path:  # allow `python tools/obs_report.py` from anywhere
+    sys.path.insert(0, _REPO)
+
+from graphmine_tpu.obs.schema import RECOVERY_PHASES, validate_record  # noqa: E402
+
+BAR = "█"
+BAR_WIDTH = 30
+
+
+def load_records(path: str):
+    """Parse a JSONL file tolerantly: unparseable/unknown-shape lines are
+    counted, not fatal — a torn final line (the process died mid-write)
+    is exactly the stream this tool exists to read."""
+    records, bad = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                bad += 1
+                continue
+            if not isinstance(rec, dict) or "phase" not in rec:
+                bad += 1
+                continue
+            records.append(rec)
+    return records, bad
+
+
+def split_runs(records):
+    """Group records into runs. Preferred key: ``run_id`` (order of first
+    appearance). Records with no run_id (pre-tracing streams) fall into
+    segments delimited by ``run_start`` records, keyed ``segment-N``."""
+    runs: dict = {}
+    order: list = []
+    seg_key = None
+    seg = 0
+    for rec in records:
+        rid = rec.get("run_id")
+        if rid is None:
+            if rec.get("phase") == "run_start" or seg_key is None:
+                seg += 1
+                seg_key = f"segment-{seg}"
+            rid = seg_key
+        if rid not in runs:
+            runs[rid] = []
+            order.append(rid)
+        runs[rid].append(rec)
+    return runs, order
+
+
+def _fmt_offset(rec, t0):
+    return f"+{rec.get('t', t0) - t0:8.2f}s"
+
+
+def _short_path(rec):
+    path = rec.get("span_path", "")
+    return path[4:] if path.startswith("run/") else path  # strip "run/"
+
+
+def _bar(frac: float, width: int = BAR_WIDTH) -> str:
+    n = max(0, min(width, round(frac * width)))
+    return BAR * n
+
+
+def _phase_waterfall(records, t0):
+    spans = [r for r in records if r.get("phase") == "span"]
+    rows = []
+    if spans:
+        for r in spans:
+            secs = float(r.get("seconds", 0.0))
+            start = float(r.get("t", t0)) - secs - t0
+            rows.append((start, r.get("name", "?"), secs,
+                         r.get("status", "ok"), _short_path(r)))
+    else:  # pre-span streams: fall back to timed phase records
+        for r in records:
+            if "seconds" in r and r.get("phase") not in (
+                "lpa_iter", "span", "superstep_telemetry"
+            ):
+                secs = float(r["seconds"])
+                rows.append((float(r.get("t", t0)) - secs - t0,
+                             r["phase"], secs, "ok", ""))
+    if not rows:
+        return ["  (no phase records)"]
+    rows.sort()
+    total = max((s + d for s, _, d, _, _ in rows), default=1.0) or 1.0
+    width = max(len(n) for _, n, _, _, _ in rows)
+    out = []
+    for start, name, secs, status, _ in rows:
+        flag = "" if status == "ok" else f"  [{status.upper()}]"
+        out.append(
+            f"  {name:<{width}}  {start:8.2f}s  {secs:8.2f}s  "
+            f"{_bar(secs / total)}{flag}"
+        )
+    return out
+
+
+def _superstep_table(records):
+    iters = [r for r in records if r.get("phase") == "lpa_iter"]
+    if not iters:
+        return ["  (no lpa_iter records)"]
+    peak = max(r.get("edges_per_sec_per_chip", 0) for r in iters) or 1
+    out = ["  it  changed   seconds   edges/sec/chip"]
+    for r in iters:
+        eps = r.get("edges_per_sec_per_chip", 0)
+        out.append(
+            f"  {r.get('iteration', '?'):>2}  {r.get('labels_changed', 0):>7}"
+            f"  {r.get('seconds', 0):>8.4f}  {eps:>14,}  {_bar(eps / peak, 20)}"
+        )
+    return out
+
+
+def _telemetry_table(records):
+    tele = [r for r in records if r.get("phase") == "superstep_telemetry"]
+    if not tele:
+        return ["  (no superstep_telemetry records)"]
+    out = ["  it  frontier  shards  shard min/max  imbalance  variant"]
+    for r in tele:
+        out.append(
+            f"  {r.get('iteration', '?'):>2}  {r.get('frontier', 0):>8}"
+            f"  {r.get('devices', '?'):>6}"
+            f"  {r.get('shard_min', '?'):>6}/{r.get('shard_max', '?'):<6}"
+            f"  {r.get('imbalance', '?'):>9}  {r.get('variant', '?')}"
+        )
+    return out
+
+
+_DETAIL_KEYS = {
+    "retry": ("stage", "attempt", "backoff_s"),
+    "retries_exhausted": ("stage", "attempts"),
+    "degrade": ("stage", "to", "kind"),
+    "mesh_degrade": ("from_devices", "to_devices", "iteration",
+                     "resumed_from", "dead_devices"),
+    "tripwire": ("kind", "shard", "iteration"),
+    "watchdog_timeout": ("stage", "timeout_s", "checkpointed"),
+    "resume": ("iteration", "reason"),
+    "checkpoint_rollback": ("path",),
+    "checkpoint_rollback_ok": ("path", "iteration"),
+    "ivf_fallback": ("guard",),
+    "quarantine": (),
+}
+
+
+def _recovery_timeline(records, t0):
+    events = [r for r in records if r.get("phase") in RECOVERY_PHASES]
+    if not events:
+        return ["  (clean run: no recovery events)"]
+    out = []
+    for r in events:
+        keys = _DETAIL_KEYS.get(r["phase"], ())
+        detail = "  ".join(
+            f"{k}={r[k]}" for k in keys if k in r and r[k] is not None
+        )
+        err = r.get("error")
+        if err and r["phase"] in ("retry", "retries_exhausted", "degrade"):
+            err = str(err)
+            detail += f"  error={err[:70]}{'…' if len(err) > 70 else ''}"
+        where = _short_path(r)
+        out.append(
+            f"  {_fmt_offset(r, t0)}  {r['phase']:<22}"
+            f"{('[' + where + ']  ') if where else ''}{detail}"
+        )
+    return out
+
+
+def _liveness(records, t0):
+    end = next((r for r in records if r.get("phase") == "run_end"), None)
+    if end is not None:
+        if end.get("ok"):
+            return "ok", f"completed in {end.get('t', t0) - t0:.2f}s"
+        detail = end.get("error_detail", end.get("error", ""))
+        return "error", f"failed ({end.get('error', '?')}): {detail}"
+    # no run_end: the process died or hung. Heartbeats disambiguate.
+    beats = [r for r in records if r.get("phase") == "heartbeat"]
+    others = [r for r in records if r.get("phase") not in ("heartbeat",)]
+    last_t = max((r.get("t", t0) for r in others), default=t0)
+    if beats and beats[-1].get("t", t0) > last_t + 1.0:
+        busy = beats[-1].get("busy", "?")
+        return "HUNG", (
+            f"no run_end, but heartbeats continued {beats[-1]['t'] - last_t:.1f}s "
+            f"past the last phase record (last busy: {busy}) — the process "
+            "was alive but stuck"
+        )
+    return "DEAD", (
+        "no run_end and no trailing heartbeats — the process was killed "
+        "(preemption / OOM-kill) or crashed without cleanup"
+    )
+
+
+def _heartbeat_summary(records, t0):
+    beats = [r for r in records if r.get("phase") == "heartbeat"]
+    if not beats:
+        return ["  (heartbeat disabled)"]
+    ts = [r.get("t", t0) for r in beats]
+    gaps = [b - a for a, b in zip(ts, ts[1:])]
+    rss = [r["rss_mb"] for r in beats if "rss_mb" in r]
+    line = (f"  {len(beats)} beats, last +{ts[-1] - t0:.2f}s,"
+            f" max gap {max(gaps):.2f}s" if gaps else
+            f"  {len(beats)} beat(s)")
+    if rss:
+        line += f", peak RSS {max(rss):.0f} MiB"
+    return [line]
+
+
+def build_report(records, source: str = "", bad_lines: int = 0) -> str:
+    """Render one run's records (already filtered to a single run_id)."""
+    start = next((r for r in records if r.get("phase") == "run_start"), None)
+    t0 = records[0].get("t", 0.0) if records else 0.0
+    run_id = records[0].get("run_id", "?") if records else "?"
+    unknown = sum(
+        1 for r in records
+        if any("unknown phase" in p for p in validate_record(r))
+    )
+    status, verdict = _liveness(records, t0)
+    import time as _time
+
+    started = _time.strftime("%Y-%m-%d %H:%M:%S UTC", _time.gmtime(t0))
+    lines = ["== graphmine_tpu run report =="]
+    if source:
+        lines.append(f"source: {source}")
+    lines.append(f"run_id: {run_id}    started: {started}")
+    if start is not None:
+        cfgbits = "  ".join(
+            f"{k}={start[k]}" for k in
+            ("backend", "schedule", "community_method", "max_iter", "pid")
+            if k in start
+        )
+        lines.append(f"config: {cfgbits}")
+        lines.append(f"data:   {start.get('data_path', '?')}")
+    lines.append(f"status: {status} — {verdict}")
+    note = []
+    if bad_lines:
+        note.append(f"{bad_lines} unparseable line(s)")
+    if unknown:
+        note.append(f"{unknown} unknown-schema record(s)")
+    lines.append(
+        f"records: {len(records)}" + (f"  ({', '.join(note)})" if note else "")
+    )
+    lines.append("")
+    lines.append("-- phase waterfall --")
+    lines.extend(_phase_waterfall(records, t0))
+    lines.append("")
+    lines.append("-- lpa supersteps --")
+    lines.extend(_superstep_table(records))
+    lines.append("")
+    lines.append("-- superstep telemetry (load imbalance) --")
+    lines.extend(_telemetry_table(records))
+    lines.append("")
+    lines.append("-- recovery timeline --")
+    lines.extend(_recovery_timeline(records, t0))
+    lines.append("")
+    lines.append("-- heartbeats --")
+    lines.extend(_heartbeat_summary(records, t0))
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("metrics", help="metrics JSONL (--metrics-out of a run)")
+    ap.add_argument("--run-id", default=None,
+                    help="report this run (default: the most recent)")
+    ap.add_argument("--out", default=None, help="write the report here "
+                    "instead of stdout")
+    args = ap.parse_args(argv)
+    try:
+        records, bad = load_records(args.metrics)
+    except OSError as e:
+        print(f"obs_report: cannot read {args.metrics}: {e}", file=sys.stderr)
+        return 2
+    if not records:
+        print(f"obs_report: no records in {args.metrics}", file=sys.stderr)
+        return 2
+    runs, order = split_runs(records)
+    rid = args.run_id or order[-1]
+    if rid not in runs:
+        print(
+            f"obs_report: run_id {rid!r} not in {args.metrics} "
+            f"(have: {', '.join(order)})", file=sys.stderr,
+        )
+        return 2
+    report = build_report(runs[rid], source=args.metrics, bad_lines=bad)
+    if len(order) > 1:
+        report += (f"\n({len(order)} runs in this file: "
+                   + ", ".join(order) + ")\n")
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(report)
+    else:
+        sys.stdout.write(report)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,9 +7,12 @@
 # (-m faults: tests/test_resilience.py + the tripwire/reshard cases in
 # tests/test_sharded.py) is part of this default pass.
 #
-# Usage: tools/run_tier1.sh [--faults-only] [extra pytest args...]
+# Usage: tools/run_tier1.sh [--faults-only|--obs-only] [extra pytest args...]
 #   --faults-only  run just the `faults`-marked recovery suite — the fast
 #                  pre-commit loop when iterating on resilience paths
+#   --obs-only     run just the `obs`-marked tracing/telemetry suite
+#                  (tests/test_obs.py: spans, schema validation, heartbeat,
+#                  superstep telemetry, obs_report e2e)
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -17,6 +20,9 @@ MARKER='not slow'
 if [ "${1:-}" = "--faults-only" ]; then
     shift
     MARKER='faults and not slow'
+elif [ "${1:-}" = "--obs-only" ]; then
+    shift
+    MARKER='obs and not slow'
 fi
 
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
