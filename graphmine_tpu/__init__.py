@@ -50,7 +50,7 @@ from graphmine_tpu.ops.features import (
 )
 from graphmine_tpu.ops.ann import ivf_knn, kmeans
 from graphmine_tpu.ops.knn import knn
-from graphmine_tpu.ops.lof import lof_scores
+from graphmine_tpu.ops.lof import lof_scores, select_lof_impl
 from graphmine_tpu.ops.outliers import (
     masked_label_propagation,
     recursive_lpa_outliers,
@@ -79,13 +79,22 @@ from graphmine_tpu.table import Table, read_parquet
 from graphmine_tpu.ops.svdpp import svd_plus_plus, svdpp_predict
 from graphmine_tpu.interop import from_networkx, graph_from_networkx, to_networkx
 from graphmine_tpu.oracle import graphx_label_propagation
-from graphmine_tpu.pipeline.planner import PlanError, RunPlan, plan_run
+from graphmine_tpu.pipeline.planner import (
+    LofPlan,
+    PlanError,
+    RunPlan,
+    plan_lof,
+    plan_run,
+)
 
 __all__ = [
     "graphx_label_propagation",
     "plan_run",
+    "plan_lof",
     "RunPlan",
+    "LofPlan",
     "PlanError",
+    "select_lof_impl",
     "vertex_features_host",
     "Graph",
     "GraphFrame",

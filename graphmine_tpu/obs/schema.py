@@ -63,6 +63,7 @@ register("outliers_recursive_lpa", "seconds")
 register("outliers_lof", "seconds", "k", "devices", "features")
 register("outlier_summary", "method")
 register("ivf_fallback", "guard", "detail")
+register("impl_selected", "op", "impl", "n", "reason")
 
 # ---- recovery / resilience records (docs/RESILIENCE.md) -------------------
 register("retry", "stage", "attempt", "backoff_s", "error")

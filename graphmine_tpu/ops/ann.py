@@ -53,6 +53,16 @@ from graphmine_tpu.ops.knn import cross_knn
 _ASSIGN_TILE = 1 << 15  # [32768, C] distance tiles: 64 MB at C=512
 
 
+def default_n_clusters(n: int) -> int:
+    """The IVF index's default cluster count for an ``n``-point set:
+    ``~sqrt(N)``, rounded to a multiple of 8, min 8. Single owner —
+    :func:`ivf_knn`'s default, the streaming re-fit's full-window sizing
+    (and its exact-warmup gate ``n < 4 * C``), and the stream bench's
+    reuse micro-bench must all size the SAME index, or a retune here
+    would silently desync what they build/gate/measure."""
+    return max(8, int(round(np.sqrt(n) / 8)) * 8)
+
+
 @jax.jit
 def _assign_tiled(points: jax.Array, centers: jax.Array) -> jax.Array:
     """Nearest-center id per point via row-tiled full [T, C] distances
@@ -130,6 +140,27 @@ def _search_clusters(q_vec, q_gid, m_vec, m_gid, m_valid, k: int):
     return -neg, m_gid[j]
 
 
+def _search_chunks(pts, m_gid, m_valid, q_gid, row_sub, k: int):
+    """Default (single-device) executor for the cluster-batched search:
+    one ``lax.map`` over the fixed-size query chunks. Inputs are the host
+    tables :func:`ivf_knn` built (float32 points, int32 member/query ids,
+    bool member validity); returns ``([R, B, k] d2, [R, B, k] gid)``.
+    Padded duplicate query slots produce junk rows; they are never read
+    back (``slot_of_pair`` only maps REAL pairs)."""
+    pts_dev = jnp.asarray(pts)
+    m_gid_dev = jnp.asarray(m_gid)
+    m_valid_dev = jnp.asarray(m_valid)
+
+    def one_chunk(args):
+        qg, s = args
+        mg = m_gid_dev[s]
+        return _search_clusters(
+            pts_dev[qg], qg, pts_dev[mg], mg, m_valid_dev[s], k
+        )
+
+    return lax.map(one_chunk, (jnp.asarray(q_gid), jnp.asarray(row_sub)))
+
+
 def _exact_fallback(pts, k, guard: str, detail: str, sink):
     """The honest exit when an IVF pathology guard trips: run the exact
     path — but LOUDLY (ADVICE r5). The silent version cost a round of
@@ -158,6 +189,8 @@ def ivf_knn(
     seed: int = 0,
     kmeans_iters: int = 5,
     sink=None,
+    centers=None,
+    search_exec=None,
 ):
     """Approximate k nearest neighbors (IVF-flat). ``(d2, idx)`` like
     :func:`~graphmine_tpu.ops.knn.knn`: ``[N, k]`` ascending squared
@@ -174,13 +207,35 @@ def ivf_knn(
     each with a ``warnings.warn`` and — when ``sink`` (a
     :class:`~graphmine_tpu.pipeline.metrics.MetricsSink`) is given — an
     ``ivf_fallback`` record naming the guard (ADVICE r5).
+
+    ``centers`` (r6): pre-trained float32 ``[C, F]`` k-means centers —
+    skips the Lloyd iterations entirely (the expensive part of index
+    construction) and only re-assigns points against them. The streaming
+    LOF scorer reuses one trained index across sliding windows this way
+    (centroids are stable between chunks; see
+    :class:`~graphmine_tpu.ops.streaming_lof.StreamingLOF`).
+
+    ``search_exec`` (r6): overrides the device executor for the
+    cluster-batched search stage — ``(pts, m_gid, m_valid, q_gid,
+    row_sub, k) -> (d2_all, gid_all)`` of shape ``[R', B, k]`` with
+    ``R' >= R`` chunk rows (extra padded rows appended at the END are
+    sliced off; their results are never read). The mesh-sharded LOF path
+    distributes exactly this stage — the dominant distance work — over
+    devices (:func:`graphmine_tpu.parallel.knn.sharded_lof`).
     """
     pts = np.asarray(points, np.float32)
     n, f = pts.shape
     if not 0 < k < n:
         raise ValueError(f"k={k} must be in (0, {n})")
-    if n_clusters is None:
-        n_clusters = max(8, int(round(np.sqrt(n) / 8)) * 8)
+    if centers is not None:
+        centers = jnp.asarray(np.asarray(centers, np.float32))
+        if centers.ndim != 2 or centers.shape[1] != f:
+            raise ValueError(
+                f"centers must be [C, {f}], got {tuple(centers.shape)}"
+            )
+        n_clusters = int(centers.shape[0])
+    elif n_clusters is None:
+        n_clusters = default_n_clusters(n)
     n_probe = min(n_probe, n_clusters)
     from graphmine_tpu.ops.knn import knn as exact_knn
 
@@ -189,7 +244,8 @@ def ivf_knn(
         # route to the exact path by design, no warning
         return exact_knn(pts, k, impl="auto")
 
-    centers = kmeans(pts, n_clusters, iters=kmeans_iters, seed=seed)
+    if centers is None:
+        centers = kmeans(pts, n_clusters, iters=kmeans_iters, seed=seed)
     # probe assignment: each query's n_probe nearest centers; column 0
     # is the owning cluster (a point is always a member of its own
     # nearest cluster's list).
@@ -331,33 +387,34 @@ def ivf_knn(
         r_rows * chunk_b
     ).reshape(r_rows, chunk_b)[q_valid]
 
-    pts_dev = jnp.asarray(pts)
-    m_gid_dev = jnp.asarray(m_gid)
-    m_valid_dev = jnp.asarray(m_valid)
-
-    def one_chunk(args):
-        qg, s = args
-        # padded duplicate query slots produce junk rows; they are never
-        # read back (slot_of_pair only maps REAL pairs).
-        mg = m_gid_dev[s]
-        return _search_clusters(
-            pts_dev[qg], qg, pts_dev[mg], mg, m_valid_dev[s], k
-        )
-
-    d2_all, gid_all = lax.map(
-        one_chunk,
-        (jnp.asarray(q_gid), jnp.asarray(row_sub.astype(np.int32))),
+    exec_fn = search_exec if search_exec is not None else _search_chunks
+    d2_all, gid_all = exec_fn(
+        pts, m_gid, m_valid, q_gid, row_sub.astype(np.int32), k
     )
-    # [R, B, k] -> per-pair rows -> tiled [T, p_max * k] merges (one
+    if d2_all.shape[0] < r_rows or d2_all.shape != (
+        d2_all.shape[0], chunk_b, k
+    ) or gid_all.shape != d2_all.shape:
+        # a short/misshapen executor result would otherwise clamp real
+        # pair indices onto the junk row in the merge gather — degraded
+        # results with no error. Fail loudly instead.
+        raise ValueError(
+            f"search_exec returned shapes {tuple(d2_all.shape)}/"
+            f"{tuple(gid_all.shape)}; expected [R'>= {r_rows}, "
+            f"{chunk_b}, {k}] with extra rows appended at the end"
+        )
+    # [R', B, k] -> per-pair rows -> tiled [T, p_max * k] merges (one
     # monolithic [N, p_max * k] gather + top_k would hold ~4 GB of merge
     # operands at 262K x 16 x 128). Queries with fewer than p_max pairs
-    # pad with the appended all-inf junk row: never selected.
+    # pad with the appended all-inf junk row: never selected. The slice
+    # to r_rows * chunk_b drops any executor-padded chunk rows (a mesh
+    # executor pads R to a device-count multiple) AND pins the junk-row
+    # sentinel id below at the same flat index either way.
     d2_flat = jnp.concatenate(
-        [d2_all.reshape(r_rows * chunk_b, k),
+        [d2_all.reshape(-1, k)[: r_rows * chunk_b],
          jnp.full((1, k), jnp.inf, d2_all.dtype)]
     )
     gid_flat = jnp.concatenate(
-        [gid_all.reshape(r_rows * chunk_b, k),
+        [gid_all.reshape(-1, k)[: r_rows * chunk_b],
          jnp.full((1, k), -1, jnp.int32)]
     )
     junk = r_rows * chunk_b

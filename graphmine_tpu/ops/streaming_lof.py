@@ -78,6 +78,21 @@ def fit_lof(refs: jax.Array, mask: jax.Array | None = None, k: int = 20,
     )
 
 
+@partial(jax.jit, static_argnames=("k",))
+def _model_state_from_knn(d2: jax.Array, idx: jax.Array, k: int):
+    """k-distance + lrd from a self-excluding kNN result (``[M, k]``) —
+    the :func:`fit_lof` formula factored out so the IVF re-fit path
+    (``StreamingLOF(impl="ivf")``) shares the duplicate-floor eps and
+    reach semantics with the exact fit bit-for-bit."""
+    dists = jnp.sqrt(jnp.maximum(d2, 0.0))
+    pos = (dists > 0) & jnp.isfinite(dists)
+    eps = 1e-3 * jnp.where(pos, dists, 0.0).sum() / jnp.maximum(pos.sum(), 1)
+    kdist = dists[:, -1]
+    reach = jnp.maximum(jnp.maximum(kdist[idx], dists), eps)
+    lrd = k / jnp.maximum(reach.sum(axis=1), 1e-12)
+    return kdist, lrd
+
+
 @partial(jax.jit, static_argnames=("row_tile",))
 def score_lof(model: LOFModel, queries: jax.Array, row_tile: int = 1024) -> jax.Array:
     """LOF score per query against the fitted window (higher = outlier)."""
@@ -103,19 +118,46 @@ class StreamingLOF:
     fixed-capacity ring buffer (evicting the oldest points) and the model is
     re-fit. All device steps have static shapes once the feature dim and
     chunk size are seen, so the stream runs from a handful of compilations.
+
+    ``impl="ivf"`` (r6): the window re-fit — the dominant cost term, a
+    ``[capacity, capacity]`` self-kNN every admitted chunk — routes
+    through the IVF-flat index (:func:`graphmine_tpu.ops.ann.ivf_knn`)
+    with **one reused set of k-means centers**: the window slides by one
+    chunk per re-fit, so its cluster structure is stable between fits,
+    and re-fits skip the Lloyd iterations entirely (points are only
+    re-assigned against the trained centers — one small matmul).
+    Chunk-vs-window *scoring* stays exact cross-kNN (it is
+    ``[chunk, capacity]``, far off the all-pairs wall). Centers train on
+    the first FULL window (earlier re-fits stay exact — centers fit to
+    a small early sample would index every later window badly);
+    ``ivf_retrain_every=N`` re-trains every N IVF re-fits to track
+    drift (0 = train once, the default — the ring buffer's content
+    drifts one chunk at a time, and the bench stream tier records the
+    reuse win/regression each capture).
     """
 
     def __init__(self, k: int = 20, capacity: int = 4096,
-                 admit_threshold: float | None = None):
+                 admit_threshold: float | None = None, impl: str = "exact",
+                 ivf_retrain_every: int = 0, sink=None):
         """``admit_threshold``: if set, points scoring above it are flagged
         but NOT admitted to the window. Without it, persistent outlier
         clusters eventually enter the window and start looking normal —
         sometimes wanted (regime change), sometimes not (contamination)."""
         if capacity <= k + 1:
             raise ValueError(f"capacity {capacity} must exceed k+1 = {k + 1}")
+        if impl not in ("exact", "ivf"):
+            raise ValueError(f"unknown impl {impl!r}; use 'exact' or 'ivf'")
+        if ivf_retrain_every < 0:
+            raise ValueError("ivf_retrain_every must be >= 0 (0 = once)")
         self.k = k
         self.capacity = capacity
         self.admit_threshold = admit_threshold
+        self.impl = impl
+        self.ivf_retrain_every = ivf_retrain_every
+        self.ivf_retrains = 0  # kmeans trainings performed (reuse metric)
+        self._sink = sink
+        self._ivf_fits = 0     # re-fits that actually rode the index
+        self._centers = None   # trained [C, F] centers (impl="ivf")
         self._refs: np.ndarray | None = None  # [capacity, F]
         self._valid = 0        # number of valid slots (grows to capacity)
         self._write = 0        # ring-buffer write head
@@ -176,8 +218,56 @@ class StreamingLOF:
         return scores
 
     def _fit(self) -> None:
-        self._model = fit_lof(
-            jnp.asarray(self._refs), jnp.asarray(self._mask()), k=self.k
+        if self.impl == "ivf":
+            self._fit_ivf()
+        else:
+            self._model = fit_lof(
+                jnp.asarray(self._refs), jnp.asarray(self._mask()), k=self.k
+            )
+
+    def _fit_ivf(self) -> None:
+        """Window re-fit through the IVF index with reused centers.
+
+        The index is sized for the FULL window (``~sqrt(capacity)``
+        clusters) and its centers train on the first FULL window — not
+        merely the first one past the index's minimum viable size:
+        centers fit to a small early sample (one regime of the stream)
+        would index every later full-capacity window badly, degraded
+        recall with no announcement. Until the fill, re-fits take the
+        exact path — the stream warms up exact, then switches to the
+        index once, permanently. The self-kNN result feeds the same
+        k-distance/lrd model state as :func:`fit_lof` (ivf_knn excludes
+        self by id, exactly like the batch scorer's kNN contract).
+        """
+        from graphmine_tpu.ops.ann import default_n_clusters, ivf_knn, kmeans
+
+        n_clusters = default_n_clusters(self.capacity)
+        valid = self._valid
+        pts = self._refs[:valid]
+        if valid < self.capacity:
+            self._model = fit_lof(
+                jnp.asarray(self._refs), jnp.asarray(self._mask()), k=self.k
+            )
+            return
+        retrain = self._centers is None or (
+            self.ivf_retrain_every
+            and self._ivf_fits % self.ivf_retrain_every == 0
+        )
+        if retrain:
+            self._centers = kmeans(pts, n_clusters, seed=0)
+            self.ivf_retrains += 1
+        self._ivf_fits += 1
+        d2, idx = ivf_knn(
+            pts, k=self.k, centers=self._centers, sink=self._sink
+        )
+        kdist, lrd = _model_state_from_knn(d2, idx, self.k)
+        pad = self.capacity - valid
+        self._model = LOFModel(
+            refs=jnp.asarray(self._refs),
+            mask=jnp.asarray(self._mask()),
+            kdist=jnp.pad(kdist, (0, pad)),
+            lrd=jnp.pad(lrd, (0, pad)),
+            k=self.k,
         )
 
     def _mask(self) -> np.ndarray:

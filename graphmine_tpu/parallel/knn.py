@@ -120,12 +120,107 @@ def sharded_knn(points, mesh, k: int, row_tile: int = 1024):
     return d2[:n], gid[:n]
 
 
-def sharded_lof(points, mesh, k: int = 128, row_tile: int = 1024):
-    """Distributed LOF scores: ring-sharded kNN + the shared LOF formula.
+def _ivf_search_body(q_gid, row_sub, pts, m_gid, m_valid, *, k: int):
+    """Per-device slice of the IVF cluster-batched search (runs under
+    shard_map): this device's chunk rows, one ``lax.map`` of the shared
+    :func:`ops.ann._search_clusters` block over them. Points and the
+    member tables are replicated — they are O(N x F) / O(n_sub x Lmax)
+    small next to the O(candidate-pairs) distance work being split."""
+    from graphmine_tpu.ops.ann import _search_clusters
+
+    def one_chunk(args):
+        qg, s = args
+        mg = m_gid[s]
+        return _search_clusters(pts[qg], qg, pts[mg], mg, m_valid[s], k)
+
+    return lax.map(one_chunk, (q_gid, row_sub))
+
+
+def mesh_ivf_search_exec(mesh):
+    """A ``search_exec`` for :func:`graphmine_tpu.ops.ann.ivf_knn` that
+    splits the cluster-batched search — the dominant distance work — over
+    ``mesh``. Chunk rows are padded to a device-count multiple (appended
+    at the end: ``ivf_knn`` slices real rows back off) and row-sharded;
+    each device searches its share. One compiled program per (mesh, table
+    shapes, k) — the same compile-per-dataset trade the single-device IVF
+    path already makes."""
+
+    def exec_fn(pts, m_gid, m_valid, q_gid, row_sub, k):
+        d = mesh.size
+        r, b = q_gid.shape
+        r_pad = -(-r // d) * d
+        qg = np.zeros((r_pad, b), np.int32)
+        qg[:r] = q_gid
+        # padded rows point at sublist 0 with query id 0: searched like
+        # any chunk, sliced off by the caller, never read back
+        rs = np.zeros((r_pad,), np.int32)
+        rs[:r] = row_sub
+        body = cached_jit_shard_map(
+            ("ivf_search", mesh, pts.shape, m_gid.shape, r_pad, b, k),
+            lambda: shard_map(
+                partial(_ivf_search_body, k=k),
+                mesh=mesh,
+                in_specs=(
+                    P(VERTEX_AXIS, None), P(VERTEX_AXIS),
+                    P(None, None), P(None, None), P(None, None),
+                ),
+                out_specs=(
+                    P(VERTEX_AXIS, None, None), P(VERTEX_AXIS, None, None)
+                ),
+            ),
+        )
+        return body(
+            jnp.asarray(qg), jnp.asarray(rs), jnp.asarray(pts),
+            jnp.asarray(m_gid), jnp.asarray(m_valid),
+        )
+
+    return exec_fn
+
+
+def sharded_lof(points, mesh, k: int = 128, row_tile: int = 1024,
+                impl: str = "auto", sink=None):
+    """Distributed LOF scores over the device mesh.
+
+    ``impl`` (r6, same policy surface as :func:`ops.lof.lof_scores`):
+
+    - ``"exact"`` — ring-sharded all-pairs kNN (the r2 path): points stay
+      row-sharded, chunks rotate via ``ppermute``.
+    - ``"ivf"`` — the IVF-flat candidate reduction with its search stage
+      sharded over the mesh (:func:`mesh_ivf_search_exec`), so the mesh
+      path does LESS work per output slot instead of ring all-pairs. The
+      index build (k-means, inverted lists) and final merge stay
+      host/default-device — they are a small fraction of the exact
+      path's distance work. A pathology-guard fallback inside ``ivf_knn``
+      lands on the single-device exact path, LOUDLY (warning +
+      ``ivf_fallback`` record through ``sink``).
+    - ``"auto"`` — :func:`ops.lof.select_lof_impl`'s measured crossover
+      decides (IVF from ~131K points); the choice is emitted as an
+      ``impl_selected`` record when ``sink`` is given.
 
     The post-kNN gathers (``kdist[idx]``, ``lrd[idx]``) touch only ``[N]``
-    vectors, so GSPMD's inserted collectives are small; the O(N^2) work
-    stays ring-scheduled. Returns float32 ``[N]`` (sharded).
+    vectors, so GSPMD's inserted collectives are small. Returns float32
+    ``[N]``.
     """
+    from graphmine_tpu.ops.lof import select_lof_impl
+
+    if impl not in ("auto", "ivf", "exact"):
+        raise ValueError(
+            f"unknown sharded LOF impl {impl!r}; use 'auto', 'ivf' or "
+            "'exact'"
+        )
+    n = int(np.asarray(points).shape[0])
+    family, reason = select_lof_impl(n, k, impl=impl)
+    if sink is not None:
+        sink.emit(
+            "impl_selected", op="lof_knn", impl=family, requested=impl,
+            n=n, k=k, devices=int(mesh.size), reason=reason,
+        )
+    if family == "ivf":
+        from graphmine_tpu.ops.ann import ivf_knn
+
+        d2, gid = ivf_knn(
+            points, k=k, sink=sink, search_exec=mesh_ivf_search_exec(mesh)
+        )
+        return _lof_from_knn(d2, gid, k)
     d2, gid = sharded_knn(points, mesh, k, row_tile)
     return _lof_from_knn(d2, gid, k)

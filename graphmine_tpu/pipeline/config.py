@@ -50,12 +50,15 @@ class PipelineConfig:
     # hubs (docs/DESIGN.md, bench.py --tier lof). 128 is the measured
     # best; the driver clamps it to num_vertices - 1 on small graphs.
     lof_k: int = 128
-    # LOF kNN implementation (r5): "auto" = the measured exact-path
-    # policy (XLA dot+top_k; Pallas at k <= 8); "ivf" = the approximate
-    # IVF-flat index — the exact scorer is AT the top_k roofline, so
-    # large feature clouds trade a measured sliver of recall (0.9999 at
-    # 262K points; AUROC 0.9895 vs 0.9905 on the harness) for ~3x wall
-    # (docs/DESIGN.md "Exact kNN is at the sort roofline").
+    # LOF kNN implementation. "auto" (r6) is SCALE-AWARE: the planner
+    # deploys the approximate IVF-flat index at the measured crossover
+    # (>= 131K points — 3.1x over exact at 262K for recall 0.9999 /
+    # AUROC -0.001; docs/DESIGN.md "LOF impl auto-policy"), the exact
+    # path below it (whose own XLA/Pallas choice is ops/knn.py's
+    # measured policy). The resolved family is emitted as an
+    # impl_selected metrics record, and the degradation ladder runs the
+    # opposite family as its rung. Explicit values force a path;
+    # GRAPHMINE_LOF_IVF_MIN_N moves the crossover.
     lof_impl: str = "auto"  # auto | xla | pallas | ivf
     # observability (docs/OBSERVABILITY.md)
     show: int = 10  # .show(10) parity

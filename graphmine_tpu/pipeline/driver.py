@@ -340,15 +340,25 @@ def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
         from graphmine_tpu.ops.lof import lof_scores
 
         from graphmine_tpu.parallel.knn import can_shard
+        from graphmine_tpu.pipeline.planner import plan_lof
 
         k = min(config.lof_k, graph.num_vertices - 1)
         use_sharded_lof = n_dev > 1 and can_shard(graph.num_vertices, n_dev, k)
-        if use_sharded_lof and config.lof_impl != "auto":
+        # Plan-time impl resolution (r6): the measured IVF crossover
+        # (ops/lof.py provenance table) decides here, BEFORE any scorer
+        # runs, so the degradation ladder below is built in the right
+        # direction — exact primary gets the leaner IVF index as its OOM
+        # rung; IVF primary gets the roofline-bounded exact tiles as its
+        # rescue rung. The scorers re-apply the same policy function and
+        # emit the impl_selected record through the sink.
+        lof_plan = plan_lof(graph.num_vertices, k, requested=config.lof_impl)
+        if use_sharded_lof and config.lof_impl in ("xla", "pallas"):
             m.emit(
                 "warning",
-                message=f"lof_impl={config.lof_impl!r} applies to the "
-                "single-device scorer only; the multi-device path runs "
-                "the exact ring-sharded kNN/LOF",
+                message=f"lof_impl={config.lof_impl!r} forces an exact "
+                "single-device kernel; the multi-device path runs the "
+                "exact ring-sharded kNN/LOF instead (auto/ivf DO apply "
+                "to the sharded scorer)",
             )
         if scale_out and not use_sharded_lof:
             m.emit(
@@ -413,32 +423,54 @@ def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
                     simple_edges=simple_edges,
                 ))
             if use_sharded_lof:
-                # Multi-device: ring-sharded kNN + distributed LOF — the
-                # O(V^2) distance work is scheduled over the mesh with no
-                # replicated [V, F] (parallel/knn.py).
+                # Multi-device (parallel/knn.py): the planner-resolved
+                # family — IVF candidate reduction with the search stage
+                # sharded over the mesh at crossover scale (r6), else the
+                # exact ring-sharded kNN — plus the opposite family as
+                # the degradation rung.
                 from graphmine_tpu.parallel.knn import sharded_lof
                 from graphmine_tpu.parallel.mesh import make_mesh
 
+                impl_sharded = (
+                    "ivf" if lof_plan.impl == "ivf" else "exact"
+                )
+
                 def _score():
                     resilience.fault_point("outliers_lof")
-                    return sharded_lof(feats, make_mesh(n_dev), k=k)
+                    return sharded_lof(
+                        feats, make_mesh(n_dev), k=k, impl=impl_sharded,
+                        sink=m,
+                    )
 
-                ladder = ()
+                ladder = ((
+                    f"lof_sharded_{lof_plan.degrade_to}",
+                    lambda: sharded_lof(
+                        feats, make_mesh(n_dev), k=k,
+                        impl=lof_plan.degrade_to, sink=m,
+                    ),
+                ),)
             else:
-                # config.lof_impl="ivf" opts large clouds into the
-                # approximate IVF index (r5; measured ~3x at 262K points
-                # for ~0.001 AUROC — see config.py)
+                # Planner-selected family (r6): impl="auto" deploys the
+                # IVF index at the measured crossover scale (~3.1x at
+                # 262K points for ~0.001 AUROC — ops/lof.py provenance);
+                # config.lof_impl passes through so explicit choices
+                # stay honored, and lof_scores re-applies the same
+                # policy + emits the impl_selected record.
                 def _score():
                     resilience.fault_point("outliers_lof")
                     return lof_scores(feats, k=k, impl=config.lof_impl, sink=m)
 
-                # OOM ladder: the exact all-pairs scorer's [V, V] distance
-                # tiles are the memory hog; the IVF index probes a bounded
-                # candidate set (bounded recall loss, see config.py)
-                ladder = (
-                    ("lof_ivf",
-                     lambda: lof_scores(feats, k=k, impl="ivf", sink=m)),
-                ) if config.lof_impl != "ivf" else ()
+                # Degradation rung, direction from the plan: the exact
+                # scorer's [V, V] distance tiles OOM -> the IVF index's
+                # bounded candidate set; the IVF scorer's data-dependent
+                # pair tables blow up -> the roofline-bounded exact path.
+                rung_impl = (
+                    "xla" if lof_plan.degrade_to == "exact" else "ivf"
+                )
+                ladder = ((
+                    f"lof_{lof_plan.degrade_to}",
+                    lambda: lof_scores(feats, k=k, impl=rung_impl, sink=m),
+                ),)
             scores = resilience.run_phase(
                 "outliers_lof", _score, config.resilience, m, ladder=ladder
             )

@@ -190,6 +190,50 @@ def elastic_device_ladder(schedule: str, num_devices: int) -> list[int]:
     return rungs
 
 
+@dataclass(frozen=True)
+class LofPlan:
+    """Resolved LOF-scorer plan for one feature cloud (r6).
+
+    ``impl`` is the selected kNN family (``"ivf"`` / ``"exact"``);
+    ``degrade_to`` is the family the degradation ladder steps to on a
+    resource failure — the two are always opposite, so IVF→exact is a
+    rung exactly as exact→IVF long has been: an exact scorer that OOMs
+    its [V, V] distance tiles steps DOWN to the bounded-candidate index,
+    and an IVF scorer whose data-dependent pair tables blow up steps
+    ACROSS to the roofline-bounded exact tiles."""
+
+    impl: str          # "ivf" | "exact"
+    degrade_to: str    # the ladder rung's family ("exact" | "ivf")
+    reason: str        # one-line selection rationale (measured provenance)
+
+
+def plan_lof(
+    num_points: int, k: int, requested: str = "auto",
+    ivf_min_points: int | None = None,
+) -> LofPlan:
+    """Resolve the LOF kNN implementation for the ``outliers_lof`` phase.
+
+    Thin planner wrapper over :func:`graphmine_tpu.ops.lof.select_lof_impl`
+    (the single policy owner, with the measured-crossover provenance
+    table) so the driver's dispatch AND its degradation-ladder direction
+    come from one plan-time decision — the e2e pipeline deploys IVF at
+    scale because the planner said so, not because an operator passed an
+    opt-in string. NOTE: unlike the rest of this module this imports the
+    ops layer (hence jax) lazily — callers planning a LOF phase are about
+    to run one anyway.
+    """
+    from graphmine_tpu.ops.lof import select_lof_impl
+
+    family, reason = select_lof_impl(
+        num_points, k, impl=requested, ivf_min_points=ivf_min_points
+    )
+    return LofPlan(
+        impl=family,
+        degrade_to="exact" if family == "ivf" else "ivf",
+        reason=reason,
+    )
+
+
 def plan_run(
     num_vertices: int,
     num_edges: int,

@@ -71,6 +71,14 @@ def pytest_configure(config):
         "the default CPU pass — select with -m obs or "
         "tools/run_tier1.sh --obs-only",
     )
+    config.addinivalue_line(
+        "markers",
+        "ann: approximate-kNN suite (tests/test_ann.py + "
+        "tests/test_lof_policy.py: IVF contract/recall, the LOF "
+        "auto-policy crossover, recall/AUROC regression gates); runs in "
+        "the default CPU pass — select with -m ann or "
+        "tools/run_tier1.sh --ann-only",
+    )
     if not (_needs_reexec() and _invoked_as_pytest_cli()):
         return
     cap = config.pluginmanager.getplugin("capturemanager")

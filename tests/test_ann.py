@@ -13,6 +13,8 @@ import pytest
 from graphmine_tpu.ops.ann import ivf_knn, kmeans
 from graphmine_tpu.ops.knn import knn
 
+pytestmark = pytest.mark.ann  # the --ann-only tier-1 lane
+
 
 @pytest.fixture(scope="module")
 def clouds():

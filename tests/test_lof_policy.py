@@ -1,0 +1,148 @@
+"""LOF auto-policy (r6): the measured IVF crossover as deployed code.
+
+VERDICT r5 weak-item 3: a measured 3.1x sat undeployed because
+``lof_scores(impl="auto")`` was scale-blind. These tests pin the policy —
+small-N auto stays exact, large-N auto deploys the IVF index, a
+pathology-guard fallback stays loud AND exact — and gate the index's
+quality against the exact oracle (recall >= 0.999, |AUROC delta| <=
+0.005 on a fixed-seed cloud: the acceptance numbers, with the measured
+values 0.9999 / 0.001 well inside them).
+"""
+
+import numpy as np
+import pytest
+
+from graphmine_tpu.ops.lof import (
+    LOF_IVF_MIN_POINTS,
+    auroc,
+    lof_scores,
+    select_lof_impl,
+)
+from graphmine_tpu.pipeline.metrics import MetricsSink
+
+pytestmark = pytest.mark.ann  # the --ann-only tier-1 lane
+
+
+@pytest.fixture(scope="module")
+def blob_cloud():
+    """Fixed-seed clustered cloud with planted shell outliers — IVF's
+    design case (inverted lists exploit cluster structure), sized well
+    under the real crossover so tests force the dispatch explicitly."""
+    rng = np.random.default_rng(42)
+    n, f = 20000, 8
+    centers = rng.normal(size=(16, f)).astype(np.float32) * 4
+    assign = rng.integers(0, 16, n)
+    pts = centers[assign] + rng.normal(size=(n, f)).astype(np.float32)
+    is_out = rng.random(n) < 0.01
+    n_out = int(is_out.sum())
+    d = rng.normal(size=(n_out, f)).astype(np.float32)
+    d /= np.linalg.norm(d, axis=1, keepdims=True)
+    pts[is_out] = centers[assign[is_out]] + d * rng.uniform(
+        4.0, 6.0, (n_out, 1)
+    ).astype(np.float32)
+    return pts, is_out
+
+
+def test_select_lof_impl_crossover():
+    # the deployed default crossover is the provenance table's value
+    assert LOF_IVF_MIN_POINTS == 1 << 17
+    fam, reason = select_lof_impl(LOF_IVF_MIN_POINTS - 1, 128)
+    assert fam == "exact" and "crossover" in reason
+    fam, reason = select_lof_impl(LOF_IVF_MIN_POINTS, 128)
+    assert fam == "ivf" and "3.1x" in reason
+    # explicit requests bypass the policy
+    assert select_lof_impl(10**9, 128, impl="xla")[0] == "exact"
+    assert select_lof_impl(100, 16, impl="ivf")[0] == "ivf"
+    # overrides: argument beats the default; env beats the default
+    assert select_lof_impl(1000, 16, ivf_min_points=500)[0] == "ivf"
+    # unknown impls are rejected, not silently coerced to a family
+    with pytest.raises(ValueError, match="unknown LOF impl"):
+        select_lof_impl(1000, 16, impl="IVF")
+
+
+def test_select_lof_impl_env_override(monkeypatch):
+    monkeypatch.setenv("GRAPHMINE_LOF_IVF_MIN_N", "300")
+    assert select_lof_impl(1000, 16)[0] == "ivf"
+    monkeypatch.setenv("GRAPHMINE_LOF_IVF_MIN_N", "5000")
+    assert select_lof_impl(1000, 16)[0] == "exact"
+
+
+def test_auto_small_n_runs_exact_and_records(blob_cloud):
+    pts, _ = blob_cloud
+    m = MetricsSink()
+    auto = np.asarray(lof_scores(pts[:4000], k=32, sink=m))
+    rec = m.of_phase("impl_selected")
+    assert len(rec) == 1 and rec[0]["impl"] == "exact"
+    assert rec[0]["op"] == "lof_knn" and rec[0]["n"] == 4000
+    assert rec[0]["requested"] == "auto"
+    exact = np.asarray(lof_scores(pts[:4000], k=32, impl="xla"))
+    np.testing.assert_allclose(auto, exact, rtol=1e-5, atol=1e-6)
+
+
+def test_auto_large_n_deploys_ivf_and_records(blob_cloud):
+    """The crossover dispatch itself, with the threshold lowered so the
+    'large-N' branch runs at test scale (the same policy function with
+    the same inputs; only the constant moves)."""
+    pts, _ = blob_cloud
+    m = MetricsSink()
+    auto = np.asarray(
+        lof_scores(pts, k=32, sink=m, ivf_min_points=10000)
+    )
+    rec = m.of_phase("impl_selected")
+    assert len(rec) == 1 and rec[0]["impl"] == "ivf"
+    assert not m.of_phase("ivf_fallback")  # really rode the index
+    ivf = np.asarray(lof_scores(pts, k=32, impl="ivf"))
+    np.testing.assert_array_equal(auto, ivf)  # same deterministic index
+    # and the approximate scores track the exact oracle
+    exact = np.asarray(lof_scores(pts, k=32, impl="xla"))
+    frac_close = np.mean(np.abs(auto - exact) < 0.05 * np.abs(exact) + 0.01)
+    assert frac_close > 0.95, frac_close
+
+
+def test_forced_fallback_is_exact_and_loud():
+    """Auto selects IVF (lowered threshold) on a cloud whose clusters
+    cannot fill the requested top-k: the pathology guard must route to
+    the exact result AND leave an ivf_fallback record + warning (ADVICE
+    r5) — with the impl_selected record still saying what the policy
+    chose, so the triage trail shows both the decision and the bailout."""
+    rng = np.random.default_rng(4)
+    n, f, k = 64, 4, 40  # k above any cluster's size: "k_unfillable"
+    pts = rng.normal(size=(n, f)).astype(np.float32)
+    m = MetricsSink()
+    with pytest.warns(UserWarning, match="ivf_knn guard"):
+        scores = np.asarray(
+            lof_scores(pts, k=k, sink=m, ivf_min_points=50)
+        )
+    sel = m.of_phase("impl_selected")
+    assert sel and sel[0]["impl"] == "ivf"
+    fb = m.of_phase("ivf_fallback")
+    assert fb and fb[0]["guard"]
+    exact = np.asarray(lof_scores(pts, k=k, impl="xla"))
+    np.testing.assert_allclose(scores, exact, rtol=1e-5, atol=1e-5)
+
+
+def test_ivf_recall_and_auroc_regression_gates(blob_cloud):
+    """The acceptance gates as a pinned regression test: on the
+    fixed-seed clustered cloud the index must hold recall >= 0.999
+    against the exact kNN oracle and |AUROC delta| <= 0.005 on the
+    planted outliers (measured: 0.9999 recall / 0.001 delta at 262K on
+    silicon; this cloud measures ~1.0 / ~0.000 at CI scale)."""
+    from graphmine_tpu.ops.ann import ivf_knn
+    from graphmine_tpu.ops.knn import knn
+
+    pts, is_out = blob_cloud
+    k = 32
+    exact_d2, exact_i = knn(pts, k=k, impl="xla")
+    ivf_d2, ivf_i = ivf_knn(pts, k=k)
+    exact_i, ivf_i = np.asarray(exact_i), np.asarray(ivf_i)
+    recall = np.mean([
+        len(set(exact_i[i]) & set(ivf_i[i])) / k for i in range(len(pts))
+    ])
+    assert recall >= 0.999, recall
+
+    from graphmine_tpu.ops.lof import lof_from_knn
+
+    a_exact = auroc(np.asarray(lof_from_knn(exact_d2, exact_i, k)), is_out)
+    a_ivf = auroc(np.asarray(lof_from_knn(ivf_d2, ivf_i, k)), is_out)
+    assert abs(a_exact - a_ivf) <= 0.005, (a_exact, a_ivf)
+    assert a_ivf > 0.95  # the harness detects, not just agrees

@@ -191,6 +191,67 @@ def test_kitchen_sink_weighted_ring_checkpoint(tmp_path):
     assert resume_events and resume_events[0]["iteration"] == 2
 
 
+def _write_random_edgelist(tmp_path, v=800, e=6000, seed=0):
+    rng = np.random.default_rng(seed)
+    src, dst = rng.integers(0, v, e), rng.integers(0, v, e)
+    p = tmp_path / "edges.txt"
+    p.write_text("".join(f"n{s} n{d}\n" for s, d in zip(src, dst)))
+    return str(p)
+
+
+def test_lof_auto_policy_deploys_through_driver(tmp_path, monkeypatch):
+    """r6 acceptance: the e2e pipeline deploys IVF planner/driver-selected,
+    not via an opt-in string — lof_impl stays 'auto', only the measured
+    crossover (lowered via its env override to run at test scale) decides.
+    Both directions pinned, with the impl_selected record through the
+    metrics sink and the degradation ladder built the matching way."""
+    p = _write_random_edgelist(tmp_path)
+
+    def cfg():
+        return PipelineConfig(
+            data_path=p, data_format="edgelist", outlier_method="lof",
+            num_devices=1, lof_k=32,
+        )
+
+    res = run_pipeline(cfg())
+    sel = [r for r in res.metrics.records if r["phase"] == "impl_selected"]
+    assert sel and sel[0]["impl"] == "exact" and sel[0]["requested"] == "auto"
+    assert res.lof is not None and res.lof.shape == (800,)
+
+    monkeypatch.setenv("GRAPHMINE_LOF_IVF_MIN_N", "500")
+    res2 = run_pipeline(cfg())
+    sel2 = [r for r in res2.metrics.records if r["phase"] == "impl_selected"]
+    assert sel2 and sel2[0]["impl"] == "ivf"
+    assert res2.lof is not None
+    # approximate scores track the exact run
+    close = np.abs(res2.lof - res.lof) < 0.05 * np.abs(res.lof) + 0.01
+    assert close.mean() > 0.95
+
+
+def test_lof_ivf_degrades_to_exact_rung(tmp_path, monkeypatch):
+    """The IVF→exact degradation rung (r6): when the planner-selected IVF
+    scorer dies with a resource-exhaustion error, the ladder steps to the
+    exact path and the phase still completes, with the degrade record
+    naming the lof_exact rung."""
+    from graphmine_tpu.testing.faults import FaultInjector, oom_error
+
+    p = _write_random_edgelist(tmp_path, seed=1)
+    monkeypatch.setenv("GRAPHMINE_LOF_IVF_MIN_N", "500")
+    inj = FaultInjector().add("outliers_lof", oom_error, at=1)
+    with inj.installed():
+        res = run_pipeline(PipelineConfig(
+            data_path=p, data_format="edgelist", outlier_method="lof",
+            num_devices=1, lof_k=32,
+        ))
+    assert inj.fired("outliers_lof") == 1
+    assert res.lof is not None
+    deg = [r for r in res.metrics.records if r["phase"] == "degrade"]
+    assert deg and deg[0]["to"] == "lof_exact"
+    # the rung's scorer records the exact path it actually ran
+    sel = [r for r in res.metrics.records if r["phase"] == "impl_selected"]
+    assert sel and sel[-1]["impl"] == "exact" and sel[-1]["requested"] == "xla"
+
+
 def test_config_validation():
     with pytest.raises(ValueError):
         PipelineConfig(backend="spark").validate()

@@ -19,6 +19,24 @@ from graphmine_tpu.pipeline.planner import (
 GIB = 1 << 30
 
 
+def test_plan_lof_applies_measured_crossover():
+    """r6: the planner's LOF plan is the ops-layer policy (one owner)
+    with the ladder direction derived from it — IVF primary degrades to
+    exact, exact primary degrades to IVF."""
+    from graphmine_tpu.ops.lof import LOF_IVF_MIN_POINTS
+    from graphmine_tpu.pipeline.planner import plan_lof
+
+    small = plan_lof(10_000, 128)
+    assert small.impl == "exact" and small.degrade_to == "ivf"
+    big = plan_lof(LOF_IVF_MIN_POINTS, 128)
+    assert big.impl == "ivf" and big.degrade_to == "exact"
+    assert "3.1x" in big.reason  # measured provenance rides the plan
+    forced = plan_lof(10**8, 128, requested="xla")
+    assert forced.impl == "exact"
+    assert plan_lof(100, 16, requested="ivf").impl == "ivf"
+    assert plan_lof(10_000, 128, ivf_min_points=1000).impl == "ivf"
+
+
 def test_single_device_selects_fused_kernel():
     p = plan_run(1 << 20, 1 << 23, num_devices=1)
     assert p.schedule == "single" and not p.lpa_only

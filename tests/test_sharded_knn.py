@@ -81,6 +81,58 @@ def test_sharded_lof_matches_single_device(mesh8):
     assert got[0] == got.max() and got[0] > 2.0
 
 
+def test_sharded_ivf_lof_matches_fused(mesh8):
+    """r6: sharded_lof(impl="ivf") distributes the IVF search stage over
+    the mesh; the chunk partition must not change a single candidate, so
+    scores are BIT-identical to the fused single-device IVF scorer (the
+    same index, the same merges — only the lax.map rows moved devices)."""
+    from graphmine_tpu.parallel.knn import sharded_lof
+
+    r = np.random.default_rng(5)
+    c = r.normal(size=(8, 8)).astype(np.float32) * 3
+    pts = (
+        c[r.integers(0, 8, 6000)]
+        + r.normal(size=(6000, 8)).astype(np.float32)
+    )
+    fused = np.asarray(lof_scores(pts, k=16, impl="ivf"))
+    got = np.asarray(sharded_lof(pts, mesh8, k=16, impl="ivf"))
+    np.testing.assert_array_equal(got, fused)
+
+
+def test_sharded_lof_auto_policy_and_record(mesh8, monkeypatch):
+    """impl="auto" on the sharded scorer applies the same measured
+    crossover as lof_scores and emits the impl_selected record; unknown
+    impl strings are rejected, not silently coerced to exact."""
+    from graphmine_tpu.parallel.knn import sharded_lof
+    from graphmine_tpu.pipeline.metrics import MetricsSink
+
+    r = np.random.default_rng(6)
+    pts = r.normal(size=(600, 8)).astype(np.float32)
+    m = MetricsSink()
+    got = np.asarray(sharded_lof(pts, mesh8, k=16, sink=m))
+    rec = m.of_phase("impl_selected")
+    assert rec and rec[0]["impl"] == "exact" and rec[0]["devices"] == 8
+    want = np.asarray(lof_scores(pts, k=16, impl="xla"))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    with pytest.raises(ValueError, match="unknown sharded LOF impl"):
+        sharded_lof(pts, mesh8, k=16, impl="IVF")
+
+    m2 = MetricsSink()
+    monkeypatch.setenv("GRAPHMINE_LOF_IVF_MIN_N", "100")
+    c = r.normal(size=(8, 8)).astype(np.float32) * 3
+    blob = (
+        c[r.integers(0, 8, 4000)]
+        + r.normal(size=(4000, 8)).astype(np.float32)
+    )
+    got2 = np.asarray(sharded_lof(blob, mesh8, k=16, sink=m2))
+    rec2 = m2.of_phase("impl_selected")
+    assert rec2 and rec2[0]["impl"] == "ivf"
+    np.testing.assert_array_equal(
+        got2, np.asarray(lof_scores(blob, k=16, impl="ivf"))
+    )
+
+
 def test_sharded_knn_validates_k(mesh8):
     from graphmine_tpu.parallel.knn import sharded_knn
 

@@ -105,6 +105,62 @@ def test_window_eviction_adapts(rng):
     assert high > 5 * low
 
 
+def test_ivf_refit_reuses_one_trained_index():
+    """r6 index reuse: impl="ivf" trains k-means ONCE (the first window
+    big enough for the index), re-fits every later window against the
+    reused centers, and scores must track the exact-impl stream tightly;
+    ivf_retrain_every=N re-trains on the drift cadence."""
+    rng = np.random.default_rng(11)
+    n, f, chunk, cap, k = 1 << 14, 8, 1 << 10, 1 << 11, 16
+    centers = rng.normal(size=(8, f)).astype(np.float32) * 4
+    pts = (
+        centers[rng.integers(0, 8, n)]
+        + rng.normal(size=(n, f)).astype(np.float32)
+    )
+
+    def run(**kw):
+        s = StreamingLOF(k=k, capacity=cap, **kw)
+        out = np.empty(n, np.float32)
+        for lo in range(0, n, chunk):
+            out[lo:lo + chunk] = s.update(pts[lo:lo + chunk])
+        s.sync()
+        return s, out
+
+    s_exact, sc_exact = run()
+    s_ivf, sc_ivf = run(impl="ivf")
+    assert s_ivf.ivf_retrains == 1  # trained once, reused ever after
+    assert s_ivf._ivf_fits >= 10
+    warm = slice(cap, None)
+    frac_close = np.mean(
+        np.abs(sc_ivf[warm] - sc_exact[warm])
+        < 0.05 * np.abs(sc_exact[warm]) + 0.01
+    )
+    assert frac_close > 0.95, frac_close
+
+    s_rt, _ = run(impl="ivf", ivf_retrain_every=4)
+    assert s_rt.ivf_retrains > 1
+
+    with pytest.raises(ValueError, match="impl"):
+        StreamingLOF(k=4, capacity=64, impl="annoy")
+    with pytest.raises(ValueError, match="ivf_retrain_every"):
+        StreamingLOF(k=4, capacity=64, impl="ivf", ivf_retrain_every=-1)
+
+
+def test_ivf_small_windows_warm_up_exact(rng):
+    """Windows that have not FILLED yet take the exact fit — the stream
+    warms up exact (bit-for-bit the same fit as impl='exact') and the
+    index trains only on a full window, never on a small early sample
+    that would index every later window badly."""
+    pts = rng.normal(size=(90, 4)).astype(np.float32)
+    s_e = StreamingLOF(k=8, capacity=512)
+    s_i = StreamingLOF(k=8, capacity=512, impl="ivf")
+    np.testing.assert_array_equal(s_e.update(pts), s_i.update(pts))
+    assert s_i.ivf_retrains == 0  # window not full: no training yet
+    q = rng.normal(size=(4, 4)).astype(np.float32)
+    np.testing.assert_array_equal(s_e.update(q), s_i.update(q))
+    assert s_i.ivf_retrains == 0  # 94/512 valid: still warming up exact
+
+
 def test_first_chunk_too_small():
     s = StreamingLOF(k=10, capacity=128)
     with pytest.raises(ValueError):

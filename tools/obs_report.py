@@ -133,6 +133,16 @@ def _phase_waterfall(records, t0):
             f"  {name:<{width}}  {start:8.2f}s  {secs:8.2f}s  "
             f"{_bar(secs / total)}{flag}"
         )
+    # implementation selections (r6): which kNN family the LOF phase
+    # actually deployed (the auto-policy's measured-crossover decision)
+    # belongs next to the waterfall bar it explains.
+    for r in records:
+        if r.get("phase") == "impl_selected":
+            out.append(
+                f"  [impl_selected] {r.get('op', '?')}: {r.get('impl', '?')}"
+                f" (n={r.get('n', '?')}, k={r.get('k', '?')}) — "
+                f"{r.get('reason', '')}"
+            )
     return out
 
 

@@ -7,12 +7,17 @@
 # (-m faults: tests/test_resilience.py + the tripwire/reshard cases in
 # tests/test_sharded.py) is part of this default pass.
 #
-# Usage: tools/run_tier1.sh [--faults-only|--obs-only] [extra pytest args...]
+# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only] [extra pytest args...]
 #   --faults-only  run just the `faults`-marked recovery suite — the fast
 #                  pre-commit loop when iterating on resilience paths
 #   --obs-only     run just the `obs`-marked tracing/telemetry suite
 #                  (tests/test_obs.py: spans, schema validation, heartbeat,
 #                  superstep telemetry, obs_report e2e)
+#   --ann-only     run just the `ann`-marked approximate-kNN suite
+#                  (tests/test_ann.py + tests/test_lof_policy.py: IVF
+#                  contract/recall, the LOF auto-policy crossover, and the
+#                  recall/AUROC regression gates) — the fast slice when
+#                  iterating on the IVF index or its deployment policy
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -23,6 +28,9 @@ if [ "${1:-}" = "--faults-only" ]; then
 elif [ "${1:-}" = "--obs-only" ]; then
     shift
     MARKER='obs and not slow'
+elif [ "${1:-}" = "--ann-only" ]; then
+    shift
+    MARKER='ann and not slow'
 fi
 
 LOG="${TIER1_LOG:-/tmp/_t1.log}"

@@ -73,6 +73,23 @@ def compute():
         partition_graph(g, mesh=mesh, build_bucket_plan=True), mesh
     )
     sharded_lpa = sharded_label_propagation(sg, mesh, max_iter=5)
+
+    # IVF-LOF, fused AND mesh-sharded (r6): the deployed large-cloud LOF
+    # path (ops/lof.py auto-policy) and its distributed twin. Blob data,
+    # not gaussian: the k-means assignment step runs on device, and on a
+    # near-tie cloud a backend's last-ulp rounding could flip a border
+    # point's cluster — a DIFFERENT candidate set, not a numerics bug.
+    # Well-separated blobs keep assignment margins far above float
+    # jitter, so these rows compare numerics, not tie-breaks.
+    from graphmine_tpu.parallel.knn import sharded_lof
+
+    blob_c = rng.normal(size=(8, 8)).astype(np.float32) * 4
+    blob_pts = (
+        blob_c[rng.integers(0, 8, 2048)]
+        + rng.normal(size=(2048, 8)).astype(np.float32)
+    )
+    ivf_lof_fused = lof_scores(blob_pts, k=8, impl="ivf")
+    ivf_lof_sharded = sharded_lof(blob_pts, mesh, k=8, impl="ivf")
     return {
         "lpa": np.asarray(labels),
         "cc": np.asarray(gm.connected_components(g)),
@@ -96,6 +113,8 @@ def compute():
         "knn_d2": np.asarray(knn_d2),
         "lof": np.asarray(lof_scores(pts, k=8)),
         "sharded_lpa": np.asarray(sharded_lpa),
+        "ivf_lof_fused": np.asarray(ivf_lof_fused),
+        "ivf_lof_sharded": np.asarray(ivf_lof_sharded),
     }
 """
 
