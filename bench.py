@@ -774,6 +774,167 @@ def main_stream() -> None:
     )
 
 
+def main_serve() -> None:
+    """Serving tier (r7, docs/SERVING.md): the steady-state numbers the
+    serve/ subsystem exists for — query resolve throughput (single-vertex
+    loop vs the one-device-gather batched path), delta-apply latency vs a
+    cold full recompute at three delta sizes, and snapshot publish/load
+    wall time. The headline is batched lookups/sec; ``vs_baseline`` is
+    the batched-over-single speedup (the whole point of the vectorized
+    path), and the delta ladder records warm-repair seconds next to the
+    cold-recompute seconds it replaces."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    _setup_jax_cache()
+
+    from graphmine_tpu.graph.container import build_graph
+    from graphmine_tpu.pipeline.checkpoint import graph_fingerprint
+    from graphmine_tpu.serve import (
+        DeltaIngestor,
+        EdgeDelta,
+        QueryEngine,
+        SnapshotStore,
+    )
+    from graphmine_tpu.serve.delta import cold_recompute, splice_edges
+
+    # Community-structured graph (SBM, the quality tier's generator): the
+    # serving workload's shape. A pure power-law draw livelocks
+    # synchronous LPA (period-2), which routes EVERY delta to the
+    # fallback — that path is measured too (repair_method in the ladder
+    # says which one each row took), but the steady-state warm-repair
+    # story needs a graph whose LPA actually fixpoints.
+    from graphmine_tpu.datasets import sbm
+
+    blocks, p_in, p_out = ([400] * 120, 0.04, 0.0002)
+    if _CPU_FALLBACK:
+        blocks, p_in, p_out = ([100] * 20, 0.1, 0.002)
+    rng = np.random.default_rng(7)
+    src, dst, _blocks = sbm(blocks, p_in, p_out, seed=7)
+    v, e = int(np.sum(blocks)), len(src)
+    g = build_graph(src, dst, num_vertices=v)
+    t0 = time.perf_counter()
+    labels, cc, _ = cold_recompute(g)
+    t_cold_base = time.perf_counter() - t0
+
+    tmp = tempfile.mkdtemp(prefix="graphmine_serve_")
+    try:
+        store = SnapshotStore(os.path.join(tmp, "snap"))
+        fp = graph_fingerprint(src, dst)
+        lof = rng.random(v).astype(np.float32)
+        arrays = {
+            "src": src, "dst": dst, "labels": labels, "cc_labels": cc,
+            "lof": lof,
+        }
+        t0 = time.perf_counter()
+        store.publish(arrays, fingerprint=fp)
+        t_publish = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        snap = store.load(fingerprint=fp)
+        t_load = time.perf_counter() - t0
+        engine = QueryEngine(snap)
+
+        # single-vertex loop (the naive client) vs the batched gather
+        ids = rng.integers(0, v, 1 << 12).astype(np.int64)
+        for vtx in ids[:64]:  # warm caches/compiles outside the window
+            engine.membership(int(vtx))
+        engine.query_batch(ids)
+        t0 = time.perf_counter()
+        for vtx in ids:
+            engine.membership(int(vtx))
+            engine.score(int(vtx))
+        single_qps = len(ids) / (time.perf_counter() - t0)
+        reps = 32
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            engine.query_batch(ids)
+        batched_qps = reps * len(ids) / (time.perf_counter() - t0)
+
+        # delta-apply vs cold recompute at three delta sizes. ONE
+        # ingestor across the ladder — the steady-state shape: the LOF
+        # stream bootstraps once (paid by the warmup delta below), then
+        # each batch scores only its affected vertices.
+        from graphmine_tpu.obs.spans import Tracer
+        from graphmine_tpu.pipeline.metrics import MetricsSink
+
+        sink = MetricsSink(tracer=Tracer())
+        ing = DeltaIngestor(store, sink=sink, lof_k=16, check_samples=64)
+        ing.apply(EdgeDelta.from_pairs(insert=[(0, 1)]))  # LOF bootstrap
+        ladder = []
+        for frac in (0.0005, 0.005, 0.05):
+            n_d = max(8, int(e * frac))
+            cur_v = ing.num_vertices
+            ins = np.stack(
+                [rng.integers(0, cur_v, n_d), rng.integers(0, cur_v, n_d)],
+                axis=1,
+            )
+            dele_idx = rng.integers(0, len(ing.src), n_d // 2)
+            delta = EdgeDelta(
+                ins[:, 0], ins[:, 1],
+                ing.src[dele_idx].astype(np.int64),
+                ing.dst[dele_idx].astype(np.int64),
+            )
+            src_c, dst_c = ing.src.copy(), ing.dst.copy()
+            t0 = time.perf_counter()
+            ing.apply(delta)
+            t_apply = time.perf_counter() - t0
+            rec = [
+                r for r in sink.records if r.get("phase") == "delta_apply"
+            ][-1]
+            s2, d2, v2, _ = splice_edges(src_c, dst_c, cur_v, delta)
+            g2 = build_graph(s2, d2, num_vertices=v2)
+            t0 = time.perf_counter()
+            cold_recompute(g2)
+            t_cold = time.perf_counter() - t0
+            repair_s = rec["repair_seconds"]
+            ladder.append({
+                "delta_edges": n_d + n_d // 2,
+                "apply_seconds": round(t_apply, 3),
+                "repair_seconds": repair_s,
+                "lof_seconds": rec["lof_seconds"],
+                "repair_method": rec["method"],
+                "cold_recompute_seconds": round(t_cold, 3),
+                # the like-for-like term: warm label repair vs the cold
+                # label recompute it replaces
+                "repair_speedup_vs_cold": round(t_cold / repair_s, 2)
+                if repair_s > 0 else None,
+                "version": rec["version"],
+            })
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "serve_batched_lookups_per_sec_cpu_fallback"
+                    if _CPU_FALLBACK else "serve_batched_lookups_per_sec"
+                ),
+                "value": round(batched_qps),
+                "unit": "lookups/s",
+                # batched-over-single speedup: the one-device-gather
+                # path's win over per-vertex resolution
+                "vs_baseline": round(batched_qps / single_qps, 2)
+                if single_qps > 0 else 0.0,
+                "detail": {
+                    "num_vertices": v,
+                    "num_edges": e,
+                    "single_qps": round(single_qps),
+                    "batched_qps": round(batched_qps),
+                    "batch_size": len(ids),
+                    "snapshot_publish_seconds": round(t_publish, 3),
+                    "snapshot_load_seconds": round(t_load, 3),
+                    "cold_pipeline_seconds": round(t_cold_base, 2),
+                    "delta_ladder": ladder,
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
 def _run_chip_tier(weighted: bool) -> None:
     """Shared chip-tier measurement: fused-kernel LPA supersteps on the
     standard power-law graph, one timing path for the unweighted and
@@ -1510,6 +1671,7 @@ _CHILD_TIMEOUT_S = {
     "quality": 1200.0,
     "weighted": 900.0,
     "stream": 1200.0,
+    "serve": 1200.0,
 }
 
 # Healthy-TPU capture order: chip first (its number headlines the final
@@ -1519,13 +1681,13 @@ _CHILD_TIMEOUT_S = {
 # number), then the remaining tiers by evidence value.
 _TIER_ORDER = [
     "chip", "roofline", "northstar", "sharded", "cc", "e2e", "lof", "snap",
-    "quality", "weighted", "stream",
+    "quality", "weighted", "stream", "serve",
 ]
 # Dead-tunnel fallback order: every tier has a reduced-scale CPU variant
 # except roofline (CPU primitive rates say nothing about the TPU model).
 _FALLBACK_TIERS = [
     "chip", "northstar", "sharded", "cc", "e2e", "lof", "snap", "quality",
-    "weighted", "stream",
+    "weighted", "stream", "serve",
 ]
 
 # Indirection so orchestration tests can stub the inter-probe wait.
@@ -1949,7 +2111,7 @@ if __name__ == "__main__":
         "--tier",
         choices=[
             "all", "chip", "roofline", "northstar", "sharded", "cc", "e2e",
-            "lof", "snap", "quality", "weighted", "stream",
+            "lof", "snap", "quality", "weighted", "stream", "serve",
         ],
         # No-args (the driver's invocation) = the full evidence suite: one
         # healthy TPU window turns every README performance claim into a
@@ -1969,6 +2131,7 @@ if __name__ == "__main__":
         "quality": main_quality,
         "weighted": main_weighted,
         "stream": main_stream,
+        "serve": main_serve,
     }
     if os.environ.get("_GRAPHMINE_BENCH_CHILD") == "1":
         fn = _TIERS.get(args.tier)

@@ -65,6 +65,15 @@ register("outlier_summary", "method")
 register("ivf_fallback", "guard", "detail")
 register("impl_selected", "op", "impl", "n", "reason")
 
+# ---- serving records (docs/SERVING.md) ------------------------------------
+register("snapshot_publish", "version", "snapshot_id", "path", "bytes",
+         "arrays", "seconds")
+register("snapshot_load", "version", "path", "seconds")
+register("delta_apply", "inserts", "deletes", "method", "iterations",
+         "quarantine", "version", "seconds")
+register("query_batch", "endpoint", "n", "seconds")
+register("repair_fallback", "stage", "reason")
+
 # ---- recovery / resilience records (docs/RESILIENCE.md) -------------------
 register("retry", "stage", "attempt", "backoff_s", "error")
 register("retries_exhausted", "stage", "attempts", "error")
@@ -83,6 +92,7 @@ RECOVERY_PHASES = frozenset((
     "retry", "retries_exhausted", "degrade", "mesh_degrade", "tripwire",
     "watchdog_timeout", "resume", "checkpoint_rollback",
     "checkpoint_rollback_ok", "ivf_fallback", "quarantine",
+    "repair_fallback",
 ))
 
 

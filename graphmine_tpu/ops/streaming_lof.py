@@ -138,11 +138,18 @@ class StreamingLOF:
 
     def __init__(self, k: int = 20, capacity: int = 4096,
                  admit_threshold: float | None = None, impl: str = "exact",
-                 ivf_retrain_every: int = 0, sink=None):
+                 ivf_retrain_every: int = 0, sink=None, centers=None):
         """``admit_threshold``: if set, points scoring above it are flagged
         but NOT admitted to the window. Without it, persistent outlier
         clusters eventually enter the window and start looking normal —
-        sometimes wanted (regime change), sometimes not (contamination)."""
+        sometimes wanted (regime change), sometimes not (contamination).
+
+        ``centers`` (r7): pre-trained float32 ``[C, F]`` k-means centers
+        to seed the IVF re-fit path with — a serving-layer scorer
+        resuming from a snapshot skips Lloyd entirely (the same
+        ``ivf_knn(centers=...)`` reuse the first full window would
+        otherwise train; ``ivf_retrain_every`` still refreshes them on
+        its cadence). Ignored under ``impl="exact"``."""
         if capacity <= k + 1:
             raise ValueError(f"capacity {capacity} must exceed k+1 = {k + 1}")
         if impl not in ("exact", "ivf"):
@@ -157,7 +164,12 @@ class StreamingLOF:
         self.ivf_retrains = 0  # kmeans trainings performed (reuse metric)
         self._sink = sink
         self._ivf_fits = 0     # re-fits that actually rode the index
-        self._centers = None   # trained [C, F] centers (impl="ivf")
+        # trained [C, F] centers (impl="ivf"); seeded from `centers` when
+        # given so a resumed scorer never re-trains what a prior
+        # process/snapshot already paid for
+        self._centers = (
+            None if centers is None else np.asarray(centers, np.float32)
+        )
         self._refs: np.ndarray | None = None  # [capacity, F]
         self._valid = 0        # number of valid slots (grows to capacity)
         self._write = 0        # ring-buffer write head

@@ -11,6 +11,7 @@ from graphmine_tpu.parallel.sharded import (
     partition_graph,
     shard_graph_arrays,
     sharded_label_propagation,
+    sharded_lpa_fixpoint,
     sharded_connected_components,
     sharded_pagerank,
 )
@@ -23,6 +24,7 @@ __all__ = [
     "partition_graph",
     "shard_graph_arrays",
     "sharded_label_propagation",
+    "sharded_lpa_fixpoint",
     "sharded_connected_components",
     "sharded_pagerank",
     "ring_label_propagation",

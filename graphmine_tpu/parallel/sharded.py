@@ -146,6 +146,20 @@ def _cc_tripwire(new, cur, it, chunk_size, every):
     _fire_trip(fire, kind, shard, it + 1)
 
 
+def _lpa_range_tripwire(new, cur, it, chunk_size, every):
+    """Range-only LPA guard for the fixpoint runner (r7 serving repair).
+    The oscillation guard needs the previous iterate, which the fixpoint
+    carry doesn't hold — a period-2 livelock simply never reaches
+    frontier 0 and exhausts the repair budget, which the serving layer's
+    full-recompute fallback already handles."""
+    v_pad = new.shape[0]
+    bad = (new < 0) | (new >= v_pad)
+    kind = jnp.where(jnp.any(bad), 1, 0)
+    shard = (jnp.argmax(bad).astype(jnp.int32) // chunk_size)
+    fire = (kind > 0) & (((it + 1) % every) == 0)
+    _fire_trip(fire, kind, shard, it + 1)
+
+
 def _rank_tripwire(new, it, chunk_size, every):
     """PageRank guard: NaN/Inf anywhere in the rank vector. NaN is
     absorbing through the power iteration AND satisfies no convergence
@@ -657,15 +671,18 @@ _FIXPOINT_TELEMETRY_CAP = 4096
 
 def _fixpoint_supersteps(
     step_fn, sg: ShardedGraph, max_iter: int, tripwire_every: int = 0,
-    init_labels=None, collect: bool = False,
+    init_labels=None, collect: bool = False, guard=_cc_tripwire,
 ):
     """Run supersteps until no label changes (CC semantics), bounded by
     ``max_iter`` when nonzero. Shared by the replicated-label and ring
     schedules so the convergence logic has one home. ``tripwire_every``
-    arms the CC tripwires (range + monotonicity) every K supersteps;
-    ``init_labels`` resumes a checkpointed run mid-fixpoint. ``collect``
-    accumulates :func:`_telemetry_row` into a fixed-size buffer carried
-    through the while_loop and returns
+    arms the ``guard`` tripwire every K supersteps — the CC guards
+    (range + monotonicity) by default; the LPA fixpoint runner passes
+    its range-only guard (min-monotonicity doesn't hold for mode
+    propagation). ``init_labels`` resumes a checkpointed run
+    mid-fixpoint or seeds a warm-start repair. ``collect`` accumulates
+    :func:`_telemetry_row` into a fixed-size buffer carried through the
+    while_loop and returns
     ``(labels, (changed[cap], shard_changed[cap, D], it_end))``."""
     limit = max_iter if max_iter > 0 else sg.num_vertices + 2
     cap = min(limit, _FIXPOINT_TELEMETRY_CAP)
@@ -679,7 +696,7 @@ def _fixpoint_supersteps(
         it = state[2]
         new = step_fn(labels)
         if tripwire_every:
-            _cc_tripwire(new, labels, it, sg.chunk_size, tripwire_every)
+            guard(new, labels, it, sg.chunk_size, tripwire_every)
         if collect:
             total, per_shard = _telemetry_row(new, labels, sg.chunk_size)
             row = jnp.minimum(it, cap - 1)
@@ -706,7 +723,7 @@ def _fixpoint_supersteps(
         # fixpoint loop between two K-aligned checks; garbage must never
         # leave the loop silently. Monotonicity needs history, so only
         # the range guard applies here (cur=new disables it).
-        _cc_tripwire(labels, labels, it_end - 1, sg.chunk_size, 1)
+        guard(labels, labels, it_end - 1, sg.chunk_size, 1)
     if collect:
         return labels[: sg.num_vertices], (out[3], out[4], it_end)
     return labels[: sg.num_vertices]
@@ -748,12 +765,10 @@ def sharded_label_propagation(
     )
 
 
-@partial(jax.jit, static_argnames=("max_iter", "mesh", "tripwire_every", "telemetry"))
-def _sharded_lpa_jit(
-    sg: ShardedGraph, mesh, max_iter: int, init_labels, tripwire_every: int,
-    telemetry: bool = False,
-):
-    _check_mesh(sg, mesh)
+def _build_lpa_step(sg: ShardedGraph, mesh):
+    """The per-superstep LPA callable for one (graph, mesh) — shared by
+    the fixed-count driver (:func:`_sharded_lpa_jit`) and the fixpoint
+    repair entry (:func:`_sharded_lpa_fixpoint_jit`). Traced under jit."""
     axes = _vertex_axes(mesh)
     rep = P()
     if sg.bucket_send:
@@ -775,20 +790,28 @@ def _sharded_lpa_jit(
             # which the vma checker cannot infer statically.
             check_vma=False,
         )
-        step = lambda l: body(l, sg.bucket_send, sg.bucket_target, sg.bucket_weight)
-    else:
-        in_specs, _ = _shard_specs(mesh)
-        data_spec = P(axes, None)
-        body = shard_map(
-            partial(_lpa_shard_body, chunk_size=sg.chunk_size, axes=axes),
-            mesh=mesh,
-            in_specs=in_specs + (data_spec,),  # None weights: empty subtree
-            out_specs=rep,
-            check_vma=False,
-        )
-        step = lambda l: body(
-            l, sg.msg_recv_local, sg.msg_send, sg.degrees, sg.msg_weight
-        )
+        return lambda l: body(l, sg.bucket_send, sg.bucket_target, sg.bucket_weight)
+    in_specs, _ = _shard_specs(mesh)
+    data_spec = P(axes, None)
+    body = shard_map(
+        partial(_lpa_shard_body, chunk_size=sg.chunk_size, axes=axes),
+        mesh=mesh,
+        in_specs=in_specs + (data_spec,),  # None weights: empty subtree
+        out_specs=rep,
+        check_vma=False,
+    )
+    return lambda l: body(
+        l, sg.msg_recv_local, sg.msg_send, sg.degrees, sg.msg_weight
+    )
+
+
+@partial(jax.jit, static_argnames=("max_iter", "mesh", "tripwire_every", "telemetry"))
+def _sharded_lpa_jit(
+    sg: ShardedGraph, mesh, max_iter: int, init_labels, tripwire_every: int,
+    telemetry: bool = False,
+):
+    _check_mesh(sg, mesh)
+    step = _build_lpa_step(sg, mesh)
     labels = _padded_init_labels(sg) if init_labels is None else _pad_labels(init_labels, sg)
     out = _scan_supersteps(
         step, labels, max_iter,
@@ -799,6 +822,50 @@ def _sharded_lpa_jit(
         labels, ys = out
         return labels[: sg.num_vertices], ys
     return out[: sg.num_vertices]
+
+
+def sharded_lpa_fixpoint(
+    sg: ShardedGraph, mesh, max_iter: int = 0,
+    init_labels: jax.Array | None = None, tripwire_every: int = 0,
+):
+    """Warm-start LPA run to FIXPOINT — the serving delta-repair entry
+    (r7, docs/SERVING.md): ``init_labels`` seeds the previous snapshot's
+    labels and supersteps run until no label changes, bounded by
+    ``max_iter`` (0 = unbounded). Returns
+    ``(labels[:V], iterations, converged)`` — ``converged=False`` means
+    the budget exhausted first (the serving layer then falls back to a
+    cold full recompute rather than publish a non-fixpoint).
+
+    Same shard bodies, comms and mesh semantics as
+    :func:`sharded_label_propagation`; only the loop driver differs
+    (while-until-quiescent instead of a fixed scan).
+    ``tripwire_every`` arms the range-only LPA guard every K supersteps.
+    """
+    if not tripwire_every:
+        out = _sharded_lpa_fixpoint_jit(sg, mesh, max_iter, init_labels, 0)
+    else:
+        out = _run_armed(
+            lambda: _sharded_lpa_fixpoint_jit(
+                sg, mesh, max_iter, init_labels, tripwire_every
+            )
+        )
+    labels, (changed, _per_shard, it_end) = out
+    it = int(it_end)
+    row = min(it, changed.shape[0]) - 1
+    converged = it == 0 or int(changed[row]) == 0
+    return labels, it, converged
+
+
+@partial(jax.jit, static_argnames=("max_iter", "mesh", "tripwire_every"))
+def _sharded_lpa_fixpoint_jit(
+    sg: ShardedGraph, mesh, max_iter: int, init_labels, tripwire_every: int,
+):
+    _check_mesh(sg, mesh)
+    step = _build_lpa_step(sg, mesh)
+    return _fixpoint_supersteps(
+        step, sg, max_iter, tripwire_every=tripwire_every,
+        init_labels=init_labels, collect=True, guard=_lpa_range_tripwire,
+    )
 
 
 def sharded_connected_components(

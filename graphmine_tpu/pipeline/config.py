@@ -80,6 +80,13 @@ class PipelineConfig:
     # path (atomically, each heartbeat + once at exit) — the node_exporter
     # textfile-collector hand-off for runs with no scrape endpoint.
     prom_out: str | None = None
+    # serving (docs/SERVING.md): publish the run's results — community
+    # labels, CC labels, LOF scores, census, edge arrays, provenance —
+    # as a versioned snapshot generation at this store directory, as the
+    # pipeline's final phase. The serving layer (graphmine_tpu/serve/,
+    # tools/serve_cli.py) queries it and ingests edge deltas against it
+    # with warm-start repair instead of cold full recomputes.
+    snapshot_out: str | None = None
     # checkpoint / resume
     checkpoint_dir: str | None = None
     # Save every N supersteps (plus always the final one). 1 = every

@@ -93,7 +93,14 @@ def run_pipeline(config: PipelineConfig) -> PipelineResult:
         ).start()
     run_err: BaseException | None = None
     try:
-        return _run_pipeline(config, m)
+        result = _run_pipeline(config, m)
+        if config.snapshot_out:
+            # Serving hand-off (r7, docs/SERVING.md): the run's final
+            # phase publishes labels/CC/LOF/census + edges as a versioned
+            # snapshot generation the serve/ subsystem queries and
+            # delta-repairs against.
+            _publish_snapshot(config, result, m)
+        return result
     except BaseException as e:
         run_err = e
         raise
@@ -482,6 +489,72 @@ def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
             over_1_5=int((result.lof > 1.5).sum()),
         )
     return result
+
+
+def _publish_snapshot(config: PipelineConfig, result: PipelineResult, m: MetricsSink) -> None:
+    """Publish the pipeline's outputs as one snapshot generation.
+
+    CC labels are computed here (the pipeline itself has no CC phase):
+    device-resident graphs run the fused single-device fixpoint; host-
+    resident graphs (scale-out mode) shard over the mesh — the planner
+    just ruled out materializing them on one device. Wrapped in
+    ``run_phase`` so transient publish weather retries like any phase.
+    """
+    from graphmine_tpu.serve.snapshot import SnapshotStore
+
+    table, graph = result.edge_table, result.graph
+    n_dev = config.num_devices or _visible_devices()
+
+    def _publish():
+        resilience.fault_point("snapshot_publish")
+        if isinstance(graph.src, np.ndarray):
+            from graphmine_tpu.parallel.mesh import make_mesh
+            from graphmine_tpu.parallel.sharded import (
+                partition_graph,
+                shard_graph_arrays,
+                sharded_connected_components,
+            )
+
+            mesh = make_mesh(n_dev)
+            sg = shard_graph_arrays(partition_graph(graph, mesh=mesh), mesh)
+            cc = np.asarray(sharded_connected_components(sg, mesh))
+        else:
+            from graphmine_tpu.ops.cc import connected_components
+
+            cc = np.asarray(connected_components(graph))
+        present, sizes, edge_counts = result.community_table
+        arrays = {
+            "src": np.asarray(table.src, np.int32),
+            "dst": np.asarray(table.dst, np.int32),
+            "labels": np.asarray(result.labels, np.int32),
+            "cc_labels": cc.astype(np.int32),
+            "census_present": np.asarray(present),
+            "census_sizes": np.asarray(sizes),
+            "census_edges": np.asarray(edge_counts),
+        }
+        if result.lof is not None:
+            arrays["lof"] = np.asarray(result.lof, np.float32)
+        if table.weights is not None:
+            # Preserved so queries/provenance keep the real graph; the
+            # delta-repair path refuses weighted snapshots loudly (its
+            # propagations are unweighted — repairing weighted-LPA labels
+            # with unweighted supersteps would silently change semantics).
+            arrays["weights"] = np.asarray(table.weights, np.float32)
+        store = SnapshotStore(config.snapshot_out)
+        return store.publish(
+            arrays,
+            fingerprint=ckpt.graph_fingerprint(
+                table.src, table.dst, table.weights
+            ),
+            run_id=m.tracer.run_id if m.tracer is not None else "",
+            mesh_shape=[n_dev],
+            sink=m,
+        )
+
+    with m.span("snapshot_publish"):
+        resilience.run_phase(
+            "snapshot_publish", _publish, config.resilience, m
+        )
 
 
 def _emit_superstep_telemetry(

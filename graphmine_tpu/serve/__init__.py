@@ -1,0 +1,34 @@
+"""Serving layer: versioned snapshots, incremental delta repair, queries.
+
+The batch pipeline (``pipeline/driver.py``) computes communities and LOF
+scores and exits; nothing served those results, and every new edge batch
+forced a cold full recompute. This package is the steady-state side
+(docs/SERVING.md):
+
+- :mod:`~graphmine_tpu.serve.snapshot` — versioned, atomically-published
+  result snapshots (the checkpoint manifest pattern applied to pipeline
+  *outputs*);
+- :mod:`~graphmine_tpu.serve.delta` — edge insert/delete batches spliced
+  into the graph with **warm-start repair**: the previous snapshot's
+  labels seed LPA/CC via ``init_labels`` and only the delta-affected
+  frontier re-runs (GraphBLAST's steady-state argument), tripwire-guarded
+  by a sampled exact check with full-recompute fallback;
+- :mod:`~graphmine_tpu.serve.query` — O(1)/O(log n) lookups over a loaded
+  snapshot, with a batched one-device-gather path;
+- :mod:`~graphmine_tpu.serve.server` — a stdlib HTTP front end that
+  double-buffers snapshots so a delta publish swaps atomically under
+  live queries.
+"""
+
+from graphmine_tpu.serve.delta import DeltaIngestor, EdgeDelta, RepairResult
+from graphmine_tpu.serve.query import QueryEngine
+from graphmine_tpu.serve.snapshot import Snapshot, SnapshotStore
+
+__all__ = [
+    "DeltaIngestor",
+    "EdgeDelta",
+    "QueryEngine",
+    "RepairResult",
+    "Snapshot",
+    "SnapshotStore",
+]

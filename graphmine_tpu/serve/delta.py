@@ -1,0 +1,670 @@
+"""Incremental delta ingest: splice edge batches, repair labels warm.
+
+The batch pipeline recomputes everything from scratch on every new edge
+batch. Steady-state serving inverts that (GraphBLAST's argument: keep
+graph state resident, re-run only the delta-affected frontier):
+
+1. **validate** an insert/delete batch through the ingestion-quarantine
+   rules (negative / absurdly-large ids, deletes that match nothing are
+   counted and set aside, never crash the server);
+2. **splice** it into the host edge arrays — inserts append (duplicates
+   keep LPA multiplicity semantics, ``Graphframes.py:70-74``), deletes
+   remove one matching directed occurrence each (multiset semantics);
+3. **repair**: the previous snapshot's labels seed the new graph's
+   LPA/CC via the ``init_labels`` warm-start seam
+   (``parallel/sharded.py``) and propagate to a new fixpoint under a
+   frontier-derived iteration budget;
+4. **verify**: a sampled exact check — one exact superstep of the new
+   graph evaluated at sampled vertices (every delta-affected vertex plus
+   a random sample) must leave the repaired labels unchanged, and every
+   label must be a real vertex id. Any disagreement (or a budget
+   exhausted before the frontier emptied) emits a ``repair_fallback``
+   record and falls back to a cold full recompute — serving must never
+   publish a state the exact operator disagrees with.
+
+Warm-start correctness notes (docs/SERVING.md "delta semantics"):
+
+- **CC** repair is exact by construction: old component labels are valid
+  min-propagation upper bounds after inserts (merges only); deletes can
+  split, so every vertex of a component touched by a delete is reset to
+  its own id first — untouched components keep their (already exact)
+  labels, and the monotone min fixpoint from a valid upper bound is THE
+  fixpoint. Repair == cold recompute, always.
+- **LPA** fixpoints are not unique, so warm repair is *checked*, not
+  assumed: the sampled exact check accepts only genuine fixpoints of the
+  new graph, and the equivalence tests pin repair == cold recompute on
+  CPU test graphs (insert-only, delete-only, mixed batches).
+
+Repaired outlier scores ride the existing streaming reuse path:
+:class:`~graphmine_tpu.ops.streaming_lof.StreamingLOF` with
+``impl="ivf"`` re-fits its window against ONE trained set of k-means
+centers, so each delta scores only the affected vertices' features.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from graphmine_tpu.pipeline import resilience
+from graphmine_tpu.pipeline.checkpoint import graph_fingerprint
+from graphmine_tpu.serve.snapshot import Snapshot, SnapshotStore
+
+# Growth guard: a typo'd insert id must not allocate a billion-row label
+# vector. Inserts past current V + this bound are quarantined.
+MAX_NEW_VERTICES = 1 << 20
+
+
+@dataclass
+class EdgeDelta:
+    """One edge insert/delete batch (directed endpoints, dense ids)."""
+
+    insert_src: np.ndarray = field(default_factory=lambda: np.empty(0, np.int64))
+    insert_dst: np.ndarray = field(default_factory=lambda: np.empty(0, np.int64))
+    delete_src: np.ndarray = field(default_factory=lambda: np.empty(0, np.int64))
+    delete_dst: np.ndarray = field(default_factory=lambda: np.empty(0, np.int64))
+
+    def __post_init__(self):
+        for name in ("insert_src", "insert_dst", "delete_src", "delete_dst"):
+            setattr(self, name, np.asarray(getattr(self, name), np.int64))
+        if (
+            self.insert_src.shape != self.insert_dst.shape
+            or self.delete_src.shape != self.delete_dst.shape
+        ):
+            raise ValueError("src/dst arrays must be equal-length")
+
+    @classmethod
+    def from_pairs(cls, insert=(), delete=()) -> "EdgeDelta":
+        """Build from ``[(src, dst), ...]`` pair lists (the JSON wire
+        shape the HTTP front end accepts)."""
+        ins = np.asarray(list(insert), np.int64).reshape(-1, 2)
+        del_ = np.asarray(list(delete), np.int64).reshape(-1, 2)
+        return cls(ins[:, 0], ins[:, 1], del_[:, 0], del_[:, 1])
+
+    @property
+    def num_inserts(self) -> int:
+        return len(self.insert_src)
+
+    @property
+    def num_deletes(self) -> int:
+        return len(self.delete_src)
+
+
+def validate_delta(
+    delta: EdgeDelta, num_vertices: int,
+    max_new_vertices: int = MAX_NEW_VERTICES,
+) -> tuple[EdgeDelta, dict]:
+    """Quarantine-validate a delta against the current vertex space.
+
+    Returns ``(clean_delta, quarantine)`` — the same count-and-set-aside
+    contract as ingestion (``io/edges.from_arrays``): negative ids and
+    inserts past the growth guard are dropped as ``out_of_range_ids``;
+    deletes referencing vertices that don't exist can never match an
+    edge and are dropped as ``unmatched_deletes``. Nothing raises on bad
+    rows — a served endpoint crashing on one malformed batch row is the
+    failure mode quarantine exists to prevent.
+    """
+    q = {"out_of_range_ids": 0, "unmatched_deletes": 0}
+    cap = num_vertices + max_new_vertices
+    ok_i = (
+        (delta.insert_src >= 0) & (delta.insert_dst >= 0)
+        & (delta.insert_src < cap) & (delta.insert_dst < cap)
+    )
+    q["out_of_range_ids"] += int((~ok_i).sum())
+    ok_d = (
+        (delta.delete_src >= 0) & (delta.delete_dst >= 0)
+        & (delta.delete_src < num_vertices) & (delta.delete_dst < num_vertices)
+    )
+    q["unmatched_deletes"] += int((~ok_d).sum())
+    return EdgeDelta(
+        delta.insert_src[ok_i], delta.insert_dst[ok_i],
+        delta.delete_src[ok_d], delta.delete_dst[ok_d],
+    ), q
+
+
+def splice_edges(src, dst, num_vertices: int, delta: EdgeDelta):
+    """Apply a validated delta to host edge arrays.
+
+    Inserts append (multiplicity kept); each delete row removes ONE
+    matching directed occurrence (multiset delete — deleting an edge
+    that appears 3x leaves 2). Returns
+    ``(src', dst', num_vertices', stats)`` with
+    ``stats = {inserted, deleted, unmatched_deletes}``; the vertex space
+    only ever grows (deletes remove edges, never vertices — stable ids
+    are the serving contract).
+    """
+    src = np.asarray(src, np.int64)
+    dst = np.asarray(dst, np.int64)
+    v_new = int(
+        max(
+            num_vertices,
+            delta.insert_src.max(initial=-1) + 1,
+            delta.insert_dst.max(initial=-1) + 1,
+        )
+    )
+    keep = np.ones(len(src), bool)
+    unmatched = 0
+    if delta.num_deletes:
+        enc = v_new + 1
+        ekey = src * enc + dst
+        dkey = delta.delete_src * enc + delta.delete_dst
+        dk_u, dk_c = np.unique(dkey, return_counts=True)
+        order = np.argsort(ekey, kind="stable")
+        sk = ekey[order]
+        # occurrence rank of each edge within its (src, dst) group
+        rank = np.arange(len(sk)) - np.searchsorted(sk, sk, side="left")
+        pos = np.searchsorted(dk_u, sk)
+        pos_c = np.minimum(pos, len(dk_u) - 1)
+        want = np.where(dk_u[pos_c] == sk, dk_c[pos_c], 0)
+        drop_sorted = rank < want
+        keep[order[drop_sorted]] = False
+        unmatched = int(delta.num_deletes - drop_sorted.sum())
+    src2 = np.concatenate([src[keep], delta.insert_src])
+    dst2 = np.concatenate([dst[keep], delta.insert_dst])
+    stats = {
+        "inserted": delta.num_inserts,
+        "deleted": int((~keep).sum()),
+        "unmatched_deletes": unmatched,
+    }
+    return src2.astype(np.int32), dst2.astype(np.int32), v_new, stats
+
+
+def affected_vertices(delta: EdgeDelta) -> np.ndarray:
+    """Distinct vertex ids a delta touches directly — the repair frontier
+    seed (their labels may change first; propagation widens from here)."""
+    return np.unique(
+        np.concatenate(
+            [delta.insert_src, delta.insert_dst,
+             delta.delete_src, delta.delete_dst]
+        )
+    ).astype(np.int64)
+
+
+def frontier_budget(num_vertices: int, affected: int) -> int:
+    """Frontier-derived superstep budget for a warm repair.
+
+    Label effects propagate one hop per superstep, so a delta touching
+    ``affected`` seeds needs depth proportional to how far its influence
+    can reach before dying out: ``log2``-ish in the graph size (pointer
+    jumping / small-world propagation depth) plus a term in the seed
+    count. Deliberately generous — exhausting it without convergence
+    triggers the full-recompute fallback, so a tight budget only costs a
+    wasted warm attempt, never a wrong answer.
+    """
+    v_term = math.ceil(math.log2(num_vertices + 2))
+    a_term = math.ceil(math.log2(affected + 2))
+    return int(min(128, 2 * v_term + a_term + 8))
+
+
+# ---- warm fixpoint runners -------------------------------------------------
+
+
+def _warm_lpa(graph, init_labels: np.ndarray, budget: int):
+    """Warm-start synchronous LPA to fixpoint, bounded by ``budget``.
+
+    One jitted superstep per iteration (the serving graphs this runs on
+    are the delta-affected working set, not the 100M-vertex batch case;
+    the sharded twin is
+    :func:`graphmine_tpu.parallel.sharded.sharded_lpa_fixpoint`).
+    Returns ``(labels, iterations, converged)``.
+
+    Period-2 cycles — synchronous LPA's known livelock on e.g. bipartite
+    hub structures — are detected (state t+1 == state t-1) and exit
+    early as ``converged=False``: burning the rest of the budget on a
+    cycle that can never fixpoint would only delay the caller's
+    full-recompute fallback.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from graphmine_tpu.ops.lpa import lpa_superstep
+
+    step = jax.jit(lpa_superstep)
+    labels = jnp.asarray(init_labels, jnp.int32)
+    prev = None
+    for it in range(budget):
+        new = step(labels, graph)
+        if not bool(jnp.any(new != labels)):
+            return np.asarray(new), it + 1, True
+        if prev is not None and not bool(jnp.any(new != prev)):
+            return np.asarray(new), it + 1, False  # period-2 livelock
+        prev = labels
+        labels = new
+    return np.asarray(labels), budget, False
+
+
+def _warm_cc(graph, init_labels: np.ndarray, budget: int):
+    """Warm-start min-propagation CC to fixpoint (monotone, so any valid
+    upper-bound init converges to THE fixpoint). Returns
+    ``(labels, iterations, converged)``."""
+    import jax
+    import jax.numpy as jnp
+
+    from graphmine_tpu.ops.cc import cc_superstep
+
+    step = jax.jit(cc_superstep)
+    labels = jnp.asarray(init_labels, jnp.int32)
+    for it in range(budget):
+        new = step(labels, graph)
+        if not bool(jnp.any(new != labels)):
+            return np.asarray(new), it + 1, True
+        labels = new
+    return np.asarray(labels), budget, False
+
+
+def cc_repair_init(
+    prev_cc: np.ndarray, num_vertices: int, delta: EdgeDelta
+) -> np.ndarray:
+    """Valid min-propagation upper bounds seeded from the previous CC
+    labels: every vertex of a component touched by a DELETE resets to its
+    own id (the split case — its old min may have landed in the other
+    part), new vertices get their own id, everything else keeps its
+    (exact) label. See the module docstring for why this makes CC repair
+    == cold recompute by construction."""
+    init = np.arange(num_vertices, dtype=np.int32)
+    init[: len(prev_cc)] = prev_cc
+    if delta.num_deletes:
+        touched = np.unique(
+            prev_cc[
+                np.concatenate([delta.delete_src, delta.delete_dst]).astype(
+                    np.int64
+                )
+            ]
+        )
+        reset = np.isin(prev_cc, touched)
+        init[: len(prev_cc)][reset] = np.arange(len(prev_cc), dtype=np.int32)[
+            reset
+        ]
+    return init
+
+
+def sampled_exact_check(
+    graph, labels: np.ndarray, samples: np.ndarray, kind: str = "lpa"
+) -> tuple[bool, int]:
+    """The repair tripwire: one EXACT superstep of the new graph must
+    leave the repaired labels unchanged at every sampled vertex, and
+    every sampled label must be a real vertex id. A genuine fixpoint
+    passes by construction; corrupted state, a non-fixpoint (budget ran
+    out), or a wrong-graph mixup does not. Returns
+    ``(ok, mismatching_samples)``."""
+    import jax
+    import jax.numpy as jnp
+
+    from graphmine_tpu.ops.cc import cc_superstep
+    from graphmine_tpu.ops.lpa import lpa_superstep
+
+    v = graph.num_vertices
+    lbl = np.asarray(labels)
+    oob = int(((lbl < 0) | (lbl >= v)).sum())
+    if oob:
+        return False, oob
+    step = lpa_superstep if kind == "lpa" else cc_superstep
+    nxt = np.asarray(jax.jit(step)(jnp.asarray(lbl, jnp.int32), graph))
+    samples = np.asarray(samples, np.int64)
+    samples = samples[(samples >= 0) & (samples < v)]
+    bad = int((nxt[samples] != lbl[samples]).sum())
+    return bad == 0, bad
+
+
+@dataclass(frozen=True)
+class RepairResult:
+    """Outcome of one delta repair."""
+
+    labels: np.ndarray            # community labels [V'] (LPA fixpoint)
+    cc_labels: np.ndarray         # CC labels [V']
+    method: str                   # "warm" | "full_recompute"
+    iterations: int               # supersteps the winning path ran (LPA + CC)
+    fallback_reason: str | None = None
+    checked_samples: int = 0
+
+
+def cold_recompute(graph, budget: int = 0):
+    """Cold full recompute — the fallback AND the equivalence oracle the
+    repair tests compare against: LPA from identity init run to fixpoint
+    (bounded, period-2 cycles exit early), CC from identity. Returns
+    ``(labels, cc_labels, iters)``. On graphs whose synchronous LPA
+    livelocks (never fixpoints), the result is the cycle-stopped bounded
+    recompute — the same semantics class as the batch pipeline's bounded
+    ``max_iter`` — and every delta on such a graph routes here via the
+    repair fallback (the sampled check refuses non-fixpoints)."""
+    import numpy as _np
+
+    v = graph.num_vertices
+    budget = budget or frontier_budget(v, v)
+    labels, it_l, _ = _warm_lpa(
+        graph, _np.arange(v, dtype=_np.int32), budget
+    )
+    from graphmine_tpu.ops.cc import connected_components
+
+    cc = _np.asarray(connected_components(graph))
+    return labels, cc, it_l
+
+
+def _verify_or_fallback(
+    graph, labels, cc, conv_l, conv_c, delta: EdgeDelta, budget: int,
+    iterations: int, check_samples: int, sink, num_shards: int = 1,
+    seed: int = 0,
+) -> RepairResult:
+    """The shared tail of BOTH repair paths (single-device and sharded):
+    fault seam → sampled exact check → accept or fall back. One owner so
+    the two paths can never diverge on what gets published.
+
+    The fault seam is where tests corrupt the repaired state
+    (poison_labels-style mutator) to prove the sampled check catches
+    silent damage and the fallback republishes exact labels.
+    """
+    state = {"labels": labels, "cc_labels": cc}
+    resilience.fault_point("delta_repair", state=state, num_shards=num_shards)
+    labels, cc = state["labels"], state["cc_labels"]
+
+    v = graph.num_vertices
+    rng = np.random.default_rng(seed)
+    extra = rng.integers(0, v, size=min(check_samples, v))
+    samples = np.unique(np.concatenate([affected_vertices(delta), extra]))
+    ok_l, bad_l = sampled_exact_check(graph, labels, samples, kind="lpa")
+    ok_c, bad_c = sampled_exact_check(graph, cc, samples, kind="cc")
+
+    reason = None
+    if not (conv_l and conv_c):
+        reason = (
+            f"budget exhausted before fixpoint (lpa converged={conv_l}, "
+            f"cc converged={conv_c}, budget={budget})"
+        )
+    elif not (ok_l and ok_c):
+        reason = (
+            f"sampled exact check failed ({bad_l} lpa / {bad_c} cc "
+            f"disagreements over {len(samples)} samples)"
+        )
+    if reason is None:
+        return RepairResult(
+            labels=labels, cc_labels=cc, method="warm",
+            iterations=iterations, checked_samples=len(samples),
+        )
+    if sink is not None:
+        sink.emit("repair_fallback", stage="delta_repair", reason=reason)
+    labels, cc, it = cold_recompute(graph)
+    return RepairResult(
+        labels=labels, cc_labels=cc, method="full_recompute",
+        iterations=it, fallback_reason=reason,
+        checked_samples=len(samples),
+    )
+
+
+def repair_labels(
+    graph,
+    prev_labels: np.ndarray,
+    prev_cc: np.ndarray,
+    delta: EdgeDelta,
+    budget: int | None = None,
+    check_samples: int = 64,
+    sink=None,
+    seed: int = 0,
+) -> RepairResult:
+    """Warm-start repair of community + CC labels on the spliced graph.
+
+    The previous snapshot's labels seed both propagations (see module
+    docstring for the exact init rules); the sampled exact check accepts
+    or rejects the result, and rejection — or a budget exhausted before
+    the frontier emptied — falls back to :func:`cold_recompute` with a
+    ``repair_fallback`` record through ``sink``. The returned labels are
+    therefore ALWAYS a verified fixpoint of the new graph.
+    """
+    v = graph.num_vertices
+    if budget is None:
+        budget = frontier_budget(v, len(affected_vertices(delta)))
+
+    init_lpa = np.arange(v, dtype=np.int32)
+    init_lpa[: len(prev_labels)] = prev_labels
+    labels, it_l, conv_l = _warm_lpa(graph, init_lpa, budget)
+    cc, it_c, conv_c = _warm_cc(
+        graph, cc_repair_init(np.asarray(prev_cc), v, delta), budget
+    )
+    return _verify_or_fallback(
+        graph, labels, cc, conv_l, conv_c, delta, budget, it_l + it_c,
+        check_samples, sink, seed=seed,
+    )
+
+
+class DeltaIngestor:
+    """Applies edge deltas to a snapshot store: validate → splice →
+    warm repair → streaming LOF refresh → publish.
+
+    Holds the host-side working state (edge arrays + labels) between
+    deltas so consecutive batches never re-load the store, and one
+    :class:`~graphmine_tpu.ops.streaming_lof.StreamingLOF` whose trained
+    IVF centers are reused across deltas (``impl="ivf"`` — Lloyd runs
+    once per ingestor, not once per batch).
+
+    ``num_shards > 1`` runs the repair propagations through the sharded
+    entries (:func:`~graphmine_tpu.parallel.sharded.sharded_lpa_fixpoint`
+    / ``sharded_connected_components(init_labels=...)``) on a
+    ``num_shards``-device mesh — identical labels (parity-tested), for
+    working sets past one device.
+    """
+
+    def __init__(
+        self,
+        store: SnapshotStore,
+        sink=None,
+        lof_k: int = 16,
+        lof_capacity: int = 4096,
+        check_samples: int = 64,
+        num_shards: int = 1,
+        snapshot: Snapshot | None = None,
+    ):
+        self.store = store
+        self.sink = sink
+        self.check_samples = check_samples
+        self.num_shards = num_shards
+        snap = snapshot if snapshot is not None else store.load(sink=sink)
+        if snap is None:
+            raise ValueError(
+                f"snapshot store at {store.root!r} is empty; publish a "
+                "pipeline snapshot (--snapshot-out) before ingesting deltas"
+            )
+        self.snapshot = snap
+        if snap.get("weights") is not None:
+            raise ValueError(
+                "snapshot carries per-edge weights: delta repair runs "
+                "UNWEIGHTED propagations, and warm-repairing weighted-LPA "
+                "labels with unweighted supersteps would silently change "
+                "their semantics. Re-run the batch pipeline for weighted "
+                "graphs (weighted delta repair is a ROADMAP item)"
+            )
+        self.src = np.asarray(snap["src"], np.int32)
+        self.dst = np.asarray(snap["dst"], np.int32)
+        self.labels = np.asarray(snap["labels"], np.int32)
+        self.cc_labels = np.asarray(
+            snap.get("cc_labels", snap["labels"]), np.int32
+        )
+        lof = snap.get("lof")
+        self.lof = (
+            np.zeros(len(self.labels), np.float32) if lof is None
+            else np.asarray(lof, np.float32).copy()
+        )
+        self.lof_k = lof_k
+        self.lof_capacity = max(lof_capacity, lof_k + 2)
+        self._stream = None
+        # IVF centers from a prior process's publishes (if any): the
+        # StreamingLOF(centers=...) reuse path — Lloyd never re-trains
+        # what an earlier ingestor already paid for.
+        self._centers = snap.get("lof_centers")
+
+    @property
+    def num_vertices(self) -> int:
+        return len(self.labels)
+
+    def _repair(self, graph, delta: EdgeDelta) -> RepairResult:
+        if self.num_shards <= 1:
+            return repair_labels(
+                graph, self.labels, self.cc_labels, delta,
+                check_samples=self.check_samples, sink=self.sink,
+            )
+        return self._repair_sharded(graph, delta)
+
+    def _repair_sharded(self, graph, delta: EdgeDelta) -> RepairResult:
+        """Mesh twin of :func:`repair_labels`: same inits, propagation
+        through the sharded entries, same shared verify/fallback tail
+        (:func:`_verify_or_fallback`)."""
+        from graphmine_tpu.parallel.mesh import make_mesh
+        from graphmine_tpu.parallel.sharded import (
+            partition_graph,
+            shard_graph_arrays,
+            sharded_connected_components,
+            sharded_lpa_fixpoint,
+        )
+
+        v = graph.num_vertices
+        budget = frontier_budget(v, len(affected_vertices(delta)))
+        mesh = make_mesh(self.num_shards)
+        sg = shard_graph_arrays(partition_graph(graph, mesh=mesh), mesh)
+        import jax.numpy as jnp
+
+        init_lpa = np.arange(v, dtype=np.int32)
+        init_lpa[: len(self.labels)] = self.labels
+        labels, it_l, conv_l = sharded_lpa_fixpoint(
+            sg, mesh, max_iter=budget, init_labels=jnp.asarray(init_lpa)
+        )
+        # telemetry rides the while-loop carry and gives the convergence
+        # verdict the bare call lacks: exhausted-at-budget iff the final
+        # superstep still changed labels.
+        cc, tele = sharded_connected_components(
+            sg, mesh, max_iter=budget,
+            init_labels=jnp.asarray(cc_repair_init(self.cc_labels, v, delta)),
+            telemetry=True,
+        )
+        conv_c = tele.iterations < budget or (
+            len(tele.labels_changed) > 0 and int(tele.labels_changed[-1]) == 0
+        )
+        return _verify_or_fallback(
+            graph, np.asarray(labels), np.asarray(cc), conv_l, conv_c,
+            delta, budget, int(it_l) + int(tele.iterations),
+            self.check_samples, self.sink, num_shards=self.num_shards,
+        )
+
+    def _refresh_lof(self, graph, labels: np.ndarray, aff: np.ndarray):
+        """Score delta-affected vertices through the streaming IVF-reuse
+        path and splice them into the LOF column. The first delta
+        bootstraps the window from the full feature matrix (and refreshes
+        every score); later deltas STREAM-SCORE only the affected rows —
+        but the feature matrix itself is still the whole-graph vectorized
+        pass (vertex_features has no per-vertex entry point; features
+        depend on neighbor degrees and community sizes, which a delta can
+        shift beyond its own endpoints). That O(V+E) host term is the
+        delta hot path's known cost floor — incremental features are the
+        ROADMAP's serving scale-out item, not a claim this code makes."""
+        from graphmine_tpu.ops.features import standardize, vertex_features
+        from graphmine_tpu.ops.streaming_lof import StreamingLOF
+
+        feats = np.asarray(
+            standardize(
+                vertex_features(graph, labels, include_clustering="sampled")
+            ),
+            np.float32,
+        )
+        if self._stream is None:
+            self._stream = StreamingLOF(
+                k=min(self.lof_k, len(feats) - 2),
+                capacity=min(self.lof_capacity, max(len(feats), self.lof_k + 2)),
+                impl="ivf",
+                sink=self.sink,
+                centers=self._centers,
+            )
+            # np.array (copy), not asarray: device buffers view read-only
+            self.lof = np.array(self._stream.update(feats), np.float32)
+            self._centers = self._stream._centers
+            return
+        if len(self.lof) < len(feats):
+            self.lof = np.concatenate([
+                self.lof,
+                np.zeros(len(feats) - len(self.lof), np.float32),
+            ])
+        if len(aff):
+            self.lof[aff] = self._stream.update(feats[aff])
+        self._centers = self._stream._centers
+
+    def apply(self, delta: EdgeDelta) -> Snapshot:
+        """Validate, splice, repair, rescore and publish one delta batch.
+
+        Returns the newly published snapshot (its ``parent`` is the
+        snapshot this ingestor last published/loaded). Emits one
+        ``delta_apply`` record carrying the quarantine counts, the repair
+        method (warm vs fallback) and the per-stage outcome.
+        """
+        t0 = time.perf_counter()
+        span = (
+            self.sink.span("delta_apply") if self.sink is not None
+            else _null_ctx()
+        )
+        with span:
+            clean, quarantine = validate_delta(delta, self.num_vertices)
+            src2, dst2, v2, stats = splice_edges(
+                self.src, self.dst, self.num_vertices, clean
+            )
+            quarantine["unmatched_deletes"] += stats.pop("unmatched_deletes")
+            from graphmine_tpu.graph.container import build_graph
+
+            graph = build_graph(src2, dst2, num_vertices=v2)
+            t_r = time.perf_counter()
+            result = self._repair(graph, clean)
+            repair_seconds = time.perf_counter() - t_r
+            self.src, self.dst = src2, dst2
+            self.labels, self.cc_labels = result.labels, result.cc_labels
+            aff = affected_vertices(clean)
+            t_l = time.perf_counter()
+            self._refresh_lof(graph, result.labels, aff)
+            lof_seconds = time.perf_counter() - t_l
+
+            from graphmine_tpu.ops.census import census_table
+
+            present, sizes, edge_counts = census_table(result.labels, graph)
+            arrays = {
+                "src": self.src,
+                "dst": self.dst,
+                "labels": self.labels,
+                "cc_labels": self.cc_labels,
+                "lof": self.lof,
+                "census_present": np.asarray(present),
+                "census_sizes": np.asarray(sizes),
+                "census_edges": np.asarray(edge_counts),
+            }
+            if self._centers is not None:
+                arrays["lof_centers"] = np.asarray(self._centers, np.float32)
+            snap = self.store.publish(
+                arrays,
+                fingerprint=graph_fingerprint(self.src, self.dst),
+                run_id=self.snapshot.meta.get("run_id", ""),
+                mesh_shape=[self.num_shards],
+                sink=self.sink,
+            )
+            self.snapshot = snap
+            if self.sink is not None:
+                self.sink.emit(
+                    "delta_apply",
+                    inserts=stats["inserted"],
+                    deletes=stats["deleted"],
+                    method=result.method,
+                    iterations=result.iterations,
+                    quarantine=quarantine,
+                    affected=len(aff),
+                    version=snap.version,
+                    num_vertices=v2,
+                    num_edges=len(self.src),
+                    seconds=round(time.perf_counter() - t0, 4),
+                    # stage split: the repair-vs-recompute comparison the
+                    # bench serve tier reports is the repair term; LOF
+                    # refresh amortizes (full bootstrap only on the first
+                    # apply of an ingestor's lifetime)
+                    repair_seconds=round(repair_seconds, 4),
+                    lof_seconds=round(lof_seconds, 4),
+                )
+        return snap
+
+
+def _null_ctx():
+    import contextlib
+
+    return contextlib.nullcontext()

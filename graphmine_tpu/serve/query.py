@@ -1,0 +1,201 @@
+"""Batched query engine over a loaded result snapshot.
+
+Serving reads are the inverse shape of the batch pipeline: millions of
+tiny lookups instead of one huge propagation. Every query here is O(1) /
+O(log n) against indexes built ONCE at snapshot load ("Making Caches
+Work for Graph Analytics" locality argument — pay the sort/CSR
+construction once, then every lookup is a contiguous slice):
+
+- ``membership`` / ``score`` / ``community_size`` / ``community_decile``:
+  one array index;
+- ``neighbors``: one CSR row slice (the message CSR rebuilt host-side
+  from the snapshot's edge arrays);
+- ``top_outliers(community, k)``: one binary search + a k-slice of the
+  (label asc, LOF desc)-sorted vertex order;
+- ``query_batch``: the vectorized path — a whole vector of vertex ids
+  resolves in ONE device gather over a stacked ``[3, V]`` int table (+
+  one for the float LOF column), jitted once per engine.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from graphmine_tpu.serve.snapshot import Snapshot
+
+
+class QueryEngine:
+    """Immutable per-snapshot read index. Thread-safe by construction
+    (nothing mutates after ``__init__``), which is what lets the server
+    double-buffer: in-flight requests keep serving the engine they
+    grabbed while a delta publish swaps the reference under them."""
+
+    def __init__(self, snapshot: Snapshot, device: bool = True):
+        self.snapshot = snapshot
+        self.labels = np.asarray(snapshot["labels"], np.int32)
+        v = len(self.labels)
+        self.num_vertices = v
+        self.cc_labels = np.asarray(
+            snapshot.get("cc_labels", self.labels), np.int32
+        )
+        lof = snapshot.get("lof")
+        self.lof = (
+            np.zeros(v, np.float32) if lof is None
+            else np.asarray(lof, np.float32)
+        )
+
+        # neighbors: the message CSR over the snapshot's edge arrays
+        # (both directions, multiplicity kept — the same adjacency LPA
+        # propagated over). Host-side; one O(E) build per load.
+        from graphmine_tpu.graph.container import build_graph
+
+        g = build_graph(
+            np.asarray(snapshot["src"], np.int32),
+            np.asarray(snapshot["dst"], np.int32),
+            num_vertices=v, to_device=False,
+        )
+        self._nbr_ptr = np.asarray(g.msg_ptr)
+        self._nbr = np.asarray(g.msg_send)
+
+        # community census: sizes per present community + size deciles
+        if "census_sizes" in snapshot.arrays:
+            self._present = np.asarray(snapshot["census_present"], np.int64)
+            self._sizes = np.asarray(snapshot["census_sizes"], np.int64)
+        else:
+            counts = np.bincount(self.labels, minlength=v)
+            self._present = np.flatnonzero(counts).astype(np.int64)
+            self._sizes = counts[self._present].astype(np.int64)
+        size_of = np.zeros(v, np.int64)
+        size_of[self._present] = self._sizes
+        self._size_by_vertex = size_of[self.labels].astype(np.int32)
+        self._sizes_sorted = np.sort(self._sizes)
+
+        # top-k outliers per community: vertices sorted (label asc, LOF
+        # desc) once; each community is then one contiguous block whose
+        # start binary-searches in O(log C).
+        order = np.lexsort((-self.lof, self.labels))
+        self._by_comm = order.astype(np.int64)
+        sorted_labels = self.labels[order].astype(np.int64)
+        self._block_labels, self._block_starts = np.unique(
+            sorted_labels, return_index=True
+        )
+
+        self._dev = None
+        if device:
+            import jax.numpy as jnp
+
+            self._dev = (
+                jnp.stack([
+                    jnp.asarray(self.labels),
+                    jnp.asarray(self.cc_labels),
+                    jnp.asarray(self._size_by_vertex),
+                ]),
+                jnp.asarray(self.lof),
+            )
+
+    @property
+    def version(self) -> int:
+        return self.snapshot.version
+
+    # -- single lookups ----------------------------------------------------
+    def _check(self, vertex: int) -> int:
+        vertex = int(vertex)
+        if not 0 <= vertex < self.num_vertices:
+            raise KeyError(
+                f"vertex {vertex} not in [0, {self.num_vertices})"
+            )
+        return vertex
+
+    def membership(self, vertex: int) -> int:
+        """Community label of one vertex."""
+        return int(self.labels[self._check(vertex)])
+
+    def component(self, vertex: int) -> int:
+        """Weakly-connected-component label of one vertex."""
+        return int(self.cc_labels[self._check(vertex)])
+
+    def score(self, vertex: int) -> float:
+        """LOF outlier score of one vertex (higher = more outlying)."""
+        return float(self.lof[self._check(vertex)])
+
+    def community_size(self, vertex: int) -> int:
+        """Vertex count of the community ``vertex`` belongs to."""
+        return int(self._size_by_vertex[self._check(vertex)])
+
+    def community_decile(self, vertex: int) -> int:
+        """Size decile (0-9) of the vertex's community among all present
+        communities — 0 = smallest tenth (the recursive-LPA outlier
+        criterion's bottom decile), 9 = largest."""
+        size = self._size_by_vertex[self._check(vertex)]
+        n = len(self._sizes_sorted)
+        if not n:
+            return 0
+        rank = int(np.searchsorted(self._sizes_sorted, size, side="right"))
+        return min(9, 10 * (rank - 1) // n)
+
+    def neighbors(self, vertex: int) -> np.ndarray:
+        """Message-neighbor ids of one vertex (both edge directions,
+        multiplicity kept) — one CSR row slice."""
+        vertex = self._check(vertex)
+        return self._nbr[self._nbr_ptr[vertex]: self._nbr_ptr[vertex + 1]]
+
+    def top_outliers(self, community: int, k: int = 10):
+        """Top-``k`` LOF outliers of one community:
+        ``[(vertex, score), ...]`` descending. O(log C) block lookup +
+        an O(k) slice."""
+        i = np.searchsorted(self._block_labels, int(community))
+        if i >= len(self._block_labels) or self._block_labels[i] != community:
+            raise KeyError(f"community {community} has no members")
+        start = self._block_starts[i]
+        end = (
+            self._block_starts[i + 1] if i + 1 < len(self._block_starts)
+            else len(self._by_comm)
+        )
+        block = self._by_comm[start: min(end, start + max(int(k), 0))]
+        return [(int(vtx), float(self.lof[vtx])) for vtx in block]
+
+    # -- batched path ------------------------------------------------------
+    def query_batch(self, vertices) -> dict:
+        """Resolve a vector of vertex ids in one device gather.
+
+        Returns ``{"vertex", "label", "component", "community_size",
+        "lof"}`` as aligned arrays. Out-of-range ids raise (the HTTP
+        layer turns that into a 400, never a wrong answer).
+        """
+        ids = np.asarray(vertices, np.int64).reshape(-1)
+        if len(ids) and (ids.min() < 0 or ids.max() >= self.num_vertices):
+            bad = ids[(ids < 0) | (ids >= self.num_vertices)]
+            raise KeyError(
+                f"{len(bad)} vertex id(s) not in [0, {self.num_vertices}): "
+                f"{bad[:5].tolist()}..."
+            )
+        if self._dev is not None:
+            ints, lof = _gather(self._dev[0], self._dev[1], ids)
+            ints = np.asarray(ints)
+            lof = np.asarray(lof)
+        else:
+            table = np.stack(
+                [self.labels, self.cc_labels, self._size_by_vertex]
+            )
+            ints, lof = table[:, ids], self.lof[ids]
+        return {
+            "vertex": ids,
+            "label": ints[0],
+            "component": ints[1],
+            "community_size": ints[2],
+            "lof": lof,
+        }
+
+
+def _gather(int_table, lof, ids):
+    global _gather_jit
+    if _gather_jit is None:
+        import jax
+
+        _gather_jit = jax.jit(
+            lambda t, s, i: (t[:, i], s[i])
+        )
+    return _gather_jit(int_table, lof, np.asarray(ids, np.int32))
+
+
+_gather_jit = None

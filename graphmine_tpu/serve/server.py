@@ -1,0 +1,295 @@
+"""Stdlib HTTP front end: JSON queries over double-buffered snapshots.
+
+One :class:`SnapshotServer` owns a snapshot store, serves lookups from an
+immutable :class:`~graphmine_tpu.serve.query.QueryEngine`, and accepts
+delta batches. Publishes are **double-buffered**: a delta builds the next
+engine off to the side and swaps it in with one reference assignment —
+in-flight requests keep the engine they grabbed at entry, so a publish
+never drops or torn-reads a live query (pinned by
+``tests/test_serve.py::test_server_swap_under_live_queries``).
+
+Endpoints (all JSON):
+
+====================  =====================================================
+``GET  /healthz``      liveness + current snapshot version
+``GET  /snapshot``     current snapshot manifest metadata
+``GET  /vertex?v=``    one vertex: label, component, LOF, size, decile
+``GET  /neighbors?v=`` neighbor ids of one vertex
+``GET  /topk?community=&k=``  top-k LOF outliers of one community
+``POST /query``        ``{"vertices": [...]}`` — the batched gather path
+``POST /delta``        ``{"insert": [[s,d],...], "delete": [[s,d],...]}``
+``POST /reload``       reload the store's newest snapshot and swap
+====================  =====================================================
+
+Observability: every batch resolve emits a ``query_batch`` record, every
+delta a ``delta_apply`` (from the ingestor) and the store a
+``snapshot_publish`` — all span-stamped through the sink's tracer and
+rendered by ``tools/obs_report.py``; the counter/gauge registry exports
+through the existing Prometheus textfile path (``prom_out``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from graphmine_tpu.serve.delta import DeltaIngestor, EdgeDelta
+from graphmine_tpu.serve.query import QueryEngine
+from graphmine_tpu.serve.snapshot import SnapshotStore
+
+
+def _jsonable(obj):
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    return obj
+
+
+class SnapshotServer:
+    """Query server + delta ingest endpoint over one snapshot store."""
+
+    def __init__(
+        self,
+        store: SnapshotStore,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        sink=None,
+        prom_out: str | None = None,
+        num_shards: int = 1,
+    ):
+        self.store = store
+        self.sink = sink
+        self.prom_out = prom_out
+        self.num_shards = num_shards
+        snap = store.load(sink=sink)
+        if snap is None:
+            raise ValueError(
+                f"snapshot store at {store.root!r} is empty; publish one "
+                "first (pipeline --snapshot-out or serve_cli publish)"
+            )
+        # The double buffer: _engine is replaced atomically (one reference
+        # assignment); handlers bind it to a local once per request.
+        self._engine = QueryEngine(snap)
+        self._ingestor: DeltaIngestor | None = None
+        # One publisher at a time — the store's generation rotation (and
+        # the ingestor's host state) assume it.
+        self._delta_lock = threading.Lock()
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self._host, self._port = host, port
+        self._export_metrics()
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> tuple[str, int]:
+        """Bind and serve on a daemon thread; returns (host, port)."""
+        server = self
+
+        class Handler(_Handler):
+            srv = server
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="graphmine-serve",
+            daemon=True,
+        )
+        self._thread.start()
+        return self._httpd.server_address[:2]
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    # -- snapshot swap ----------------------------------------------------
+    @property
+    def engine(self) -> QueryEngine:
+        return self._engine
+
+    def _swap(self, engine: QueryEngine) -> None:
+        self._engine = engine  # atomic ref swap: the double-buffer flip
+        self._export_metrics()
+
+    def _export_metrics(self) -> None:
+        if self.sink is None:
+            return
+        self.sink.registry.gauge(
+            "graphmine_serve_snapshot_version",
+            "snapshot version currently serving queries",
+        ).set(self._engine.version)
+        if self.prom_out:
+            try:
+                self.sink.registry.write_textfile(self.prom_out)
+            except OSError:
+                pass  # metrics export must never take queries down
+
+    def reload(self) -> dict:
+        """Load the store's newest snapshot; swap if it is newer than the
+        one serving (another process may have published). Serialized with
+        delta ingest, and a swap drops the ingestor: its host edge/label
+        state derives from the snapshot it last published, and applying a
+        delta on top of the STALE state would silently discard the
+        externally published snapshot's edges (its next publish would
+        still chain version numbers from the store's manifest)."""
+        with self._delta_lock:
+            snap = self.store.load(sink=self.sink)
+            swapped = snap is not None and snap.version != self._engine.version
+            if swapped:
+                self._swap(QueryEngine(snap))
+                self._ingestor = None
+            return {"version": self._engine.version, "swapped": swapped}
+
+    def apply_delta(self, payload: dict) -> dict:
+        """Ingest one delta batch (the POST /delta body) and swap the
+        fresh snapshot in under live queries."""
+        delta = EdgeDelta.from_pairs(
+            insert=payload.get("insert", ()), delete=payload.get("delete", ())
+        )
+        with self._delta_lock:
+            if self._ingestor is None:
+                self._ingestor = DeltaIngestor(
+                    self.store, sink=self.sink, num_shards=self.num_shards,
+                    snapshot=self._engine.snapshot,
+                )
+            snap = self._ingestor.apply(delta)
+            self._swap(QueryEngine(snap))
+        if self.sink is not None:
+            self.sink.registry.counter(
+                "graphmine_serve_deltas_total", "delta batches ingested"
+            ).inc()
+        return {
+            "version": snap.version,
+            "snapshot_id": snap.snapshot_id,
+            "num_vertices": int(len(snap["labels"])),
+            "num_edges": int(len(snap["src"])),
+        }
+
+    # -- query plumbing (shared with serve_cli's in-process mode) ---------
+    def vertex_row(self, engine: QueryEngine, v: int) -> dict:
+        return {
+            "vertex": int(v),
+            "label": engine.membership(v),
+            "component": engine.component(v),
+            "lof": engine.score(v),
+            "community_size": engine.community_size(v),
+            "community_decile": engine.community_decile(v),
+        }
+
+    def record_batch(self, endpoint: str, n: int, seconds: float) -> None:
+        if self.sink is None:
+            return
+        self.sink.emit(
+            "query_batch", endpoint=endpoint, n=int(n),
+            seconds=round(seconds, 6),
+        )
+        self.sink.registry.counter(
+            "graphmine_serve_queries_total", "vertex lookups served"
+        ).inc(n)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    srv: SnapshotServer  # bound by SnapshotServer.start
+
+    # stdlib default logs every request to stderr; the metrics stream is
+    # the intended record of serving traffic.
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+    def _reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(_jsonable(payload)).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, message: str) -> None:
+        self._reply(code, {"error": message})
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        if length <= 0:
+            return {}
+        data = json.loads(self.rfile.read(length).decode())
+        if not isinstance(data, dict):
+            raise ValueError("request body must be a JSON object")
+        return data
+
+    def do_GET(self) -> None:  # noqa: N802
+        url = urlparse(self.path)
+        qs = parse_qs(url.query)
+        # One engine ref for the whole request: a concurrent snapshot
+        # swap must not mix two versions inside one response.
+        eng = self.srv.engine
+        t0 = time.perf_counter()
+        try:
+            if url.path == "/healthz":
+                self._reply(200, {
+                    "ok": True,
+                    "version": eng.version,
+                    "snapshot_id": eng.snapshot.snapshot_id,
+                    "num_vertices": eng.num_vertices,
+                })
+            elif url.path == "/snapshot":
+                self._reply(200, eng.snapshot.meta)
+            elif url.path == "/vertex":
+                v = int(qs["v"][0])
+                row = self.srv.vertex_row(eng, v)
+                self.srv.record_batch("vertex", 1, time.perf_counter() - t0)
+                self._reply(200, row)
+            elif url.path == "/neighbors":
+                v = int(qs["v"][0])
+                nbrs = eng.neighbors(v)
+                self.srv.record_batch("neighbors", 1, time.perf_counter() - t0)
+                self._reply(200, {"vertex": v, "neighbors": nbrs})
+            elif url.path == "/topk":
+                community = int(qs["community"][0])
+                k = int(qs.get("k", ["10"])[0])
+                top = eng.top_outliers(community, k)
+                self.srv.record_batch("topk", len(top), time.perf_counter() - t0)
+                self._reply(200, {
+                    "community": community,
+                    "top": [{"vertex": v, "lof": s} for v, s in top],
+                })
+            else:
+                self._error(404, f"unknown path {url.path!r}")
+        except (KeyError, ValueError, IndexError) as e:
+            # KeyError.__str__ repr-quotes its message; unwrap it
+            self._error(400, str(e.args[0]) if e.args else str(e))
+
+    def do_POST(self) -> None:  # noqa: N802
+        url = urlparse(self.path)
+        eng = self.srv.engine
+        t0 = time.perf_counter()
+        try:
+            if url.path == "/query":
+                body = self._body()
+                out = eng.query_batch(body.get("vertices", []))
+                self.srv.record_batch(
+                    "query", len(out["vertex"]), time.perf_counter() - t0
+                )
+                self._reply(200, {**out, "version": eng.version})
+            elif url.path == "/delta":
+                self._reply(200, self.srv.apply_delta(self._body()))
+            elif url.path == "/reload":
+                self._reply(200, self.srv.reload())
+            else:
+                self._error(404, f"unknown path {url.path!r}")
+        except (KeyError, ValueError, IndexError) as e:
+            # KeyError.__str__ repr-quotes its message; unwrap it
+            self._error(400, str(e.args[0]) if e.args else str(e))

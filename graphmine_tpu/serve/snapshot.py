@@ -1,0 +1,350 @@
+"""Versioned result snapshots — the serving layer's durable artifact.
+
+A *snapshot* is the published output of one pipeline run or one delta
+repair: community labels, CC labels, LOF scores, the community census,
+and the edge arrays the query engine needs for neighbor lookups, plus
+provenance (run_id, parent snapshot, graph fingerprint, mesh shape).
+
+The on-disk format is the checkpoint manifest pattern
+(``pipeline/checkpoint.py``) applied to pipeline outputs: per-array
+``.npy`` files + a JSON manifest with per-file sha256 and a whole-manifest
+checksum, written into a tmp generation directory (every file fsync'd,
+manifest last) and published by ONE directory rename after rotating the
+previous generation to ``*.prev`` — a kill at any point leaves the old or
+the new snapshot fully intact, never a torn mix. Loads verify every hash,
+roll back to ``.prev`` on corruption (condemned generation preserved at
+``*.corrupt``), and refuse a wrong graph fingerprint WITHOUT rollback
+(every generation of that store indexes the same wrong graph). The
+rollback state machine is literally shared with the checkpoint formats
+(:func:`~graphmine_tpu.pipeline.checkpoint._load_with_rollback`).
+
+Versioning: each publish increments a monotonic ``version`` counter and
+records its parent's ``snapshot_id`` — the provenance chain a delta
+repair extends (docs/SERVING.md "snapshot format").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from graphmine_tpu.pipeline import resilience
+from graphmine_tpu.pipeline.checkpoint import (
+    CheckpointCorruptionError,
+    FingerprintMismatch,
+    _CORRUPTION_ERRORS,
+    _file_sha256,
+    _fsync_dir,
+    _fsync_file,
+    _load_with_rollback,
+    _manifest_checksum,
+    _tree_bytes,
+)
+
+MANIFEST_NAME = "manifest.json"
+_FORMAT_VERSION = 1
+# Array names become file names; keep them boring so a hostile/typo'd
+# name can never escape the generation directory.
+_NAME_RE = re.compile(r"^[A-Za-z0-9_][A-Za-z0-9_.-]*$")
+
+# The standard array set the driver publishes and the query engine reads.
+# publish() accepts any dict (the format is name-agnostic); these names
+# are the serving contract documented in docs/SERVING.md.
+STANDARD_ARRAYS = (
+    "src", "dst", "labels", "cc_labels", "lof",
+    "census_present", "census_sizes", "census_edges",
+)
+
+
+@dataclass(frozen=True)
+class Snapshot:
+    """One loaded snapshot generation: arrays + manifest metadata."""
+
+    arrays: dict                # name -> np.ndarray
+    meta: dict                  # manifest body minus per-file hashes
+    path: str = ""              # generation dir it was loaded from
+
+    @property
+    def version(self) -> int:
+        return int(self.meta["version"])
+
+    @property
+    def snapshot_id(self) -> str:
+        return self.meta["snapshot_id"]
+
+    @property
+    def parent(self) -> str:
+        return self.meta.get("parent", "")
+
+    @property
+    def fingerprint(self) -> str:
+        return self.meta.get("fingerprint", "")
+
+    @property
+    def num_vertices(self) -> int:
+        return int(self.meta.get("num_vertices", 0))
+
+    @property
+    def num_edges(self) -> int:
+        return int(self.meta.get("num_edges", 0))
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        return self.arrays[name]
+
+    def get(self, name: str, default=None):
+        return self.arrays.get(name, default)
+
+
+class SnapshotStore:
+    """Two-generation versioned snapshot store rooted at one directory.
+
+    ``publish`` is safe against kills at any point (see module docstring);
+    ``load`` returns the newest intact generation. One publisher per root
+    is the concurrency contract (same as the checkpoint generation
+    rotation); any number of concurrent readers may load.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+
+    # -- paths ------------------------------------------------------------
+    def _gen(self) -> str:
+        return os.path.join(self.root, "snapshot")
+
+    def _prev(self) -> str:
+        return self._gen() + ".prev"
+
+    # -- publish ----------------------------------------------------------
+    def publish(
+        self,
+        arrays: dict,
+        fingerprint: str = "",
+        run_id: str = "",
+        mesh_shape=None,
+        extra_meta: dict | None = None,
+        sink=None,
+    ) -> Snapshot:
+        """Durably publish one snapshot generation; returns it as loaded.
+
+        ``fingerprint`` ties the snapshot to the exact edge arrays /
+        id assignment (``checkpoint.graph_fingerprint``); loads under a
+        different graph refuse. Version/parent chain continues from the
+        current generation (version 1 when the store is empty). ``sink``:
+        emits a ``snapshot_publish`` record (span-stamped, rendered by
+        ``tools/obs_report.py``).
+        """
+        t0 = time.perf_counter()
+        for name, arr in arrays.items():
+            if not _NAME_RE.match(name):
+                raise ValueError(f"unsafe snapshot array name {name!r}")
+            if not isinstance(arr, np.ndarray):
+                raise TypeError(
+                    f"snapshot arrays must be host numpy (got "
+                    f"{type(arr).__name__} for {name!r}); np.asarray() first"
+                )
+        parent_version, parent_id = 0, ""
+        peek = self._peek_manifest()
+        if peek is not None:
+            parent_version = int(peek.get("version", 0))
+            parent_id = peek.get("snapshot_id", "")
+        version = parent_version + 1
+        snapshot_id = f"{version:06d}-{os.urandom(4).hex()}"
+
+        os.makedirs(self.root, exist_ok=True)
+        gen = self._gen()
+        tmp = f"{gen}.tmp.{os.getpid()}"
+        # Sweep EVERY stale tmp generation (same rationale as
+        # checkpoint.save_sharded): each kill mid-publish leaves one
+        # behind, and restarted publishers never reuse the old pid.
+        import glob as _glob
+        import shutil
+
+        for stale in _glob.glob(gen + ".tmp.*"):
+            shutil.rmtree(stale, ignore_errors=True)
+        os.makedirs(tmp)
+
+        entries = {}
+        for name, arr in arrays.items():
+            fname = f"{name}.npy"
+            path = os.path.join(tmp, fname)
+            np.save(path, arr)
+            _fsync_file(path)
+            entries[name] = {
+                "file": fname,
+                "sha256": _file_sha256(path),
+                "dtype": str(arr.dtype),
+                "shape": list(arr.shape),
+            }
+
+        body = {
+            "format_version": _FORMAT_VERSION,
+            "version": version,
+            "snapshot_id": snapshot_id,
+            "parent": parent_id,
+            "run_id": run_id or "",
+            "fingerprint": fingerprint or "",
+            "mesh_shape": list(mesh_shape) if mesh_shape else [1],
+            "created": time.time(),
+            "arrays": entries,
+        }
+        if extra_meta:
+            overlap = set(extra_meta) & set(body)
+            if overlap:
+                raise ValueError(
+                    f"extra_meta may not shadow manifest keys {sorted(overlap)}"
+                )
+            body.update(extra_meta)
+        body["checksum"] = _manifest_checksum(body)
+        man_tmp = os.path.join(tmp, MANIFEST_NAME + ".tmp")
+        with open(man_tmp, "w") as f:
+            json.dump(body, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(man_tmp, os.path.join(tmp, MANIFEST_NAME))
+        _fsync_dir(tmp)
+
+        # Torn-publish seam: a fault/preemption injected HERE (every file
+        # written, nothing published) must leave the previous generation
+        # the loadable one — pinned by tests/test_serve.py.
+        resilience.fault_point(
+            "snapshot_publish_commit", version=version, tmp=tmp
+        )
+
+        prev = self._prev()
+        if os.path.exists(gen):
+            if os.path.exists(prev):
+                shutil.rmtree(prev)
+            os.replace(gen, prev)
+        os.replace(tmp, gen)
+        _fsync_dir(self.root)
+        if sink is not None:
+            sink.emit(
+                "snapshot_publish",
+                version=version,
+                snapshot_id=snapshot_id,
+                parent=parent_id,
+                path=gen,
+                bytes=_tree_bytes(gen),
+                arrays=sorted(arrays),
+                seconds=round(time.perf_counter() - t0, 4),
+            )
+        meta = {k: v for k, v in body.items() if k not in ("arrays", "checksum")}
+        return Snapshot(arrays=dict(arrays), meta=meta, path=gen)
+
+    # -- load -------------------------------------------------------------
+    def _peek_manifest(self) -> dict | None:
+        """Cheap current-generation manifest read (JSON only, no array
+        hashing); None = absent/unreadable (the full loader may still
+        recover via rollback)."""
+        try:
+            with open(os.path.join(self._gen(), MANIFEST_NAME)) as f:
+                return json.load(f)
+        except Exception:
+            return None
+
+    def peek_version(self) -> int | None:
+        peek = self._peek_manifest()
+        if peek is None:
+            return None
+        try:
+            return int(peek["version"])
+        except (KeyError, TypeError, ValueError):
+            return None
+
+    def _read_verified(self, gen_dir: str, fingerprint: str | None):
+        """Load one generation, verifying manifest checksum, every
+        array's sha256/dtype/shape, then the graph fingerprint. Raises a
+        :data:`_CORRUPTION_ERRORS` member on damaged bytes,
+        :class:`FingerprintMismatch` on a wrong-graph snapshot."""
+        man_path = os.path.join(gen_dir, MANIFEST_NAME)
+        try:
+            with open(man_path) as f:
+                body = json.load(f)
+        except json.JSONDecodeError as e:
+            raise CheckpointCorruptionError(
+                f"snapshot manifest at {man_path} is not valid JSON ({e})"
+            ) from e
+        want = body.get("checksum", "")
+        got = _manifest_checksum(body)
+        if want != got:
+            raise CheckpointCorruptionError(
+                f"snapshot manifest at {man_path} failed its checksum "
+                f"({got[:12]}... != recorded {want[:12]}...)"
+            )
+        saved_fp = body.get("fingerprint", "")
+        if fingerprint and saved_fp and fingerprint != saved_fp:
+            raise FingerprintMismatch(
+                f"snapshot at {gen_dir} was published for a different graph "
+                f"or vertex-id assignment (fingerprint {saved_fp[:12]}... != "
+                f"{fingerprint[:12]}...); republish from the current graph "
+                "or query the snapshot it was built from"
+            )
+        arrays = {}
+        for name, ent in body.get("arrays", {}).items():
+            path = os.path.join(gen_dir, ent["file"])
+            sha = _file_sha256(path)
+            if sha != ent["sha256"]:
+                raise CheckpointCorruptionError(
+                    f"snapshot array {name!r} at {path} failed its sha256 "
+                    f"({sha[:12]}... != manifest {ent['sha256'][:12]}...)"
+                )
+            arr = np.load(path)
+            if list(arr.shape) != ent["shape"] or str(arr.dtype) != ent["dtype"]:
+                raise CheckpointCorruptionError(
+                    f"snapshot array {name!r} at {path} is "
+                    f"{arr.dtype}{list(arr.shape)}, manifest says "
+                    f"{ent['dtype']}{ent['shape']}"
+                )
+            arrays[name] = arr
+        meta = {k: v for k, v in body.items() if k not in ("arrays", "checksum")}
+        snap = Snapshot(arrays=arrays, meta=meta, path=gen_dir)
+        # (snapshot, version) so the shared rollback state machine — whose
+        # contract is (payload, generation-counter) tuples — applies as-is.
+        return snap, snap.version
+
+    def _read_confirmed(self, gen_dir: str, fingerprint: str | None):
+        """One confirming re-read before a corruption verdict — the same
+        transient-I/O-weather rationale as the checkpoint readers."""
+        try:
+            return self._read_verified(gen_dir, fingerprint)
+        except FingerprintMismatch:
+            raise
+        except _CORRUPTION_ERRORS as first:
+            try:
+                return self._read_verified(gen_dir, fingerprint)
+            except FingerprintMismatch:
+                raise
+            except _CORRUPTION_ERRORS:
+                raise first
+
+    def load(self, fingerprint: str | None = None, sink=None) -> Snapshot | None:
+        """Newest intact snapshot, or None when the store is empty.
+
+        A corrupt current generation rolls back to ``.prev`` (promoted to
+        the current slot, the condemned directory preserved at
+        ``*.corrupt`` — ``checkpoint_rollback`` records through ``sink``);
+        a wrong ``fingerprint`` raises :class:`FingerprintMismatch`
+        without rollback. ``sink`` also gets a ``snapshot_load`` record.
+        """
+        t0 = time.perf_counter()
+        out = _load_with_rollback(
+            self._gen(), self._prev(),
+            lambda p: self._read_confirmed(p, fingerprint),
+            sink, "snapshot",
+            f"delete {self._gen()!r} (and its .prev) and republish",
+        )
+        if out is None:
+            return None
+        snap, version = out
+        if sink is not None:
+            sink.emit(
+                "snapshot_load", version=int(version), path=snap.path,
+                snapshot_id=snap.snapshot_id,
+                seconds=round(time.perf_counter() - t0, 4),
+            )
+        return snap

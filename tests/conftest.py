@@ -79,6 +79,14 @@ def pytest_configure(config):
         "the default CPU pass — select with -m ann or "
         "tools/run_tier1.sh --ann-only",
     )
+    config.addinivalue_line(
+        "markers",
+        "serve: serving-layer suite (tests/test_serve.py: versioned "
+        "snapshots, delta ingest + warm-start repair equivalence, the "
+        "batched query engine, live-swap HTTP server); runs in the "
+        "default CPU pass — select with -m serve or "
+        "tools/run_tier1.sh --serve-only",
+    )
     if not (_needs_reexec() and _invoked_as_pytest_cli()):
         return
     cap = config.pluginmanager.getplugin("capturemanager")

@@ -1,0 +1,659 @@
+"""Serving-layer suite (marker ``serve``): versioned snapshots, delta
+ingest with warm-start repair, the batched query engine and the HTTP
+front end — tools/run_tier1.sh --serve-only.
+
+The acceptance pins (ISSUE 5):
+- snapshot round-trip is byte-identical; a mismatched graph fingerprint
+  refuses; a kill mid-publish leaves the previous snapshot loadable and
+  a corrupt generation rolls back to ``.prev``;
+- warm-start repair labels are IDENTICAL to a cold full recompute for
+  insert-only, delete-only and mixed delta batches, and the tripwire
+  fallback path is exercised by fault injection;
+- a live query server swaps to a newly published snapshot without
+  dropping in-flight queries;
+- ``query_batch`` / ``delta_apply`` / ``snapshot_publish`` records are
+  schema-registered, span-joined and rendered by tools/obs_report.py.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from graphmine_tpu.graph.container import build_graph
+from graphmine_tpu.obs.schema import validate_records
+from graphmine_tpu.obs.spans import Tracer
+from graphmine_tpu.pipeline.checkpoint import (
+    CheckpointCorruptionError,
+    FingerprintMismatch,
+    graph_fingerprint,
+)
+from graphmine_tpu.pipeline.metrics import MetricsSink
+from graphmine_tpu.serve import (
+    DeltaIngestor,
+    EdgeDelta,
+    QueryEngine,
+    SnapshotStore,
+)
+from graphmine_tpu.serve.delta import (
+    cold_recompute,
+    frontier_budget,
+    repair_labels,
+    splice_edges,
+    validate_delta,
+)
+from graphmine_tpu.testing import faults
+
+pytestmark = pytest.mark.serve
+
+
+# ---- fixtures -------------------------------------------------------------
+
+
+def _clique(lo, hi):
+    ids = np.arange(lo, hi)
+    s, d = np.meshgrid(ids, ids)
+    m = s.ravel() < d.ravel()
+    return s.ravel()[m], d.ravel()[m]
+
+
+def _community_graph(extra=()):
+    """Three well-separated cliques (LPA converges to one fixpoint from
+    any init — what makes warm-vs-cold equality decidable) plus optional
+    extra edges."""
+    parts = [_clique(0, 12), _clique(12, 26), _clique(26, 40)]
+    src = np.concatenate([p[0] for p in parts] + [np.asarray([e[0] for e in extra], np.int64)])
+    dst = np.concatenate([p[1] for p in parts] + [np.asarray([e[1] for e in extra], np.int64)])
+    return src.astype(np.int32), dst.astype(np.int32), 40
+
+
+def _sink():
+    return MetricsSink(tracer=Tracer())
+
+
+def _publish_base(tmp_path, src, dst, v, sink=None):
+    g = build_graph(src, dst, num_vertices=v)
+    labels, cc, _ = cold_recompute(g)
+    store = SnapshotStore(str(tmp_path / "snap"))
+    store.publish(
+        {
+            "src": src, "dst": dst, "labels": labels, "cc_labels": cc,
+            "lof": np.linspace(0.5, 2.5, v).astype(np.float32),
+        },
+        fingerprint=graph_fingerprint(src, dst),
+        sink=sink,
+    )
+    return store, g, labels, cc
+
+
+# ---- snapshot store -------------------------------------------------------
+
+
+def test_snapshot_roundtrip_byte_identical(tmp_path):
+    src, dst, v = _community_graph()
+    sink = _sink()
+    store, g, labels, cc = _publish_base(tmp_path, src, dst, v, sink=sink)
+    snap = store.load(fingerprint=graph_fingerprint(src, dst), sink=sink)
+    assert snap.version == 1 and snap.parent == ""
+    for name, want in (("src", src), ("dst", dst), ("labels", labels),
+                       ("cc_labels", cc)):
+        got = snap[name]
+        assert got.dtype == want.dtype
+        assert got.tobytes() == np.asarray(want).tobytes()
+    # second publish continues the version/parent chain
+    snap2 = store.publish(
+        dict(snap.arrays), fingerprint=snap.fingerprint, sink=sink
+    )
+    assert snap2.version == 2
+    assert snap2.parent == snap.snapshot_id
+    assert validate_records(sink.records) == []
+
+
+def test_snapshot_fingerprint_refusal(tmp_path):
+    src, dst, v = _community_graph()
+    store, *_ = _publish_base(tmp_path, src, dst, v)
+    other = graph_fingerprint(dst, src)  # permuted graph: different identity
+    with pytest.raises(FingerprintMismatch, match="different graph"):
+        store.load(fingerprint=other)
+    # no rollback happened: the real fingerprint still loads generation 1
+    assert store.load(fingerprint=graph_fingerprint(src, dst)).version == 1
+
+
+def test_torn_publish_leaves_previous_loadable(tmp_path):
+    """A kill between writing the tmp generation and the publish rename
+    (the snapshot_publish_commit fault seam) must leave the previous
+    snapshot the loadable one — and the next publish must succeed."""
+    src, dst, v = _community_graph()
+    store, g, labels, cc = _publish_base(tmp_path, src, dst, v)
+    arrays = dict(store.load().arrays)
+    inj = faults.FaultInjector()
+    inj.add("snapshot_publish_commit", faults.preemption)
+    with inj.installed():
+        with pytest.raises(faults.SimulatedPreemption):
+            store.publish(arrays, fingerprint=graph_fingerprint(src, dst))
+    assert inj.fired("snapshot_publish_commit") == 1
+    snap = store.load()
+    assert snap.version == 1  # the survivor is the previous generation
+    # the orphaned tmp generation is swept by the next publish, which lands
+    snap2 = store.publish(arrays, fingerprint=graph_fingerprint(src, dst))
+    assert snap2.version == 2
+    assert not [
+        p for p in os.listdir(store.root) if ".tmp." in p
+    ], "stale tmp generations must be swept"
+
+
+def test_corrupt_generation_rolls_back_to_prev(tmp_path):
+    src, dst, v = _community_graph()
+    sink = _sink()
+    store, *_ = _publish_base(tmp_path, src, dst, v, sink=sink)
+    snap1 = store.load()
+    store.publish(dict(snap1.arrays), fingerprint=snap1.fingerprint)
+    # damage one array of the CURRENT generation; load must roll back to
+    # the rotated .prev and keep serving
+    faults.corrupt_file(os.path.join(store._gen(), "labels.npy"))
+    snap = store.load(sink=sink)
+    assert snap is not None and snap.version == 1
+    assert [r["phase"] for r in sink.records if "rollback" in r["phase"]] == [
+        "checkpoint_rollback", "checkpoint_rollback_ok"
+    ]
+    # condemned generation preserved for forensics
+    assert any(".corrupt" in p for p in os.listdir(store.root))
+    # both generations damaged -> loud, names the files tried
+    faults.corrupt_file(os.path.join(store._gen(), "labels.npy"))
+    with pytest.raises(CheckpointCorruptionError):
+        store.load()
+
+
+# ---- delta validation / splice --------------------------------------------
+
+
+def test_validate_delta_quarantines_bad_rows():
+    delta = EdgeDelta.from_pairs(
+        insert=[(1, 2), (-3, 4), (10**9, 2)],
+        delete=[(0, 1), (999, 0), (-1, -1)],
+    )
+    clean, q = validate_delta(delta, num_vertices=40)
+    assert clean.num_inserts == 1 and clean.num_deletes == 1
+    assert q == {"out_of_range_ids": 2, "unmatched_deletes": 2}
+
+
+def test_splice_multiset_delete():
+    src = np.asarray([0, 0, 0, 1], np.int32)
+    dst = np.asarray([1, 1, 1, 2], np.int32)
+    delta = EdgeDelta.from_pairs(delete=[(0, 1), (0, 1), (5, 5)])
+    src2, dst2, v2, stats = splice_edges(src, dst, 3, delta)
+    # exactly two of the three (0,1) occurrences removed; (5,5) unmatched
+    assert list(zip(src2.tolist(), dst2.tolist())) == [(0, 1), (1, 2)]
+    assert stats == {"inserted": 0, "deleted": 2, "unmatched_deletes": 1}
+    assert v2 == 3
+
+
+def test_splice_insert_grows_vertex_space():
+    src = np.asarray([0], np.int32)
+    dst = np.asarray([1], np.int32)
+    src2, dst2, v2, stats = splice_edges(
+        src, dst, 2, EdgeDelta.from_pairs(insert=[(5, 1)])
+    )
+    assert v2 == 6 and stats["inserted"] == 1
+    assert (src2.tolist(), dst2.tolist()) == ([0, 5], [1, 1])
+
+
+# ---- warm-start repair equivalence (the correctness gate) -----------------
+
+
+@pytest.mark.parametrize(
+    "insert,delete",
+    [
+        # insert-only: a new vertex joins clique 2, plus intra-clique fill
+        ([(40, 12), (40, 13), (40, 14), (0, 5)], []),
+        # delete-only: thin out clique 1 and cut clique 3 internally
+        ([], [(0, 1), (0, 2), (26, 27)]),
+        # mixed: grow one community while shrinking another
+        ([(40, 26), (40, 27), (40, 28)], [(12, 13), (12, 14)]),
+    ],
+    ids=["insert_only", "delete_only", "mixed"],
+)
+def test_repair_equals_cold_recompute(insert, delete):
+    src, dst, v = _community_graph()
+    g = build_graph(src, dst, num_vertices=v)
+    labels, cc, _ = cold_recompute(g)
+    delta, _ = validate_delta(EdgeDelta.from_pairs(insert, delete), v)
+    src2, dst2, v2, _ = splice_edges(src, dst, v, delta)
+    g2 = build_graph(src2, dst2, num_vertices=v2)
+    result = repair_labels(g2, labels, cc, delta)
+    assert result.method == "warm", result.fallback_reason
+    cold_l, cold_c, _ = cold_recompute(g2)
+    np.testing.assert_array_equal(result.labels, cold_l)
+    np.testing.assert_array_equal(result.cc_labels, cold_c)
+
+
+def test_cc_repair_exact_on_random_graph():
+    """CC repair is exact BY CONSTRUCTION (monotone min from valid upper
+    bounds) — pin it on an adversarial random graph where components
+    split and merge, not just cliques."""
+    rng = np.random.default_rng(3)
+    v, e = 300, 500
+    src = rng.integers(0, v, e).astype(np.int32)
+    dst = rng.integers(0, v, e).astype(np.int32)
+    g = build_graph(src, dst, num_vertices=v)
+    _, cc, _ = cold_recompute(g)
+    delta, _ = validate_delta(
+        EdgeDelta.from_pairs(
+            insert=[(int(a), int(b)) for a, b in
+                    zip(rng.integers(0, v, 20), rng.integers(0, v, 20))],
+            delete=[(int(s), int(d)) for s, d in
+                    zip(src[:25].tolist(), dst[:25].tolist())],
+        ),
+        v,
+    )
+    src2, dst2, v2, _ = splice_edges(src, dst, v, delta)
+    g2 = build_graph(src2, dst2, num_vertices=v2)
+    from graphmine_tpu.serve.delta import _warm_cc, cc_repair_init
+
+    repaired, _, conv = _warm_cc(
+        g2, cc_repair_init(cc, v2, delta), frontier_budget(v2, v2)
+    )
+    assert conv
+    from graphmine_tpu.ops.cc import connected_components
+
+    np.testing.assert_array_equal(
+        repaired, np.asarray(connected_components(g2))
+    )
+
+
+@pytest.mark.faults
+def test_repair_fallback_on_injected_corruption(tmp_path):
+    """The tripwire path: silent corruption of the repaired state (a
+    poison_labels-style mutator at the delta_repair seam) must be caught
+    by the sampled exact check, emit repair_fallback, and republish the
+    cold-recompute labels — never the garbage."""
+    src, dst, v = _community_graph()
+    sink = _sink()
+    store, g, labels, cc = _publish_base(tmp_path, src, dst, v, sink=sink)
+    ing = DeltaIngestor(store, sink=sink, lof_k=4, check_samples=16)
+    delta = EdgeDelta.from_pairs(insert=[(40, 12), (40, 13)])
+    inj = faults.FaultInjector()
+    inj.add("delta_repair", faults.poison_labels(shard=0, num_shards=1))
+    with inj.installed():
+        snap = ing.apply(delta)
+    assert inj.fired("delta_repair") == 1
+    fb = [r for r in sink.records if r["phase"] == "repair_fallback"]
+    assert len(fb) == 1 and "sampled exact check failed" in fb[0]["reason"]
+    rec = [r for r in sink.records if r["phase"] == "delta_apply"][-1]
+    assert rec["method"] == "full_recompute"
+    src2, dst2, v2, _ = splice_edges(src, dst, v, delta)
+    cold_l, cold_c, _ = cold_recompute(build_graph(src2, dst2, num_vertices=v2))
+    np.testing.assert_array_equal(snap["labels"], cold_l)
+    np.testing.assert_array_equal(snap["cc_labels"], cold_c)
+    assert validate_records(sink.records) == []
+
+
+def test_delta_chain_versions_and_lof(tmp_path):
+    """Consecutive deltas chain parent ids, keep LOF scores finite for
+    every vertex, and the streaming scorer reuses its state across
+    batches instead of retraining."""
+    src, dst, v = _community_graph()
+    sink = _sink()
+    store, *_ = _publish_base(tmp_path, src, dst, v, sink=sink)
+    ing = DeltaIngestor(store, sink=sink, lof_k=4, check_samples=8)
+    s1 = ing.apply(EdgeDelta.from_pairs(insert=[(40, 12), (40, 13)]))
+    s2 = ing.apply(EdgeDelta.from_pairs(delete=[(0, 1)]))
+    assert (s1.version, s2.version) == (2, 3)
+    assert s2.parent == s1.snapshot_id
+    assert np.isfinite(s2["lof"]).all() and len(s2["lof"]) == 41
+    applies = [r for r in sink.records if r["phase"] == "delta_apply"]
+    assert [r["method"] for r in applies] == ["warm", "warm"]
+    # span-joined: every serving record carries full trace identity
+    for r in applies:
+        assert {"run_id", "trace_id", "span_id", "span_path"} <= set(r)
+
+
+def test_weighted_snapshot_refused_by_ingestor(tmp_path):
+    """A weighted run's snapshot keeps its weights array; the delta path
+    must refuse it loudly — unweighted repair supersteps would silently
+    change weighted-LPA label semantics."""
+    src, dst, v = _community_graph()
+    g = build_graph(src, dst, num_vertices=v)
+    labels, cc, _ = cold_recompute(g)
+    store = SnapshotStore(str(tmp_path / "snap"))
+    store.publish(
+        {
+            "src": src, "dst": dst, "labels": labels, "cc_labels": cc,
+            "weights": np.ones(len(src), np.float32),
+        },
+        fingerprint=graph_fingerprint(src, dst),
+    )
+    with pytest.raises(ValueError, match="UNWEIGHTED"):
+        DeltaIngestor(store)
+
+
+def test_reload_rebases_ingestor_on_external_publish(tmp_path):
+    """An externally published snapshot + /reload must rebase the
+    server's delta path: a delta applied after the reload builds on the
+    external snapshot's edges, not the server's stale pre-reload state."""
+    from graphmine_tpu.serve.server import SnapshotServer
+
+    src, dst, v = _community_graph()
+    sink = _sink()
+    store, *_ = _publish_base(tmp_path, src, dst, v, sink=sink)
+    server = SnapshotServer(store, sink=sink)
+    host, port = server.start()
+    try:
+        # server-side delta #1 creates the (soon stale) ingestor @ v2
+        _post(host, port, "/delta", {"insert": [[40, 12], [40, 13]]})
+        # an EXTERNAL process publishes v3 with one more edge
+        ext = DeltaIngestor(store, sink=_sink(), lof_k=4, check_samples=8)
+        ext.apply(EdgeDelta.from_pairs(insert=[(41, 0), (41, 1)]))
+        out = _post(host, port, "/reload", {})
+        assert out == {"version": 3, "swapped": True}
+        # a post-reload delta must build on v3's edges (vertex 41 kept)
+        out = _post(host, port, "/delta", {"insert": [[41, 2]]})
+        assert out["version"] == 4
+        assert _get(host, port, "/vertex?v=41")["label"] == 0
+        nbrs = _get(host, port, "/neighbors?v=41")["neighbors"]
+        assert sorted(set(nbrs)) == [0, 1, 2]
+    finally:
+        server.stop()
+    assert validate_records(sink.records) == []
+
+
+# ---- sharded repair entry -------------------------------------------------
+
+
+def test_sharded_lpa_fixpoint_matches_single_device():
+    import jax.numpy as jnp
+
+    from graphmine_tpu.parallel.mesh import make_mesh
+    from graphmine_tpu.parallel.sharded import (
+        partition_graph,
+        shard_graph_arrays,
+        sharded_lpa_fixpoint,
+    )
+    from graphmine_tpu.serve.delta import _warm_lpa
+
+    src, dst, v = _community_graph(extra=[(0, 12), (5, 30)])
+    g = build_graph(src, dst, num_vertices=v)
+    init = np.arange(v, dtype=np.int32)
+    init[:12] = 0  # a warm (partially-converged) seed, not identity
+    mesh = make_mesh(8)
+    sg = shard_graph_arrays(partition_graph(g, mesh=mesh), mesh)
+    lbl_s, it_s, conv_s = sharded_lpa_fixpoint(
+        sg, mesh, max_iter=64, init_labels=jnp.asarray(init)
+    )
+    lbl_1, it_1, conv_1 = _warm_lpa(g, init, 64)
+    assert conv_s and conv_1 and it_s == it_1
+    np.testing.assert_array_equal(np.asarray(lbl_s), lbl_1)
+
+
+def test_sharded_lpa_fixpoint_budget_exhaustion():
+    """converged=False when the budget ends before quiescence — the
+    signal the serving layer's full-recompute fallback keys off."""
+    import jax.numpy as jnp
+
+    from graphmine_tpu.parallel.mesh import make_mesh
+    from graphmine_tpu.parallel.sharded import (
+        partition_graph,
+        shard_graph_arrays,
+        sharded_lpa_fixpoint,
+    )
+
+    src, dst, v = _community_graph()
+    g = build_graph(src, dst, num_vertices=v)
+    mesh = make_mesh(8)
+    sg = shard_graph_arrays(partition_graph(g, mesh=mesh), mesh)
+    _, it, conv = sharded_lpa_fixpoint(
+        sg, mesh, max_iter=1,
+        init_labels=jnp.asarray(np.arange(v, dtype=np.int32)),
+    )
+    assert it == 1 and not conv
+
+
+def test_sharded_ingestor_repair_matches_cold(tmp_path):
+    """DeltaIngestor(num_shards=8) routes repair through the sharded
+    entries (virtual mesh) — published labels identical to the cold
+    recompute, same as the single-device path."""
+    src, dst, v = _community_graph()
+    sink = _sink()
+    store, *_ = _publish_base(tmp_path, src, dst, v, sink=sink)
+    ing = DeltaIngestor(
+        store, sink=sink, lof_k=4, check_samples=16, num_shards=8
+    )
+    delta = EdgeDelta.from_pairs(
+        insert=[(40, 12), (40, 13), (40, 14)], delete=[(0, 1)]
+    )
+    snap = ing.apply(delta)
+    rec = [r for r in sink.records if r["phase"] == "delta_apply"][-1]
+    assert rec["method"] == "warm"
+    clean, _ = validate_delta(delta, v)
+    src2, dst2, v2, _ = splice_edges(src, dst, v, clean)
+    cold_l, cold_c, _ = cold_recompute(build_graph(src2, dst2, num_vertices=v2))
+    np.testing.assert_array_equal(snap["labels"], cold_l)
+    np.testing.assert_array_equal(snap["cc_labels"], cold_c)
+
+
+def test_streaming_lof_seeded_centers_skip_training():
+    from graphmine_tpu.ops.ann import default_n_clusters, kmeans
+    from graphmine_tpu.ops.streaming_lof import StreamingLOF
+
+    rng = np.random.default_rng(0)
+    capacity, f = 256, 4
+    pts = rng.normal(size=(capacity, f)).astype(np.float32)
+    centers = np.asarray(kmeans(pts, default_n_clusters(capacity), seed=0))
+    s = StreamingLOF(k=8, capacity=capacity, impl="ivf", centers=centers)
+    s.update(pts)  # full window: the IVF path runs immediately
+    s.update(rng.normal(size=(32, f)).astype(np.float32))
+    assert s.ivf_retrains == 0, "seeded centers must not retrain Lloyd"
+    assert s._ivf_fits >= 1
+
+
+# ---- query engine ---------------------------------------------------------
+
+
+def test_query_engine_single_and_batched_agree(tmp_path):
+    src, dst, v = _community_graph()
+    store, g, labels, cc = _publish_base(tmp_path, src, dst, v)
+    eng = QueryEngine(store.load())
+    ids = np.asarray([0, 13, 27, 39, 5])
+    batch = eng.query_batch(ids)
+    for i, vtx in enumerate(ids):
+        assert batch["label"][i] == eng.membership(vtx) == labels[vtx]
+        assert batch["component"][i] == eng.component(vtx) == cc[vtx]
+        assert batch["lof"][i] == pytest.approx(eng.score(vtx))
+        assert batch["community_size"][i] == eng.community_size(vtx)
+    # neighbors: one CSR row == the graph's message neighborhood
+    nbrs = eng.neighbors(0)
+    assert sorted(set(nbrs.tolist())) == list(range(1, 12))
+    # top-k: descending LOF, members of the right community only
+    community = eng.membership(26)
+    top = eng.top_outliers(community, 5)
+    scores = [s for _, s in top]
+    assert scores == sorted(scores, reverse=True)
+    assert all(labels[vtx] == community for vtx, _ in top)
+    # the highest-LOF member of that community heads the list
+    members = np.flatnonzero(labels == community)
+    want = members[np.argmax(eng.lof[members])]
+    assert top[0][0] == want
+    # deciles are ranks in [0, 9]
+    assert 0 <= eng.community_decile(0) <= 9
+    with pytest.raises(KeyError):
+        eng.membership(v + 7)
+    with pytest.raises(KeyError):
+        eng.query_batch([0, v + 7])
+    with pytest.raises(KeyError):
+        eng.top_outliers(10**6, 3)
+
+
+# ---- HTTP front end -------------------------------------------------------
+
+
+def _get(host, port, path):
+    with urllib.request.urlopen(f"http://{host}:{port}{path}", timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _post(host, port, path, payload):
+    req = urllib.request.Request(
+        f"http://{host}:{port}{path}", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def test_server_swap_under_live_queries(tmp_path):
+    """The double-buffer acceptance pin: queries hammer the server from
+    several threads while a delta publishes; zero dropped/failed queries,
+    every response is internally one version, and the swap is observed."""
+    from graphmine_tpu.serve.server import SnapshotServer
+
+    src, dst, v = _community_graph()
+    sink = _sink()
+    store, *_ = _publish_base(tmp_path, src, dst, v, sink=sink)
+    server = SnapshotServer(store, sink=sink)
+    host, port = server.start()
+    try:
+        assert _get(host, port, "/healthz")["version"] == 1
+        errors, versions = [], set()
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    out = _post(host, port, "/query", {"vertices": [0, 13, 27]})
+                    versions.add(out["version"])
+                    if len(out["label"]) != 3:
+                        raise AssertionError(f"short response: {out}")
+                except Exception as e:  # noqa: BLE001 — collect, assert later
+                    errors.append(e)
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        out = _post(
+            host, port, "/delta",
+            {"insert": [[40, 12], [40, 13], [40, 14]], "delete": [[0, 1]]},
+        )
+        assert out["version"] == 2 and out["num_vertices"] == 41
+        # post-swap queries resolve against the new snapshot
+        assert _get(host, port, "/healthz")["version"] == 2
+        assert _get(host, port, "/vertex?v=40")["label"] == 12
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert errors == []
+        assert versions <= {1, 2} and versions  # no torn/mixed versions
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(host, port, "/vertex?v=999999")
+        assert e.value.code == 400
+        top = _get(host, port, "/topk?community=12&k=3")
+        assert len(top["top"]) == 3
+    finally:
+        server.stop()
+    assert validate_records(sink.records) == []
+
+
+# ---- driver / obs integration ---------------------------------------------
+
+
+def _write_edgelist(tmp_path, src, dst):
+    p = tmp_path / "edges.txt"
+    p.write_text("".join(f"n{s} n{d}\n" for s, d in zip(src, dst)))
+    return str(p)
+
+
+def test_driver_publishes_snapshot_and_serves(tmp_path):
+    """--snapshot-out end to end: run_pipeline publishes as its final
+    phase; the snapshot loads, fingerprints match the run's edge arrays,
+    and a DeltaIngestor can repair on top of it."""
+    from graphmine_tpu.pipeline.config import PipelineConfig
+    from graphmine_tpu.pipeline.driver import run_pipeline
+
+    src, dst, v = _community_graph()
+    cfg = PipelineConfig(
+        data_path=_write_edgelist(tmp_path, src, dst),
+        data_format="edgelist",
+        outlier_method="lof",
+        lof_k=8,
+        num_devices=1,
+        snapshot_out=str(tmp_path / "snap"),
+    )
+    res = run_pipeline(cfg)
+    pub = [r for r in res.metrics.records if r["phase"] == "snapshot_publish"]
+    assert len(pub) == 1 and pub[0]["version"] == 1
+    assert {"run_id", "trace_id", "span_id", "span_path"} <= set(pub[0])
+    store = SnapshotStore(str(tmp_path / "snap"))
+    snap = store.load(
+        fingerprint=graph_fingerprint(res.edge_table.src, res.edge_table.dst)
+    )
+    np.testing.assert_array_equal(snap["labels"], res.labels)
+    assert {"src", "dst", "labels", "cc_labels", "lof", "census_present",
+            "census_sizes", "census_edges"} <= set(snap.arrays)
+    assert snap.meta["run_id"] == res.metrics.tracer.run_id
+    # and the store is delta-ready (labels here are maxIter-bounded, so
+    # the repair may legitimately re-fixpoint or fall back — either way
+    # the published labels must be a verified fixpoint)
+    ing = DeltaIngestor(store, sink=res.metrics, lof_k=4, check_samples=8)
+    snap2 = ing.apply(EdgeDelta.from_pairs(insert=[(3, 17)]))
+    assert snap2.version == 2
+    assert validate_records(res.metrics.records) == []
+
+
+def test_obs_report_renders_serving_section(tmp_path):
+    """query_batch / delta_apply / snapshot_publish all surface in the
+    obs_report output (the acceptance render pin)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    from graphmine_tpu.serve.server import SnapshotServer
+
+    src, dst, v = _community_graph()
+    stream = tmp_path / "metrics.jsonl"
+    sink = MetricsSink(stream_path=str(stream), tracer=Tracer())
+    sink.emit("run_start", pid=os.getpid())
+    store, *_ = _publish_base(tmp_path, src, dst, v, sink=sink)
+    server = SnapshotServer(store, sink=sink)
+    host, port = server.start()
+    try:
+        _post(host, port, "/query", {"vertices": [0, 1, 2]})
+        _post(host, port, "/delta", {"insert": [[40, 12], [40, 13]]})
+    finally:
+        server.stop()
+    sink.emit("run_end", ok=True)
+    sink.finalize(str(stream))
+    import obs_report
+
+    records, bad = obs_report.load_records(str(stream))
+    assert bad == 0
+    report = obs_report.build_report(records)
+    assert "-- serving (snapshots / deltas / queries) --" in report
+    assert "snapshot_publish" in report and "delta_apply" in report
+    assert "queries[query]" in report
+    assert validate_records(records) == []
+
+
+def test_serve_cli_query_and_delta(tmp_path):
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    import serve_cli
+
+    src, dst, v = _community_graph()
+    store, *_ = _publish_base(tmp_path, src, dst, v)
+    root = store.root
+    rc = serve_cli.main(["info", "--store", root])
+    assert rc == 0
+    rc = serve_cli.main([
+        "query", "--store", root, "--vertex", "0", "13",
+        "--community", "0", "--topk", "3",
+    ])
+    assert rc == 0
+    rc = serve_cli.main([
+        "delta", "--store", root, "--insert", "40,12", "--insert", "40,13",
+        "--delete", "0,1",
+    ])
+    assert rc == 0
+    assert SnapshotStore(root).load().version == 2

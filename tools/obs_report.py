@@ -189,7 +189,56 @@ _DETAIL_KEYS = {
     "checkpoint_rollback_ok": ("path", "iteration"),
     "ivf_fallback": ("guard",),
     "quarantine": (),
+    "repair_fallback": ("stage", "reason"),
 }
+
+_SERVING_PHASES = ("snapshot_publish", "snapshot_load", "delta_apply",
+                   "query_batch")
+
+
+def _serving_table(records, t0):
+    """Serving-layer timeline (r7): snapshot publishes/loads and delta
+    applies as rows, query_batch records aggregated per endpoint —
+    100k lookups must not become 100k report lines."""
+    rows, queries = [], {}
+    for r in records:
+        phase = r.get("phase")
+        if phase == "query_batch":
+            agg = queries.setdefault(
+                r.get("endpoint", "?"), {"batches": 0, "n": 0, "seconds": 0.0}
+            )
+            agg["batches"] += 1
+            agg["n"] += int(r.get("n", 0))
+            agg["seconds"] += float(r.get("seconds", 0.0))
+        elif phase == "snapshot_publish":
+            rows.append(
+                f"  {_fmt_offset(r, t0)}  snapshot_publish  "
+                f"v{r.get('version', '?')}  {r.get('bytes', 0):,} B  "
+                f"{r.get('seconds', 0):.3f}s  arrays={len(r.get('arrays', []))}"
+            )
+        elif phase == "snapshot_load":
+            rows.append(
+                f"  {_fmt_offset(r, t0)}  snapshot_load     "
+                f"v{r.get('version', '?')}  {r.get('seconds', 0):.3f}s"
+            )
+        elif phase == "delta_apply":
+            q = r.get("quarantine", {})
+            quarantined = sum(q.values()) if isinstance(q, dict) else 0
+            rows.append(
+                f"  {_fmt_offset(r, t0)}  delta_apply       "
+                f"v{r.get('version', '?')}  +{r.get('inserts', 0)}/-"
+                f"{r.get('deletes', 0)} edges  {r.get('method', '?')} "
+                f"({r.get('iterations', '?')} supersteps)  "
+                f"quarantined={quarantined}  {r.get('seconds', 0):.3f}s"
+            )
+    for endpoint, agg in sorted(queries.items()):
+        qps = agg["n"] / agg["seconds"] if agg["seconds"] > 0 else 0.0
+        rows.append(
+            f"  queries[{endpoint}]: {agg['n']:,} lookups in "
+            f"{agg['batches']} batch(es), {agg['seconds']:.3f}s resolve "
+            f"time ({qps:,.0f}/s)"
+        )
+    return rows
 
 
 def _recovery_timeline(records, t0):
@@ -296,6 +345,11 @@ def build_report(records, source: str = "", bad_lines: int = 0) -> str:
     lines.append("")
     lines.append("-- superstep telemetry (load imbalance) --")
     lines.extend(_telemetry_table(records))
+    serving = _serving_table(records, t0)
+    if serving:  # serving is opt-in; batch-only streams skip the section
+        lines.append("")
+        lines.append("-- serving (snapshots / deltas / queries) --")
+        lines.extend(serving)
     lines.append("")
     lines.append("-- recovery timeline --")
     lines.extend(_recovery_timeline(records, t0))

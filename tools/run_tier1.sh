@@ -7,7 +7,7 @@
 # (-m faults: tests/test_resilience.py + the tripwire/reshard cases in
 # tests/test_sharded.py) is part of this default pass.
 #
-# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only] [extra pytest args...]
+# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only] [extra pytest args...]
 #   --faults-only  run just the `faults`-marked recovery suite — the fast
 #                  pre-commit loop when iterating on resilience paths
 #   --obs-only     run just the `obs`-marked tracing/telemetry suite
@@ -18,6 +18,10 @@
 #                  contract/recall, the LOF auto-policy crossover, and the
 #                  recall/AUROC regression gates) — the fast slice when
 #                  iterating on the IVF index or its deployment policy
+#   --serve-only   run just the `serve`-marked serving suite
+#                  (tests/test_serve.py: snapshot round-trip/rollback,
+#                  delta repair equivalence, query engine, live-swap
+#                  server) — the fast slice when iterating on serve/
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -31,6 +35,9 @@ elif [ "${1:-}" = "--obs-only" ]; then
 elif [ "${1:-}" = "--ann-only" ]; then
     shift
     MARKER='ann and not slow'
+elif [ "${1:-}" = "--serve-only" ]; then
+    shift
+    MARKER='serve and not slow'
 fi
 
 LOG="${TIER1_LOG:-/tmp/_t1.log}"

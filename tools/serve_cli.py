@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Serving CLI: run the snapshot query server, query it, ingest deltas.
+
+The operator surface of ``graphmine_tpu/serve/`` (docs/SERVING.md)::
+
+    # publish a snapshot from a pipeline run first:
+    python -m graphmine_tpu.pipeline --snapshot-out /data/snap ...
+
+    python tools/serve_cli.py info  --store /data/snap
+    python tools/serve_cli.py query --store /data/snap --vertex 12 44 7
+    python tools/serve_cli.py query --store /data/snap --community 3 --topk 5
+    python tools/serve_cli.py delta --store /data/snap \
+        --insert 10,11 --insert 11,12 --delete 3,4
+    python tools/serve_cli.py serve --store /data/snap --port 8337 \
+        --metrics-out /data/serve_metrics.jsonl --prom-out /data/serve.prom
+
+``serve`` runs until interrupted; ``query``/``delta``/``info`` are
+one-shot in-process operations against the store directory (no server
+needed). Every subcommand that mutates or resolves emits the serving
+records (``query_batch`` / ``delta_apply`` / ``snapshot_publish``) —
+point ``tools/obs_report.py`` at ``--metrics-out`` for the joined view.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+_REPO = __file__.rsplit("/", 2)[0]
+if _REPO not in sys.path:  # allow `python tools/serve_cli.py` from anywhere
+    sys.path.insert(0, _REPO)
+
+
+def _sink(args):
+    from graphmine_tpu.obs.spans import Tracer
+    from graphmine_tpu.pipeline.metrics import MetricsSink
+
+    return MetricsSink(
+        stream_path=getattr(args, "metrics_out", None), tracer=Tracer()
+    )
+
+
+def _store(args):
+    from graphmine_tpu.serve.snapshot import SnapshotStore
+
+    return SnapshotStore(args.store)
+
+
+def cmd_info(args) -> int:
+    snap = _store(args).load()
+    if snap is None:
+        print(f"serve_cli: store at {args.store!r} is empty", file=sys.stderr)
+        return 2
+    print(json.dumps({
+        **snap.meta,
+        "arrays": {k: list(v.shape) for k, v in snap.arrays.items()},
+    }, indent=1, default=str))
+    return 0
+
+
+def cmd_query(args) -> int:
+    from graphmine_tpu.serve.query import QueryEngine
+    from graphmine_tpu.serve.server import _jsonable
+
+    sink = _sink(args)
+    snap = _store(args).load(sink=sink)
+    if snap is None:
+        print(f"serve_cli: store at {args.store!r} is empty", file=sys.stderr)
+        return 2
+    eng = QueryEngine(snap)
+    out: dict = {"version": eng.version}
+    t0 = time.perf_counter()
+    if args.vertex:
+        batch = eng.query_batch(args.vertex)
+        sink.emit(
+            "query_batch", endpoint="cli", n=len(args.vertex),
+            seconds=round(time.perf_counter() - t0, 6),
+        )
+        out["rows"] = batch
+    if args.neighbors is not None:
+        out["neighbors"] = eng.neighbors(args.neighbors)
+    if args.community is not None:
+        out["top"] = [
+            {"vertex": v, "lof": s}
+            for v, s in eng.top_outliers(args.community, args.topk)
+        ]
+    print(json.dumps(_jsonable(out)))
+    if args.metrics_out:
+        sink.finalize(args.metrics_out)
+    return 0
+
+
+def cmd_delta(args) -> int:
+    from graphmine_tpu.serve.delta import DeltaIngestor, EdgeDelta
+
+    def pairs(values):
+        return [tuple(int(x) for x in v.split(",")) for v in values or ()]
+
+    if args.file:
+        with open(args.file) as f:
+            payload = json.load(f)
+        delta = EdgeDelta.from_pairs(
+            insert=payload.get("insert", ()), delete=payload.get("delete", ())
+        )
+    else:
+        delta = EdgeDelta.from_pairs(
+            insert=pairs(args.insert), delete=pairs(args.delete)
+        )
+    sink = _sink(args)
+    ing = DeltaIngestor(_store(args), sink=sink, num_shards=args.num_shards)
+    snap = ing.apply(delta)
+    last = [r for r in sink.records if r.get("phase") == "delta_apply"][-1]
+    print(json.dumps({
+        "version": snap.version,
+        "snapshot_id": snap.snapshot_id,
+        "method": last["method"],
+        "inserts": last["inserts"],
+        "deletes": last["deletes"],
+        "quarantine": last["quarantine"],
+        "seconds": last["seconds"],
+    }))
+    if args.metrics_out:
+        sink.finalize(args.metrics_out)
+    return 0
+
+
+def cmd_serve(args) -> int:
+    from graphmine_tpu.serve.server import SnapshotServer
+
+    sink = _sink(args)
+    server = SnapshotServer(
+        _store(args), host=args.host, port=args.port, sink=sink,
+        prom_out=args.prom_out, num_shards=args.num_shards,
+    )
+    host, port = server.start()
+    print(f"serving snapshot v{server.engine.version} on http://{host}:{port}",
+          file=sys.stderr)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        if args.metrics_out:
+            sink.finalize(args.metrics_out)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def common(p):
+        p.add_argument("--store", required=True,
+                       help="snapshot store directory")
+        p.add_argument("--metrics-out", default=None,
+                       help="append serving records to this JSONL")
+
+    p = sub.add_parser("info", help="print the current snapshot manifest")
+    common(p)
+    p.set_defaults(fn=cmd_info)
+
+    p = sub.add_parser("query", help="one-shot queries against the store")
+    common(p)
+    p.add_argument("--vertex", type=int, nargs="*", default=[],
+                   help="vertex ids to resolve (batched gather)")
+    p.add_argument("--neighbors", type=int, default=None,
+                   help="list this vertex's neighbors")
+    p.add_argument("--community", type=int, default=None,
+                   help="top-k outliers of this community")
+    p.add_argument("--topk", type=int, default=10)
+    p.set_defaults(fn=cmd_query)
+
+    p = sub.add_parser("delta", help="apply one insert/delete batch")
+    common(p)
+    p.add_argument("--insert", action="append", metavar="SRC,DST",
+                   help="edge to insert (repeatable)")
+    p.add_argument("--delete", action="append", metavar="SRC,DST",
+                   help="edge to delete (repeatable)")
+    p.add_argument("--file", default=None,
+                   help='JSON file {"insert": [[s,d],...], "delete": [...]}')
+    p.add_argument("--num-shards", type=int, default=1)
+    p.set_defaults(fn=cmd_delta)
+
+    p = sub.add_parser("serve", help="run the HTTP query server")
+    common(p)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8337)
+    p.add_argument("--prom-out", default=None,
+                   help="Prometheus textfile path (updated on each swap)")
+    p.add_argument("--num-shards", type=int, default=1)
+    p.set_defaults(fn=cmd_serve)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
