@@ -79,9 +79,26 @@ class EdgeDelta:
     @classmethod
     def from_pairs(cls, insert=(), delete=()) -> "EdgeDelta":
         """Build from ``[(src, dst), ...]`` pair lists (the JSON wire
-        shape the HTTP front end accepts)."""
-        ins = np.asarray(list(insert), np.int64).reshape(-1, 2)
-        del_ = np.asarray(list(delete), np.int64).reshape(-1, 2)
+        shape the HTTP front end accepts). Malformed input — null,
+        non-iterable, non-numeric, or fractional ids — raises ValueError
+        (the HTTP layer's 400), never TypeError, and never silently
+        truncates ``1.9`` to vertex ``1``. Integral floats (``40.0``,
+        which JSON encoders routinely emit for integers) are accepted.
+        """
+
+        from graphmine_tpu.serve.query import _as_int_ids
+
+        def _pairs(name, pairs):
+            try:
+                lst = list(pairs)
+            except TypeError as e:
+                raise ValueError(
+                    f"{name} must be an array of [src, dst] pairs ({e})"
+                ) from e
+            return _as_int_ids(lst, name).reshape(-1, 2)
+
+        ins = _pairs("insert", insert)
+        del_ = _pairs("delete", delete)
         return cls(ins[:, 0], ins[:, 1], del_[:, 0], del_[:, 1])
 
     @property
@@ -152,15 +169,20 @@ def splice_edges(src, dst, num_vertices: int, delta: EdgeDelta):
         ekey = src * enc + dst
         dkey = delta.delete_src * enc + delta.delete_dst
         dk_u, dk_c = np.unique(dkey, return_counts=True)
-        order = np.argsort(ekey, kind="stable")
-        sk = ekey[order]
+        # Prefilter to rows whose key a delete targets — searchsorted
+        # against the tiny sorted dk_u is O(E log d), so the
+        # occurrence-rank sort runs over the handful of candidates, not
+        # all E edges (np.isin would fall back to an O(E log E)
+        # sort-based path for int64 key ranges this wide).
+        pos_all = np.minimum(np.searchsorted(dk_u, ekey), len(dk_u) - 1)
+        cand = np.flatnonzero(dk_u[pos_all] == ekey)
+        order = np.argsort(ekey[cand], kind="stable")
+        sk = ekey[cand][order]
         # occurrence rank of each edge within its (src, dst) group
         rank = np.arange(len(sk)) - np.searchsorted(sk, sk, side="left")
-        pos = np.searchsorted(dk_u, sk)
-        pos_c = np.minimum(pos, len(dk_u) - 1)
-        want = np.where(dk_u[pos_c] == sk, dk_c[pos_c], 0)
+        want = dk_c[np.searchsorted(dk_u, sk)]  # every sk is in dk_u
         drop_sorted = rank < want
-        keep[order[drop_sorted]] = False
+        keep[cand[order[drop_sorted]]] = False
         unmatched = int(delta.num_deletes - drop_sorted.sum())
     src2 = np.concatenate([src[keep], delta.insert_src])
     dst2 = np.concatenate([dst[keep], delta.insert_dst])
@@ -236,6 +258,34 @@ def _warm_lpa(graph, init_labels: np.ndarray, budget: int):
     return np.asarray(labels), budget, False
 
 
+def _warm_lpa_sharded(shards, init_labels: np.ndarray, budget: int):
+    """Sharded twin of :func:`_warm_lpa` with the SAME stop conditions
+    (fixpoint, period-2 livelock, budget): drives the sharded entry one
+    superstep at a time so cycle detection — which the jitted while-loop
+    carry lacks — happens host-side. Synchronous LPA is deterministic,
+    so the stepped trajectory is identical to the fused one; only the
+    exit point differs on livelock graphs."""
+    import jax.numpy as jnp
+
+    from graphmine_tpu.parallel.sharded import sharded_lpa_fixpoint
+
+    sg, mesh = shards
+    labels = np.asarray(init_labels, np.int32)
+    prev = None
+    for it in range(budget):
+        new, _, _ = sharded_lpa_fixpoint(
+            sg, mesh, max_iter=1, init_labels=jnp.asarray(labels)
+        )
+        new = np.asarray(new)
+        if np.array_equal(new, labels):
+            return new, it + 1, True
+        if prev is not None and np.array_equal(new, prev):
+            return new, it + 1, False  # period-2 livelock
+        prev = labels
+        labels = new
+    return labels, budget, False
+
+
 def _warm_cc(graph, init_labels: np.ndarray, budget: int):
     """Warm-start min-propagation CC to fixpoint (monotone, so any valid
     upper-bound init converges to THE fixpoint). Returns
@@ -281,28 +331,90 @@ def cc_repair_init(
     return init
 
 
+def _clear_sharded_jit_caches():
+    """Evict the sharded entries' module-global jit caches. They are
+    keyed by array shapes and never evicted, so on a long-lived serving
+    ingestor every delta that changes the padded shard shapes would
+    otherwise accrete one more compiled XLA executable forever
+    (unbounded host/device memory). The caller clears only when the
+    shapes actually changed — steady same-shape deltas keep their warm
+    cache.
+
+    The caches are process-global, so this also evicts any OTHER
+    in-process user of the sharded entries (e.g. a driver publish in
+    the same process). That is functionally safe — worst case is one
+    recompile on their next call — and a serving ingestor is normally
+    the only sharded user in its process; jax exposes no per-entry
+    eviction, and scoping compiled caches per ingestor would require
+    the sharded kernel entries to take a caller-owned jit handle, a
+    kernel-API change out of proportion to this fallback-path cache."""
+    from graphmine_tpu.parallel import sharded as _sharded
+
+    for fn in (
+        _sharded._sharded_lpa_fixpoint_jit,
+        _sharded._sharded_cc_jit,
+    ):
+        clear = getattr(fn, "clear_cache", None)
+        if clear is not None:
+            clear()
+
+
+def _sharded_exact_step(shards, labels: np.ndarray, kind: str) -> np.ndarray:
+    """One exact superstep through the sharded entries: ``max_iter=1``
+    with the current labels as init leaves them unchanged iff they are a
+    superstep fixpoint — the same acceptance predicate as the
+    single-device twin, without materializing an unsharded whole-graph
+    superstep on one device."""
+    import jax.numpy as jnp
+
+    from graphmine_tpu.parallel.sharded import (
+        sharded_connected_components,
+        sharded_lpa_fixpoint,
+    )
+
+    sg, mesh = shards
+    init = jnp.asarray(labels, jnp.int32)
+    if kind == "lpa":
+        nxt, _, _ = sharded_lpa_fixpoint(sg, mesh, max_iter=1, init_labels=init)
+    else:
+        nxt = sharded_connected_components(
+            sg, mesh, max_iter=1, init_labels=init
+        )
+    return np.asarray(nxt)
+
+
 def sampled_exact_check(
-    graph, labels: np.ndarray, samples: np.ndarray, kind: str = "lpa"
+    graph, labels: np.ndarray, samples: np.ndarray, kind: str = "lpa",
+    shards=None,
 ) -> tuple[bool, int]:
     """The repair tripwire: one EXACT superstep of the new graph must
     leave the repaired labels unchanged at every sampled vertex, and
     every sampled label must be a real vertex id. A genuine fixpoint
     passes by construction; corrupted state, a non-fixpoint (budget ran
     out), or a wrong-graph mixup does not. Returns
-    ``(ok, mismatching_samples)``."""
-    import jax
-    import jax.numpy as jnp
+    ``(ok, mismatching_samples)``.
 
-    from graphmine_tpu.ops.cc import cc_superstep
-    from graphmine_tpu.ops.lpa import lpa_superstep
-
+    ``shards``: optional ``(sharded_graph, mesh)`` pair — the exact
+    superstep then runs through the sharded entries, so working sets
+    past one device (the reason ``num_shards > 1`` exists) are never
+    funneled back into a single-device whole-graph superstep here.
+    """
     v = graph.num_vertices
     lbl = np.asarray(labels)
     oob = int(((lbl < 0) | (lbl >= v)).sum())
     if oob:
         return False, oob
-    step = lpa_superstep if kind == "lpa" else cc_superstep
-    nxt = np.asarray(jax.jit(step)(jnp.asarray(lbl, jnp.int32), graph))
+    if shards is not None:
+        nxt = _sharded_exact_step(shards, lbl, kind)
+    else:
+        import jax
+        import jax.numpy as jnp
+
+        from graphmine_tpu.ops.cc import cc_superstep
+        from graphmine_tpu.ops.lpa import lpa_superstep
+
+        step = lpa_superstep if kind == "lpa" else cc_superstep
+        nxt = np.asarray(jax.jit(step)(jnp.asarray(lbl, jnp.int32), graph))
     samples = np.asarray(samples, np.int64)
     samples = samples[(samples >= 0) & (samples < v)]
     bad = int((nxt[samples] != lbl[samples]).sum())
@@ -321,7 +433,7 @@ class RepairResult:
     checked_samples: int = 0
 
 
-def cold_recompute(graph, budget: int = 0):
+def cold_recompute(graph, budget: int = 0, shards=None):
     """Cold full recompute — the fallback AND the equivalence oracle the
     repair tests compare against: LPA from identity init run to fixpoint
     (bounded, period-2 cycles exit early), CC from identity. Returns
@@ -329,11 +441,51 @@ def cold_recompute(graph, budget: int = 0):
     livelocks (never fixpoints), the result is the cycle-stopped bounded
     recompute — the same semantics class as the batch pipeline's bounded
     ``max_iter`` — and every delta on such a graph routes here via the
-    repair fallback (the sampled check refuses non-fixpoints)."""
+    repair fallback (the sampled check refuses non-fixpoints).
+
+    ``shards``: optional ``(sharded_graph, mesh)`` pair — the recompute
+    then runs through ``sharded_lpa_fixpoint`` (identity init) /
+    ``sharded_connected_components`` (label parity with the
+    single-device ops is pinned by the sharded suite), so the sharded
+    repair path's fallback never OOMs on exactly the working sets that
+    needed sharding in the first place. Livelock graphs take the fused
+    fixpoint run first (fast path), then replay with host-side period-2
+    detection so the published labels match the single-device oracle's
+    cycle-stopped state, not a budget-parity-dependent cycle phase."""
     import numpy as _np
 
     v = graph.num_vertices
     budget = budget or frontier_budget(v, v)
+    if shards is not None:
+        from graphmine_tpu.parallel.sharded import (
+            sharded_connected_components,
+            sharded_lpa_fixpoint,
+        )
+
+        import jax.numpy as jnp
+
+        sg, mesh = shards
+        labels, it_l, conv = sharded_lpa_fixpoint(sg, mesh, max_iter=budget)
+        if not conv:
+            # The jitted while-loop carry has no cycle detection, so a
+            # period-2 livelock burns the whole budget and lands on
+            # whichever phase budget parity picks. Probe two more
+            # supersteps: back-to-start means the end state sits IN a
+            # 2-cycle — replay one superstep at a time (identical
+            # deterministic trajectory) with the same host-side
+            # new==prev exit as _warm_lpa to land on its cycle-stopped
+            # state. Genuine budget exhaustion (still converging) skips
+            # the replay: it would retrace the whole budget only to
+            # reproduce the same truncated labels.
+            probe, _, _ = sharded_lpa_fixpoint(
+                sg, mesh, max_iter=2, init_labels=jnp.asarray(labels)
+            )
+            if _np.array_equal(_np.asarray(probe), _np.asarray(labels)):
+                labels, it_l, _ = _warm_lpa_sharded(
+                    shards, _np.arange(v, dtype=_np.int32), budget
+                )
+        cc = sharded_connected_components(sg, mesh)
+        return _np.asarray(labels), _np.asarray(cc), int(it_l)
     labels, it_l, _ = _warm_lpa(
         graph, _np.arange(v, dtype=_np.int32), budget
     )
@@ -346,11 +498,14 @@ def cold_recompute(graph, budget: int = 0):
 def _verify_or_fallback(
     graph, labels, cc, conv_l, conv_c, delta: EdgeDelta, budget: int,
     iterations: int, check_samples: int, sink, num_shards: int = 1,
-    seed: int = 0,
+    seed: int = 0, shards=None,
 ) -> RepairResult:
     """The shared tail of BOTH repair paths (single-device and sharded):
     fault seam → sampled exact check → accept or fall back. One owner so
-    the two paths can never diverge on what gets published.
+    the two paths can never diverge on what gets published. ``shards``
+    (the sharded caller's ``(sharded_graph, mesh)``) keeps the check and
+    the fallback recompute on the sharded entries too — no single-device
+    full-graph funnel.
 
     The fault seam is where tests corrupt the repaired state
     (poison_labels-style mutator) to prove the sampled check catches
@@ -364,8 +519,12 @@ def _verify_or_fallback(
     rng = np.random.default_rng(seed)
     extra = rng.integers(0, v, size=min(check_samples, v))
     samples = np.unique(np.concatenate([affected_vertices(delta), extra]))
-    ok_l, bad_l = sampled_exact_check(graph, labels, samples, kind="lpa")
-    ok_c, bad_c = sampled_exact_check(graph, cc, samples, kind="cc")
+    ok_l, bad_l = sampled_exact_check(
+        graph, labels, samples, kind="lpa", shards=shards
+    )
+    ok_c, bad_c = sampled_exact_check(
+        graph, cc, samples, kind="cc", shards=shards
+    )
 
     reason = None
     if not (conv_l and conv_c):
@@ -385,7 +544,7 @@ def _verify_or_fallback(
         )
     if sink is not None:
         sink.emit("repair_fallback", stage="delta_repair", reason=reason)
-    labels, cc, it = cold_recompute(graph)
+    labels, cc, it = cold_recompute(graph, shards=shards)
     return RepairResult(
         labels=labels, cc_labels=cc, method="full_recompute",
         iterations=it, fallback_reason=reason,
@@ -492,20 +651,31 @@ class DeltaIngestor:
         # StreamingLOF(centers=...) reuse path — Lloyd never re-trains
         # what an earlier ingestor already paid for.
         self._centers = snap.get("lof_centers")
+        # padded shard shapes of the last sharded apply (jit-cache
+        # eviction key; see _clear_sharded_jit_caches)
+        self._shard_jit_key = None
 
     @property
     def num_vertices(self) -> int:
         return len(self.labels)
 
     def _repair(self, graph, delta: EdgeDelta) -> RepairResult:
+        # Rotate the sampled-check seed per apply (the snapshot version
+        # increments every publish): a fixed seed would pick the same
+        # "random" vertices on every delta, gutting the tripwire's
+        # long-run coverage of silent corruption outside the frontier.
+        seed = self.snapshot.version
         if self.num_shards <= 1:
             return repair_labels(
                 graph, self.labels, self.cc_labels, delta,
                 check_samples=self.check_samples, sink=self.sink,
+                seed=seed,
             )
-        return self._repair_sharded(graph, delta)
+        return self._repair_sharded(graph, delta, seed)
 
-    def _repair_sharded(self, graph, delta: EdgeDelta) -> RepairResult:
+    def _repair_sharded(
+        self, graph, delta: EdgeDelta, seed: int = 0
+    ) -> RepairResult:
         """Mesh twin of :func:`repair_labels`: same inits, propagation
         through the sharded entries, same shared verify/fallback tail
         (:func:`_verify_or_fallback`)."""
@@ -521,7 +691,19 @@ class DeltaIngestor:
         budget = frontier_budget(v, len(affected_vertices(delta)))
         mesh = make_mesh(self.num_shards)
         sg = shard_graph_arrays(partition_graph(graph, mesh=mesh), mesh)
+        import jax
         import jax.numpy as jnp
+
+        # One compiled-executable generation at a time: when this
+        # delta's padded shard shapes differ from the previous apply's,
+        # drop the stale jit entries before compiling the new ones.
+        key = tuple(
+            tuple(x.shape) for x in jax.tree_util.tree_leaves(sg)
+            if hasattr(x, "shape")
+        )
+        if self._shard_jit_key is not None and key != self._shard_jit_key:
+            _clear_sharded_jit_caches()
+        self._shard_jit_key = key
 
         init_lpa = np.arange(v, dtype=np.int32)
         init_lpa[: len(self.labels)] = self.labels
@@ -543,6 +725,7 @@ class DeltaIngestor:
             graph, np.asarray(labels), np.asarray(cc), conv_l, conv_c,
             delta, budget, int(it_l) + int(tele.iterations),
             self.check_samples, self.sink, num_shards=self.num_shards,
+            seed=seed, shards=(sg, mesh),
         )
 
     def _refresh_lof(self, graph, labels: np.ndarray, aff: np.ndarray):
@@ -565,9 +748,25 @@ class DeltaIngestor:
             ),
             np.float32,
         )
+        grew = len(self.lof) < len(feats)
+        if grew:
+            # vertex growth: new vertices start at score 0 (fresh array —
+            # concatenate never resizes in place)
+            self.lof = np.concatenate([
+                self.lof,
+                np.zeros(len(feats) - len(self.lof), np.float32),
+            ])
+        k = min(self.lof_k, len(feats) - 2)
         if self._stream is None:
+            if k < 1:
+                # Too few vertices to LOF-score (k needs >= 1 real
+                # neighbors): keep the existing scores and publish —
+                # never crash the apply over an unscorable batch. The
+                # bootstrap retries once the graph grows past the
+                # threshold.
+                return
             self._stream = StreamingLOF(
-                k=min(self.lof_k, len(feats) - 2),
+                k=k,
                 capacity=min(self.lof_capacity, max(len(feats), self.lof_k + 2)),
                 impl="ivf",
                 sink=self.sink,
@@ -577,13 +776,16 @@ class DeltaIngestor:
             self.lof = np.array(self._stream.update(feats), np.float32)
             self._centers = self._stream._centers
             return
-        if len(self.lof) < len(feats):
-            self.lof = np.concatenate([
-                self.lof,
-                np.zeros(len(feats) - len(self.lof), np.float32),
-            ])
         if len(aff):
-            self.lof[aff] = self._stream.update(feats[aff])
+            # Copy-on-write: the last published Snapshot (and any
+            # QueryEngine serving it) aliases self.lof, so an in-place
+            # splice would mutate the live engine mid-apply — torn reads
+            # under the double-buffer's no-torn-read guarantee. A growth
+            # delta already rebuilt the column fresh above; nothing
+            # published aliases that one, so skip the second O(V) copy.
+            lof = self.lof if grew else self.lof.copy()
+            lof[aff] = self._stream.update(feats[aff])
+            self.lof = lof
         self._centers = self._stream._centers
 
     def apply(self, delta: EdgeDelta) -> Snapshot:

@@ -14,7 +14,9 @@ construction once, then every lookup is a contiguous slice):
   (label asc, LOF desc)-sorted vertex order;
 - ``query_batch``: the vectorized path — a whole vector of vertex ids
   resolves in ONE device gather over a stacked ``[3, V]`` int table (+
-  one for the float LOF column), jitted once per engine.
+  one for the float LOF column), jitted per engine with batches padded
+  to power-of-two buckets (bounded retraces; traces die with the engine
+  at snapshot swap).
 """
 
 from __future__ import annotations
@@ -22,6 +24,28 @@ from __future__ import annotations
 import numpy as np
 
 from graphmine_tpu.serve.snapshot import Snapshot
+
+
+def _as_int_ids(values, what: str) -> np.ndarray:
+    """Coerce wire input to an int64 id array. Integral floats are
+    accepted (JSON encoders routinely emit ``40.0`` for 40); fractional,
+    non-finite or non-numeric ids raise ValueError (the HTTP layer's
+    400) — never a TypeError crash, never a silent truncation of ``1.9``
+    to id ``1``. Shared by the query and delta wire paths so the two can
+    never drift on what counts as a valid id."""
+    try:
+        arr = np.asarray(values)
+    except TypeError as e:
+        raise ValueError(f"{what} must be an array of integers ({e})") from e
+    if arr.size == 0 or np.issubdtype(arr.dtype, np.integer):
+        return arr.astype(np.int64)
+    if (
+        np.issubdtype(arr.dtype, np.floating)
+        and np.isfinite(arr).all()
+        and (arr == np.floor(arr)).all()
+    ):
+        return arr.astype(np.int64)
+    raise ValueError(f"{what} ids must be integers (got dtype {arr.dtype})")
 
 
 class QueryEngine:
@@ -81,7 +105,15 @@ class QueryEngine:
         )
 
         self._dev = None
-        if device:
+        self._table = None
+        if not device:
+            # host twin of the device table, built ONCE (a per-call
+            # np.stack would memcpy 3x[V] ints on every batch)
+            self._table = np.stack(
+                [self.labels, self.cc_labels, self._size_by_vertex]
+            )
+        else:
+            import jax
             import jax.numpy as jnp
 
             self._dev = (
@@ -92,6 +124,10 @@ class QueryEngine:
                 ]),
                 jnp.asarray(self.lof),
             )
+            # Per-ENGINE jit (not module-global): traces die with the
+            # engine at snapshot swap instead of accreting one stale
+            # entry per (batch shape, V) forever on a long-lived server.
+            self._gather = jax.jit(lambda t, s, i: (t[:, i], s[i]))
 
     @property
     def version(self) -> int:
@@ -162,7 +198,7 @@ class QueryEngine:
         "lof"}`` as aligned arrays. Out-of-range ids raise (the HTTP
         layer turns that into a 400, never a wrong answer).
         """
-        ids = np.asarray(vertices, np.int64).reshape(-1)
+        ids = _as_int_ids(vertices, "vertex").reshape(-1)
         if len(ids) and (ids.min() < 0 or ids.max() >= self.num_vertices):
             bad = ids[(ids < 0) | (ids >= self.num_vertices)]
             raise KeyError(
@@ -170,14 +206,20 @@ class QueryEngine:
                 f"{bad[:5].tolist()}..."
             )
         if self._dev is not None:
-            ints, lof = _gather(self._dev[0], self._dev[1], ids)
-            ints = np.asarray(ints)
-            lof = np.asarray(lof)
+            # Pad to the next power-of-two bucket: clients send arbitrary
+            # batch lengths, and jit retraces per shape — bucketing caps
+            # the traces per engine at ~log2(max batch) instead of one
+            # per distinct length (a synchronous XLA compile on the hot
+            # path each time).
+            n = len(ids)
+            cap = 1 << max(0, (n - 1).bit_length())
+            padded = np.zeros(cap, np.int32)
+            padded[:n] = ids
+            ints, lof = self._gather(self._dev[0], self._dev[1], padded)
+            ints = np.asarray(ints)[:, :n]
+            lof = np.asarray(lof)[:n]
         else:
-            table = np.stack(
-                [self.labels, self.cc_labels, self._size_by_vertex]
-            )
-            ints, lof = table[:, ids], self.lof[ids]
+            ints, lof = self._table[:, ids], self.lof[ids]
         return {
             "vertex": ids,
             "label": ints[0],
@@ -185,17 +227,3 @@ class QueryEngine:
             "community_size": ints[2],
             "lof": lof,
         }
-
-
-def _gather(int_table, lof, ids):
-    global _gather_jit
-    if _gather_jit is None:
-        import jax
-
-        _gather_jit = jax.jit(
-            lambda t, s, i: (t[:, i], s[i])
-        )
-    return _gather_jit(int_table, lof, np.asarray(ids, np.int32))
-
-
-_gather_jit = None

@@ -137,6 +137,13 @@ class SnapshotStore:
         current generation (version 1 when the store is empty). ``sink``:
         emits a ``snapshot_publish`` record (span-stamped, rendered by
         ``tools/obs_report.py``).
+
+        The returned :class:`Snapshot` ALIASES the caller's arrays (no
+        defensive copy of potentially-GB columns): snapshots are
+        immutable by contract, so a publisher that keeps mutable working
+        state must copy-on-write before changing it (the delta
+        ingestor's LOF splice does) — a live ``QueryEngine`` built on
+        the returned snapshot reads these same buffers.
         """
         t0 = time.perf_counter()
         for name, arr in arrays.items():
@@ -217,9 +224,23 @@ class SnapshotStore:
 
         prev = self._prev()
         if os.path.exists(gen):
-            if os.path.exists(prev):
-                shutil.rmtree(prev)
-            os.replace(gen, prev)
+            if self._peek_dir(gen) is None:
+                # The current generation's manifest is unreadable:
+                # rotating it into .prev would EVICT the only intact
+                # snapshot and install garbage as the rollback target
+                # (a kill before the final rename would then lose every
+                # loadable generation). Condemn it aside instead — the
+                # same *.corrupt convention as the loader's rollback.
+                condemned = gen + ".corrupt"
+                n = 0
+                while os.path.exists(condemned):
+                    n += 1
+                    condemned = f"{gen}.corrupt.{n}"
+                os.replace(gen, condemned)
+            else:
+                if os.path.exists(prev):
+                    shutil.rmtree(prev)
+                os.replace(gen, prev)
         os.replace(tmp, gen)
         _fsync_dir(self.root)
         if sink is not None:
@@ -237,15 +258,44 @@ class SnapshotStore:
         return Snapshot(arrays=dict(arrays), meta=meta, path=gen)
 
     # -- load -------------------------------------------------------------
-    def _peek_manifest(self) -> dict | None:
-        """Cheap current-generation manifest read (JSON only, no array
-        hashing); None = absent/unreadable (the full loader may still
-        recover via rollback)."""
+    @staticmethod
+    def _peek_dir(gen_dir: str) -> dict | None:
+        """Cheap one-directory manifest read (JSON + manifest checksum,
+        no array hashing); None = absent/unparseable/checksum-damaged.
+        Applies the loader's manifest-level corruption verdict so the
+        publish rotation never treats a bit-damaged-but-parseable
+        manifest as an intact generation, and stats every listed array
+        file (existence + non-empty, no hashing — damage overwhelmingly
+        lands in the GB-scale arrays, not the KB manifest) so a
+        generation missing its arrays is never rotated over an intact
+        ``.prev``."""
         try:
-            with open(os.path.join(self._gen(), MANIFEST_NAME)) as f:
-                return json.load(f)
+            with open(os.path.join(gen_dir, MANIFEST_NAME)) as f:
+                body = json.load(f)
         except Exception:
             return None
+        if body.get("checksum", "") != _manifest_checksum(body):
+            return None
+        for ent in body.get("arrays", {}).values():
+            try:
+                if os.path.getsize(os.path.join(gen_dir, ent["file"])) <= 0:
+                    return None
+            except (OSError, KeyError, TypeError):
+                return None
+        return body
+
+    def _peek_manifest(self) -> dict | None:
+        """Cheap manifest read for the version/parent chain: the current
+        generation, falling back to ``.prev`` when the current one is
+        missing/unreadable — a kill in the window between the two
+        publish renames leaves only ``.prev`` intact, and the chain must
+        continue from it, never reset to version 1. None = neither
+        generation readable."""
+        for gen in (self._gen(), self._prev()):
+            peek = self._peek_dir(gen)
+            if peek is not None:
+                return peek
+        return None
 
     def peek_version(self) -> int | None:
         peek = self._peek_manifest()

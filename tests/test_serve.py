@@ -146,6 +146,60 @@ def test_torn_publish_leaves_previous_loadable(tmp_path):
     ], "stale tmp generations must be swept"
 
 
+def test_publish_version_chain_survives_missing_current_generation(tmp_path):
+    """A kill in the window between the two publish renames leaves only
+    ``.prev`` intact; the next publish must continue the version/parent
+    chain from it — never reset to version 1 (version regressions would
+    break the server's swap comparison and the provenance chain)."""
+    import shutil
+
+    src, dst, v = _community_graph()
+    store, *_ = _publish_base(tmp_path, src, dst, v)
+    snap1 = store.load()
+    arrays = dict(snap1.arrays)
+    store.publish(arrays, fingerprint=snap1.fingerprint)  # v2, rotates v1
+    shutil.rmtree(store._gen())  # crash window: only .prev (v1) remains
+    snap = store.publish(arrays, fingerprint=snap1.fingerprint)
+    assert snap.version == 2
+    assert snap.parent == snap1.snapshot_id
+
+
+@pytest.mark.parametrize(
+    "damage", ["not_json", "bad_checksum", "missing_array"]
+)
+def test_publish_condemns_corrupt_current_generation(tmp_path, damage):
+    """A current generation whose manifest is unreadable, fails its
+    checksum, or is missing an array file must NOT rotate into ``.prev``
+    on the next publish — that would evict the only intact snapshot and
+    install garbage as the rollback target. It gets condemned aside
+    (*.corrupt) and the intact ``.prev`` survives."""
+    src, dst, v = _community_graph()
+    store, *_ = _publish_base(tmp_path, src, dst, v)
+    snap1 = store.load()
+    arrays = dict(snap1.arrays)
+    store.publish(arrays, fingerprint=snap1.fingerprint)  # v2, rotates v1
+    man = os.path.join(store._gen(), "manifest.json")
+    if damage == "not_json":
+        with open(man, "w") as f:
+            f.write("{not json")
+    elif damage == "bad_checksum":
+        # parseable JSON, damaged body: the loader's checksum verdict
+        body = json.load(open(man))
+        body["run_id"] = "tampered"
+        with open(man, "w") as f:
+            json.dump(body, f)
+    else:  # intact manifest, GB-scale damage: an array file vanished
+        os.remove(os.path.join(store._gen(), "labels.npy"))
+    snap = store.publish(arrays, fingerprint=snap1.fingerprint)
+    # chain continued from the intact .prev (v1), not reset to 1
+    assert snap.version == 2 and snap.parent == snap1.snapshot_id
+    assert store.load().version == 2
+    # .prev still holds the intact v1; the damaged dir is set aside
+    with open(os.path.join(store._prev(), "manifest.json")) as f:
+        assert json.load(f)["version"] == 1
+    assert any(".corrupt" in p for p in os.listdir(store.root))
+
+
 def test_corrupt_generation_rolls_back_to_prev(tmp_path):
     src, dst, v = _community_graph()
     sink = _sink()
@@ -169,6 +223,20 @@ def test_corrupt_generation_rolls_back_to_prev(tmp_path):
 
 
 # ---- delta validation / splice --------------------------------------------
+
+
+def test_from_pairs_wire_validation():
+    """JSON-wire hygiene: integral floats are accepted (encoders emit
+    40.0 for 40), fractional or non-numeric ids raise ValueError — never
+    a silent truncation of 1.9 to vertex 1, never a TypeError."""
+    d = EdgeDelta.from_pairs(insert=[[40.0, 12.0]])
+    assert d.insert_src.tolist() == [40] and d.insert_dst.tolist() == [12]
+    with pytest.raises(ValueError, match="integers"):
+        EdgeDelta.from_pairs(insert=[[1.9, 2.7]])
+    with pytest.raises(ValueError, match="integers"):
+        EdgeDelta.from_pairs(delete=[[1, None]])
+    with pytest.raises(ValueError, match="pairs"):
+        EdgeDelta.from_pairs(insert=None)
 
 
 def test_validate_delta_quarantines_bad_rows():
@@ -312,6 +380,67 @@ def test_delta_chain_versions_and_lof(tmp_path):
         assert {"run_id", "trace_id", "span_id", "span_path"} <= set(r)
 
 
+def test_published_snapshot_arrays_immutable_under_later_deltas(tmp_path):
+    """Double-buffer contract: a QueryEngine built on a published
+    snapshot must never observe a later delta mutating its arrays — the
+    LOF splice used to write through the publish-time alias on
+    no-growth deltas (torn reads on the live engine)."""
+    src, dst, v = _community_graph()
+    store, *_ = _publish_base(tmp_path, src, dst, v)
+    ing = DeltaIngestor(store, lof_k=4, check_samples=8)
+    s1 = ing.apply(EdgeDelta.from_pairs(insert=[(40, 12), (40, 13), (40, 14)]))
+    eng = QueryEngine(s1, device=False)
+    lof_before = eng.lof.copy()
+    labels_before = eng.labels.copy()
+    # no vertex growth: the repaired LOF column is spliced, not rebuilt
+    ing.apply(EdgeDelta.from_pairs(delete=[(40, 14)]))
+    np.testing.assert_array_equal(eng.lof, lof_before)
+    np.testing.assert_array_equal(eng.labels, labels_before)
+    assert not np.shares_memory(ing.lof, s1["lof"])
+
+
+def test_tiny_graph_delta_skips_lof_refresh(tmp_path):
+    """A <=2-vertex graph cannot be LOF-scored (k would be < 1): the
+    apply must keep the existing scores and publish, never crash the
+    batch — and the scorer bootstraps normally once the graph grows."""
+    src = np.asarray([0], np.int32)
+    dst = np.asarray([1], np.int32)
+    store, *_ = _publish_base(tmp_path, src, dst, 2)
+    ing = DeltaIngestor(store, lof_k=4, check_samples=4)
+    snap = ing.apply(EdgeDelta.from_pairs(delete=[(0, 1)]))
+    assert snap.version == 2 and len(snap["lof"]) == 2
+    assert np.isfinite(snap["lof"]).all()
+    snap = ing.apply(EdgeDelta.from_pairs(insert=[(0, 1), (1, 2), (2, 3)]))
+    assert len(snap["lof"]) == 4 and np.isfinite(snap["lof"]).all()
+
+
+def test_delta_check_samples_vary_across_applies(tmp_path, monkeypatch):
+    """The random half of the sampled exact check must rotate across
+    applies (seeded from the snapshot version) — a fixed seed would
+    re-probe the identical vertex set on every delta, gutting the
+    tripwire's long-run coverage outside the frontier."""
+    import graphmine_tpu.serve.delta as delta_mod
+
+    seen = []
+    real = delta_mod.sampled_exact_check
+
+    def spy(graph, labels, samples, kind="lpa", shards=None):
+        if kind == "lpa":
+            seen.append(np.asarray(samples).copy())
+        return real(graph, labels, samples, kind=kind, shards=shards)
+
+    monkeypatch.setattr(delta_mod, "sampled_exact_check", spy)
+    src, dst, v = _community_graph()
+    store, *_ = _publish_base(tmp_path, src, dst, v)
+    ing = DeltaIngestor(store, lof_k=4, check_samples=16)
+    # identical affected set {0, 1} both times: any sample difference is
+    # the rotating random half, not the frontier
+    ing.apply(EdgeDelta.from_pairs(insert=[(0, 1)]))
+    ing.apply(EdgeDelta.from_pairs(delete=[(0, 1)]))
+    assert len(seen) == 2
+    assert not np.array_equal(seen[0], seen[1])
+
+
 def test_weighted_snapshot_refused_by_ingestor(tmp_path):
     """A weighted run's snapshot keeps its weights array; the delta path
     must refuse it loudly — unweighted repair supersteps would silently
@@ -433,6 +562,99 @@ def test_sharded_ingestor_repair_matches_cold(tmp_path):
     cold_l, cold_c, _ = cold_recompute(build_graph(src2, dst2, num_vertices=v2))
     np.testing.assert_array_equal(snap["labels"], cold_l)
     np.testing.assert_array_equal(snap["cc_labels"], cold_c)
+    # a second, shape-changing delta (V grows past the pad boundary)
+    # exercises the shard jit-cache eviction path and must still repair
+    delta2 = EdgeDelta.from_pairs(
+        insert=[(i, 26) for i in range(41, 50)]
+    )
+    snap2 = ing.apply(delta2)
+    clean2, _ = validate_delta(delta2, v2)
+    src3, dst3, v3, _ = splice_edges(src2, dst2, v2, clean2)
+    cold_l3, cold_c3, _ = cold_recompute(
+        build_graph(src3, dst3, num_vertices=v3)
+    )
+    np.testing.assert_array_equal(snap2["labels"], cold_l3)
+    np.testing.assert_array_equal(snap2["cc_labels"], cold_c3)
+
+
+@pytest.mark.faults
+def test_sharded_fallback_routes_through_sharded_entries(tmp_path):
+    """Corrupted sharded repair must fall back through the SHARDED
+    check/recompute entries (the single-device funnel would OOM exactly
+    the working sets that needed sharding) and still republish labels
+    identical to the exact cold recompute."""
+    src, dst, v = _community_graph()
+    sink = _sink()
+    store, *_ = _publish_base(tmp_path, src, dst, v, sink=sink)
+    ing = DeltaIngestor(
+        store, sink=sink, lof_k=4, check_samples=16, num_shards=8
+    )
+    delta = EdgeDelta.from_pairs(insert=[(40, 12), (40, 13)])
+    inj = faults.FaultInjector()
+    inj.add("delta_repair", faults.poison_labels(shard=0, num_shards=8))
+    with inj.installed():
+        snap = ing.apply(delta)
+    assert inj.fired("delta_repair") == 1
+    rec = [r for r in sink.records if r["phase"] == "delta_apply"][-1]
+    assert rec["method"] == "full_recompute"
+    clean, _ = validate_delta(delta, v)
+    src2, dst2, v2, _ = splice_edges(src, dst, v, clean)
+    cold_l, cold_c, _ = cold_recompute(build_graph(src2, dst2, num_vertices=v2))
+    np.testing.assert_array_equal(snap["labels"], cold_l)
+    np.testing.assert_array_equal(snap["cc_labels"], cold_c)
+
+
+def test_sampled_exact_check_sharded_parity():
+    """The sharded one-superstep check must agree with the single-device
+    twin: a genuine fixpoint passes, a corrupted one fails."""
+    from graphmine_tpu.parallel.mesh import make_mesh
+    from graphmine_tpu.parallel.sharded import (
+        partition_graph,
+        shard_graph_arrays,
+    )
+    from graphmine_tpu.serve.delta import sampled_exact_check
+
+    src, dst, v = _community_graph()
+    g = build_graph(src, dst, num_vertices=v)
+    labels, cc, _ = cold_recompute(g)
+    mesh = make_mesh(8)
+    shards = (shard_graph_arrays(partition_graph(g, mesh=mesh), mesh), mesh)
+    samples = np.arange(v)
+    for kind, fix in (("lpa", labels), ("cc", cc)):
+        ok_s, _ = sampled_exact_check(g, fix, samples, kind=kind, shards=shards)
+        ok_1, _ = sampled_exact_check(g, fix, samples, kind=kind)
+        assert ok_s and ok_1
+        bad = fix.copy()
+        bad[5] = (int(bad[5]) + 1) % v  # in-range but wrong
+        ok_s, _ = sampled_exact_check(g, bad, samples, kind=kind, shards=shards)
+        ok_1, _ = sampled_exact_check(g, bad, samples, kind=kind)
+        assert not ok_s and not ok_1
+
+
+def test_sharded_cold_recompute_livelock_parity():
+    """Period-2 LPA livelock (complete bipartite): the sharded cold
+    recompute must land on the same cycle-stopped labels as the
+    single-device oracle, not a budget-parity-dependent cycle phase."""
+    from graphmine_tpu.parallel.mesh import make_mesh
+    from graphmine_tpu.parallel.sharded import (
+        partition_graph,
+        shard_graph_arrays,
+    )
+    from graphmine_tpu.serve.delta import _warm_lpa
+
+    a, b = np.arange(0, 3), np.arange(3, 6)
+    s, d = np.meshgrid(a, b)
+    src = s.ravel().astype(np.int32)
+    dst = d.ravel().astype(np.int32)
+    g = build_graph(src, dst, num_vertices=6)
+    _, _, conv = _warm_lpa(g, np.arange(6, dtype=np.int32), 64)
+    assert not conv, "fixture must genuinely livelock"
+    mesh = make_mesh(2)
+    shards = (shard_graph_arrays(partition_graph(g, mesh=mesh), mesh), mesh)
+    l1, c1, _ = cold_recompute(g)
+    ls, cs, _ = cold_recompute(g, shards=shards)
+    np.testing.assert_array_equal(ls, l1)
+    np.testing.assert_array_equal(cs, c1)
 
 
 def test_streaming_lof_seeded_centers_skip_training():
@@ -464,6 +686,14 @@ def test_query_engine_single_and_batched_agree(tmp_path):
         assert batch["component"][i] == eng.component(vtx) == cc[vtx]
         assert batch["lof"][i] == pytest.approx(eng.score(vtx))
         assert batch["community_size"][i] == eng.community_size(vtx)
+    # every batch length resolves correctly through the padded device
+    # gather (ids are bucketed to powers of two; results must be exact
+    # prefixes, never padding rows)
+    for n in (1, 2, 3, 4, 5):
+        part = eng.query_batch(ids[:n])
+        np.testing.assert_array_equal(part["label"], batch["label"][:n])
+        np.testing.assert_array_equal(part["lof"], batch["lof"][:n])
+        assert len(part["component"]) == n
     # neighbors: one CSR row == the graph's message neighborhood
     nbrs = eng.neighbors(0)
     assert sorted(set(nbrs.tolist())) == list(range(1, 12))
@@ -483,6 +713,11 @@ def test_query_engine_single_and_batched_agree(tmp_path):
         eng.membership(v + 7)
     with pytest.raises(KeyError):
         eng.query_batch([0, v + 7])
+    # wire hygiene matches the delta path: integral floats ok,
+    # fractional ids never silently truncate to the wrong vertex
+    assert eng.query_batch([3.0])["label"][0] == labels[3]
+    with pytest.raises(ValueError, match="integers"):
+        eng.query_batch([1.5])
     with pytest.raises(KeyError):
         eng.top_outliers(10**6, 3)
 
@@ -554,6 +789,33 @@ def test_server_swap_under_live_queries(tmp_path):
     finally:
         server.stop()
     assert validate_records(sink.records) == []
+
+
+def test_server_rejects_null_fields_with_400(tmp_path):
+    """Malformed-but-parseable JSON (null where a list belongs) must get
+    a 400 JSON error, never a killed connection — the serving layer's
+    never-crash-on-bad-input contract — and the server keeps serving."""
+    from graphmine_tpu.serve.server import SnapshotServer
+
+    src, dst, v = _community_graph()
+    store, *_ = _publish_base(tmp_path, src, dst, v)
+    server = SnapshotServer(store)
+    host, port = server.start()
+    try:
+        for path, payload in (
+            ("/query", {"vertices": None}),
+            ("/query", {"vertices": [1, None]}),
+            ("/query", {"vertices": [1.5]}),
+            ("/delta", {"insert": [[1, 2]], "delete": None}),
+            ("/delta", {"insert": [[1.9, 2.7]]}),
+        ):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(host, port, path, payload)
+            assert e.value.code == 400
+        # still alive and consistent afterwards
+        assert _get(host, port, "/healthz")["version"] == 1
+    finally:
+        server.stop()
 
 
 # ---- driver / obs integration ---------------------------------------------
