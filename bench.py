@@ -836,21 +836,39 @@ def main_serve() -> None:
         t_load = time.perf_counter() - t0
         engine = QueryEngine(snap)
 
-        # single-vertex loop (the naive client) vs the batched gather
+        # single-vertex loop (the naive client) vs the batched gather;
+        # per-op latencies are kept so the record carries QUANTILES, not
+        # just the mean — the tail is the serving SLO number, and the
+        # next silicon window should capture p99 alongside throughput
+        # (ROADMAP silicon-capture backlog).
         ids = rng.integers(0, v, 1 << 12).astype(np.int64)
         for vtx in ids[:64]:  # warm caches/compiles outside the window
             engine.membership(int(vtx))
         engine.query_batch(ids)
+        single_lat = np.empty(len(ids))
         t0 = time.perf_counter()
-        for vtx in ids:
+        for i, vtx in enumerate(ids):
+            t_op = time.perf_counter()
             engine.membership(int(vtx))
             engine.score(int(vtx))
+            single_lat[i] = time.perf_counter() - t_op
         single_qps = len(ids) / (time.perf_counter() - t0)
         reps = 32
+        batch_lat = np.empty(reps)
         t0 = time.perf_counter()
-        for _ in range(reps):
+        for i in range(reps):
+            t_op = time.perf_counter()
             engine.query_batch(ids)
+            batch_lat[i] = time.perf_counter() - t_op
         batched_qps = reps * len(ids) / (time.perf_counter() - t0)
+
+        def _quantiles(lat):
+            p50, p95, p99 = np.percentile(lat, [50, 95, 99])
+            return {
+                "p50_us": round(float(p50) * 1e6, 2),
+                "p95_us": round(float(p95) * 1e6, 2),
+                "p99_us": round(float(p99) * 1e6, 2),
+            }
 
         # delta-apply vs cold recompute at three delta sizes. ONE
         # ingestor across the ladder — the steady-state shape: the LOF
@@ -927,6 +945,17 @@ def main_serve() -> None:
                     "snapshot_publish_seconds": round(t_publish, 3),
                     "snapshot_load_seconds": round(t_load, 3),
                     "cold_pipeline_seconds": round(t_cold_base, 2),
+                    # the SLO view of the same workload: tail latency per
+                    # single-vertex lookup PAIR (each timed window is one
+                    # membership + one score call, matching single_qps's
+                    # per-iteration unit) and per batched resolve
+                    # (seconds -> microseconds), plus the engine's
+                    # pad/gather/host stage split over the batched window
+                    "latency_quantiles": {
+                        "single_lookup_pair": _quantiles(single_lat),
+                        "batched_resolve": _quantiles(batch_lat),
+                    },
+                    "query_stages": engine.stage_snapshot(),
                     "delta_ladder": ladder,
                     "device": str(jax.devices()[0]),
                 },

@@ -6,8 +6,12 @@ builds on it, not the other way around):
 
 - :mod:`graphmine_tpu.obs.spans`      hierarchical span context
   (run_id -> phase -> rung -> superstep) with monotonic timings;
-- :mod:`graphmine_tpu.obs.registry`   counter/gauge registry with a
-  Prometheus-textfile exporter;
+- :mod:`graphmine_tpu.obs.registry`   counter/gauge/histogram registry
+  with a Prometheus exporter (textfile or the serve layer's live
+  ``GET /metrics``);
+- :mod:`graphmine_tpu.obs.histogram`  thread-safe, mergeable bucket
+  histograms with ``histogram_quantile``-style estimation — the
+  latency-distribution surface the serving SLO endpoints read;
 - :mod:`graphmine_tpu.obs.heartbeat`  periodic liveness records (a hung
   run is distinguishable from a dead one);
 - :mod:`graphmine_tpu.obs.schema`     the record-schema registry every
@@ -15,7 +19,15 @@ builds on it, not the other way around):
   ``tools/obs_report.py``).
 """
 
+from graphmine_tpu.obs.histogram import Histogram, HistogramFamily
 from graphmine_tpu.obs.registry import Registry
 from graphmine_tpu.obs.spans import Span, Tracer, new_run_id
 
-__all__ = ["Registry", "Span", "Tracer", "new_run_id"]
+__all__ = [
+    "Histogram",
+    "HistogramFamily",
+    "Registry",
+    "Span",
+    "Tracer",
+    "new_run_id",
+]

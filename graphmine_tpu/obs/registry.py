@@ -22,6 +22,12 @@ import os
 import re
 import threading
 
+from graphmine_tpu.obs.histogram import (
+    DEFAULT_LATENCY_BUCKETS,
+    Histogram,
+    HistogramFamily,
+)
+
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 
 
@@ -101,6 +107,47 @@ class Registry:
     def gauge(self, name: str, help: str = "") -> Gauge:
         return self._get(name, help, Gauge)
 
+    def histogram(
+        self, name: str, help: str = "", buckets=None, **labels
+    ) -> Histogram:
+        """Get-or-create one labeled child of the ``name`` histogram
+        family (``registry.histogram("req_seconds", endpoint="query")``).
+        The first call fixes the family's bucket ladder (default
+        :data:`~graphmine_tpu.obs.histogram.DEFAULT_LATENCY_BUCKETS`); a
+        later call naming a *different* ladder raises — merged/scraped
+        buckets must be one ladder per name, same as one TYPE per name.
+        """
+        if not _NAME_RE.match(name):
+            raise ValueError(
+                f"invalid metric name {name!r} (want [a-zA-Z_:][a-zA-Z0-9_:]*)"
+            )
+        with self._lock:
+            fam = self._metrics.get(name)
+            if fam is None:
+                fam = self._metrics[name] = HistogramFamily(
+                    name, help,
+                    DEFAULT_LATENCY_BUCKETS if buckets is None else buckets,
+                )
+            elif not isinstance(fam, HistogramFamily):
+                raise ValueError(
+                    f"metric {name!r} already registered as {fam.kind}"
+                )
+            elif buckets is not None and tuple(
+                float(b) for b in buckets
+            ) != fam.bounds:
+                raise ValueError(
+                    f"histogram {name!r} already registered with a "
+                    "different bucket ladder"
+                )
+        return fam.labels(**labels)
+
+    def histogram_family(self, name: str) -> HistogramFamily | None:
+        """The registered family (all labeled children) or None — how
+        ``/statusz`` walks every endpoint's latency distribution."""
+        with self._lock:
+            fam = self._metrics.get(name)
+        return fam if isinstance(fam, HistogramFamily) else None
+
     def values(self) -> dict:
         """Snapshot of every metric's current value, name-keyed."""
         with self._lock:
@@ -108,9 +155,17 @@ class Registry:
         return {m.name: m.value for m in metrics}
 
     def render_textfile(self, labels: dict | None = None) -> str:
-        """Prometheus text exposition (HELP/TYPE + one sample per metric).
-        ``labels`` (e.g. ``{"run_id": ...}``) attach to every sample so a
-        scrape distinguishes runs sharing one textfile directory."""
+        """Prometheus text exposition, **deterministically ordered** —
+        metrics sorted by name, histogram children by label set, label
+        keys within a sample alphabetically — so two scrapes of the same
+        state are byte-identical and successive scrapes diff cleanly.
+        Every metric gets a ``# TYPE`` line (``# HELP`` when help text
+        was registered). ``labels`` (e.g. ``{"run_id": ...}``) attach to
+        every sample so a scrape distinguishes runs sharing one textfile
+        directory. Histograms render per labeled child: cumulative
+        ``_bucket`` samples (``le`` ascending, ``+Inf`` last), ``_sum``,
+        ``_count`` — each child from one atomic snapshot, so a scrape
+        concurrent with ``observe`` is never torn."""
         lab = ""
         if labels:
             parts = ",".join(
@@ -125,7 +180,11 @@ class Registry:
             if m.help:
                 lines.append(f"# HELP {m.name} {m.help}")
             lines.append(f"# TYPE {m.name} {m.kind}")
-            lines.append(f"{m.name}{lab} {m.value}")
+            if isinstance(m, HistogramFamily):
+                for child in m.children():
+                    lines.extend(child.render_lines(extra_labels=labels))
+            else:
+                lines.append(f"{m.name}{lab} {m.value}")
         return "\n".join(lines) + ("\n" if lines else "")
 
     def write_textfile(self, path: str, labels: dict | None = None) -> str:

@@ -74,6 +74,15 @@ register("delta_apply", "inserts", "deletes", "method", "iterations",
 register("query_batch", "endpoint", "n", "seconds")
 register("repair_fallback", "stage", "reason")
 
+# ---- serving SLO records (docs/OBSERVABILITY.md "serving SLO") ------------
+# access_log: one per HTTP request through the serve middleware (slow
+# requests additionally carry slow/body_sha256/body_bytes); slo_rollup:
+# one per /statusz read — a periodic checkpoint of the quantile/debt
+# state so scrape-less runs still leave an SLO trail in the JSONL.
+register("access_log", "method", "endpoint", "status", "seconds",
+         "request_id")
+register("slo_rollup", "uptime_s", "endpoints", "repair_debt")
+
 # ---- recovery / resilience records (docs/RESILIENCE.md) -------------------
 register("retry", "stage", "attempt", "backoff_s", "error")
 register("retries_exhausted", "stage", "attempts", "error")

@@ -51,6 +51,18 @@ class MetricsSink:
     the run's counter/gauge registry (always present — callers increment
     unconditionally; it only *exports* when asked).
 
+    ``max_records``: optional in-memory cap for **long-lived serving
+    processes** (a batch run keeps the default: everything). The serve
+    layer emits one ``access_log`` record per HTTP request; retaining
+    them all in ``records`` would grow RSS linearly with traffic until
+    the server is OOM-killed. With a cap, the oldest records are
+    dropped once the list exceeds it — records already persisted by the
+    live stream lose nothing on disk, and :meth:`finalize` accounts for
+    the drops so it never re-appends or skips survivors. Callers doing
+    exit-time-only persistence with a cap are accepting bounded memory
+    over a complete exit dump (the serving CLI streams, so it never
+    hits that trade).
+
     Emission is thread-safe (the heartbeat thread and the driver thread
     share one sink); each record is appended and streamed under one lock.
     """
@@ -59,9 +71,13 @@ class MetricsSink:
     stream_path: str | None = None
     tracer: object | None = None
     registry: Registry = field(default_factory=Registry, repr=False)
+    max_records: int | None = None
     _stream: object = field(default=None, repr=False)
     _stream_ok: bool = field(default=True, repr=False)
     _streamed: int = field(default=0, repr=False)
+    _dropped: int = field(default=0, repr=False)
+    _lost: int = field(default=0, repr=False)
+    _lost_warned: bool = field(default=False, repr=False)
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def emit(self, phase: str, _span=None, **kv) -> dict:
@@ -97,6 +113,33 @@ class MetricsSink:
                         "metrics stream to %s failed: %r; records will be "
                         "written at exit instead", self.stream_path, e,
                     )
+            if (
+                self.max_records is not None
+                and len(self.records) > self.max_records
+            ):
+                drop = len(self.records) - self.max_records
+                # Dropped records with a global index past the streamed
+                # prefix were never persisted anywhere — count them and
+                # say so ONCE, or the 'written at exit instead' promise
+                # emit makes when the stream dies becomes a silent lie
+                # under the cap.
+                lost = max(
+                    0,
+                    (self._dropped + drop)
+                    - max(self._streamed, self._dropped),
+                )
+                del self.records[:drop]
+                self._dropped += drop
+                if lost:
+                    self._lost += lost
+                    if not self._lost_warned:
+                        self._lost_warned = True
+                        log.warning(
+                            "max_records=%d dropped record(s) the stream "
+                            "never persisted (running total tracked; "
+                            "%d so far) — they will NOT appear in any "
+                            "exit-time dump", self.max_records, self._lost,
+                        )
         return rec
 
     @contextlib.contextmanager
@@ -179,7 +222,13 @@ class MetricsSink:
             self._stream = None
             if self._stream_ok and self.stream_path == path:
                 return path
-        start = self._streamed if path == self.stream_path else 0
+        # max_records drops shift list positions: the first
+        # never-streamed record sits at streamed-minus-dropped (dropped
+        # records were, by the emit-order invariant, streamed first).
+        start = (
+            max(0, self._streamed - self._dropped)
+            if path == self.stream_path else 0
+        )
         # A stream that died mid-write (ENOSPC, EIO) can leave a torn
         # final line; appending straight after it would merge the torn
         # prefix with the first record below into one unparseable line.

@@ -20,7 +20,12 @@ forced a cold full recompute. This package is the steady-state side
   live queries.
 """
 
-from graphmine_tpu.serve.delta import DeltaIngestor, EdgeDelta, RepairResult
+from graphmine_tpu.serve.delta import (
+    DeltaIngestor,
+    EdgeDelta,
+    RepairDebt,
+    RepairResult,
+)
 from graphmine_tpu.serve.query import QueryEngine
 from graphmine_tpu.serve.snapshot import Snapshot, SnapshotStore
 
@@ -28,6 +33,7 @@ __all__ = [
     "DeltaIngestor",
     "EdgeDelta",
     "QueryEngine",
+    "RepairDebt",
     "RepairResult",
     "Snapshot",
     "SnapshotStore",

@@ -44,7 +44,9 @@ centers, so each delta scores only the affected vertices' features.
 from __future__ import annotations
 
 import math
+import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -431,6 +433,153 @@ class RepairResult:
     iterations: int               # supersteps the winning path ran (LPA + CC)
     fallback_reason: str | None = None
     checked_samples: int = 0
+    budget: int = 0               # frontier budget the warm attempt was granted
+
+
+class RepairDebt:
+    """Host-side ledger of how far behind serving-state repair is.
+
+    The write-heavy-serving rungs the ROADMAP names next (delta
+    coalescing, admission control, load shedding) all need ONE signal:
+    how much un-repaired work has accumulated, and how fast repairs are
+    keeping up. This ledger is that signal, fed from the two ends of the
+    delta path:
+
+    - :meth:`submitted` when a delta batch *arrives* (the HTTP handler,
+      before it queues on the publish lock) — pending rows and the
+      arrival time of the oldest unapplied batch (**ingest lag**: how
+      stale the served snapshot is against accepted writes);
+    - :meth:`applied` when the ingestor *publishes* — drains the oldest
+      pending entry and accrues the repair economics: warm vs
+      full-recompute counts (the warm ratio is the number the serve
+      bench tier exists to improve), supersteps spent vs the frontier
+      budget granted (a budget fraction pinned near 1.0 means deltas
+      are one graph-growth away from the fallback cliff).
+
+    Pure host bookkeeping under one lock — nothing here touches a
+    device, so the repair hot path's compiled programs are untouched.
+    When a ``registry`` is given, the ledger mirrors itself into
+    scrapeable gauges/counters on every event.
+    """
+
+    def __init__(self, registry=None):
+        self._lock = threading.Lock()
+        self._pending: deque = deque()   # (t_submitted, rows) FIFO
+        self._pending_rows = 0
+        self.applies_warm = 0
+        self.applies_cold = 0
+        self.supersteps_total = 0
+        self.budget_granted_total = 0
+        self.last_budget_frac = 0.0
+        self.rows_applied_total = 0
+        self._registry = registry
+
+    def submitted(self, rows: int, t: float | None = None) -> None:
+        """One delta batch accepted (``rows`` = insert + delete rows)."""
+        with self._lock:
+            self._pending.append((time.time() if t is None else t, int(rows)))
+            self._pending_rows += int(rows)
+        self._export()
+
+    def applied(self, method: str, iterations: int, budget: int) -> None:
+        """One delta batch published; drains the oldest pending entry
+        (no-op on the pending side when the ingestor is driven directly,
+        without a front end calling :meth:`submitted`)."""
+        with self._lock:
+            if self._pending:
+                _, rows = self._pending.popleft()
+                self._pending_rows -= rows
+                self.rows_applied_total += rows
+            if method == "warm":
+                self.applies_warm += 1
+            else:
+                self.applies_cold += 1
+            self.supersteps_total += int(iterations)
+            self.budget_granted_total += int(budget)
+            self.last_budget_frac = (
+                round(int(iterations) / int(budget), 4) if budget else 0.0
+            )
+        reg = self._registry
+        if reg is not None:
+            reg.counter(
+                "graphmine_serve_repairs_warm_total",
+                "delta applies repaired warm",
+            ).inc(1 if method == "warm" else 0)
+            reg.counter(
+                "graphmine_serve_repairs_cold_total",
+                "delta applies that fell back to full recompute",
+            ).inc(0 if method == "warm" else 1)
+            reg.counter(
+                "graphmine_serve_repair_supersteps_total",
+                "repair supersteps spent across all delta applies",
+            ).inc(int(iterations))
+        self._export()
+
+    @property
+    def applies_total(self) -> int:
+        """Settled applies (warm + cold) — the caller's marker for "did
+        my apply get as far as settling its debt before it raised"."""
+        with self._lock:
+            return self.applies_warm + self.applies_cold
+
+    def abandoned(self) -> None:
+        """A submitted batch will never publish (validation raised, the
+        ingestor refused the snapshot): drop the oldest pending entry so
+        the ledger doesn't report a phantom backlog forever. FIFO is an
+        approximation under concurrent submitters — the ledger is
+        advisory telemetry, and totals rebalance as the queue drains."""
+        with self._lock:
+            if self._pending:
+                _, rows = self._pending.popleft()
+                self._pending_rows -= rows
+        self._export()
+
+    def ingest_lag_s(self, now: float | None = None) -> float:
+        """Age of the oldest accepted-but-unapplied delta (0.0 when the
+        queue is drained) — the staleness bound a load balancer reads."""
+        with self._lock:
+            if not self._pending:
+                return 0.0
+            return max(0.0, (time.time() if now is None else now)
+                       - self._pending[0][0])
+
+    def snapshot(self) -> dict:
+        """One JSON-ready read of the whole ledger."""
+        lag = self.ingest_lag_s()
+        with self._lock:
+            applies = self.applies_warm + self.applies_cold
+            return {
+                "pending_deltas": len(self._pending),
+                "pending_rows": self._pending_rows,
+                "ingest_lag_s": round(lag, 4),
+                "applies_warm": self.applies_warm,
+                "applies_cold": self.applies_cold,
+                "warm_ratio": (
+                    round(self.applies_warm / applies, 4) if applies else 1.0
+                ),
+                "supersteps_total": self.supersteps_total,
+                "budget_granted_total": self.budget_granted_total,
+                "last_budget_frac": self.last_budget_frac,
+                "rows_applied_total": self.rows_applied_total,
+            }
+
+    def _export(self) -> None:
+        reg = self._registry
+        if reg is None:
+            return
+        snap = self.snapshot()
+        reg.gauge(
+            "graphmine_serve_repair_debt_rows",
+            "delta rows accepted but not yet repaired/published",
+        ).set(snap["pending_rows"])
+        reg.gauge(
+            "graphmine_serve_ingest_lag_seconds",
+            "age of the oldest accepted-but-unapplied delta batch",
+        ).set(snap["ingest_lag_s"])
+        reg.gauge(
+            "graphmine_serve_repair_budget_frac",
+            "supersteps used / frontier budget granted, last apply",
+        ).set(snap["last_budget_frac"])
 
 
 def cold_recompute(graph, budget: int = 0, shards=None):
@@ -541,6 +690,7 @@ def _verify_or_fallback(
         return RepairResult(
             labels=labels, cc_labels=cc, method="warm",
             iterations=iterations, checked_samples=len(samples),
+            budget=budget,
         )
     if sink is not None:
         sink.emit("repair_fallback", stage="delta_repair", reason=reason)
@@ -548,7 +698,7 @@ def _verify_or_fallback(
     return RepairResult(
         labels=labels, cc_labels=cc, method="full_recompute",
         iterations=it, fallback_reason=reason,
-        checked_samples=len(samples),
+        checked_samples=len(samples), budget=budget,
     )
 
 
@@ -613,11 +763,19 @@ class DeltaIngestor:
         check_samples: int = 64,
         num_shards: int = 1,
         snapshot: Snapshot | None = None,
+        debt: RepairDebt | None = None,
     ):
         self.store = store
         self.sink = sink
         self.check_samples = check_samples
         self.num_shards = num_shards
+        # Repair-debt ledger (docs/OBSERVABILITY.md "serving SLO"): the
+        # front end owns one and shares it here so the pending side
+        # survives ingestor rebasing on /reload; a bare ingestor gets a
+        # private ledger so the delta_apply record always carries debt.
+        self.debt = debt if debt is not None else RepairDebt(
+            registry=sink.registry if sink is not None else None
+        )
         snap = snapshot if snapshot is not None else store.load(sink=sink)
         if snap is None:
             raise ValueError(
@@ -843,6 +1001,12 @@ class DeltaIngestor:
                 sink=self.sink,
             )
             self.snapshot = snap
+            # Settle the debt ledger BEFORE emitting, so the record's
+            # repair_debt snapshot reflects this apply as drained.
+            self.debt.applied(
+                method=result.method, iterations=result.iterations,
+                budget=result.budget,
+            )
             if self.sink is not None:
                 self.sink.emit(
                     "delta_apply",
@@ -850,6 +1014,7 @@ class DeltaIngestor:
                     deletes=stats["deleted"],
                     method=result.method,
                     iterations=result.iterations,
+                    budget=result.budget,
                     quarantine=quarantine,
                     affected=len(aff),
                     version=snap.version,
@@ -862,6 +1027,9 @@ class DeltaIngestor:
                     # apply of an ingestor's lifetime)
                     repair_seconds=round(repair_seconds, 4),
                     lof_seconds=round(lof_seconds, 4),
+                    # the repair-debt ledger as of this publish — the
+                    # obs_report SLO section's debt-timeline raw material
+                    repair_debt=self.debt.snapshot(),
                 )
         return snap
 

@@ -21,6 +21,9 @@ construction once, then every lookup is a contiguous slice):
 
 from __future__ import annotations
 
+import threading
+import time
+
 import numpy as np
 
 from graphmine_tpu.serve.snapshot import Snapshot
@@ -50,12 +53,24 @@ def _as_int_ids(values, what: str) -> np.ndarray:
 
 class QueryEngine:
     """Immutable per-snapshot read index. Thread-safe by construction
-    (nothing mutates after ``__init__``), which is what lets the server
-    double-buffer: in-flight requests keep serving the engine they
-    grabbed while a delta publish swaps the reference under them."""
+    (nothing mutates after ``__init__`` — the one exception is the
+    lock-guarded stage-timing accumulator, which is advisory telemetry,
+    never read by a query), which is what lets the server double-buffer:
+    in-flight requests keep serving the engine they grabbed while a
+    delta publish swaps the reference under them."""
 
     def __init__(self, snapshot: Snapshot, device: bool = True):
         self.snapshot = snapshot
+        # Stage-split accounting for the batched path (docs/OBSERVABILITY
+        # "serving SLO"): host wall-clock around stages that already
+        # exist — pad (validate + power-of-two pad), gather (device
+        # gather + the np.asarray transfer that was always the sync
+        # point), host (response assembly). Zero added device syncs.
+        self._stage_lock = threading.Lock()
+        self._stages = {
+            "batches": 0, "ids": 0,
+            "pad_seconds": 0.0, "gather_seconds": 0.0, "host_seconds": 0.0,
+        }
         self.labels = np.asarray(snapshot["labels"], np.int32)
         v = len(self.labels)
         self.num_vertices = v
@@ -133,6 +148,18 @@ class QueryEngine:
     def version(self) -> int:
         return self.snapshot.version
 
+    def stage_snapshot(self) -> dict:
+        """Accumulated batched-path stage split since this engine was
+        built (engines die at snapshot swap, so the window is one served
+        version): batches/ids resolved and pad/gather/host seconds —
+        ``/statusz`` serves it so a p99 spike triages to the stage that
+        actually moved (RUNBOOKS §7) instead of "the device is slow"."""
+        with self._stage_lock:
+            out = dict(self._stages)
+        for k in ("pad_seconds", "gather_seconds", "host_seconds"):
+            out[k] = round(out[k], 6)
+        return out
+
     # -- single lookups ----------------------------------------------------
     def _check(self, vertex: int) -> int:
         vertex = int(vertex)
@@ -198,6 +225,7 @@ class QueryEngine:
         "lof"}`` as aligned arrays. Out-of-range ids raise (the HTTP
         layer turns that into a 400, never a wrong answer).
         """
+        t0 = time.perf_counter()
         ids = _as_int_ids(vertices, "vertex").reshape(-1)
         if len(ids) and (ids.min() < 0 or ids.max() >= self.num_vertices):
             bad = ids[(ids < 0) | (ids >= self.num_vertices)]
@@ -215,15 +243,26 @@ class QueryEngine:
             cap = 1 << max(0, (n - 1).bit_length())
             padded = np.zeros(cap, np.int32)
             padded[:n] = ids
+            t1 = time.perf_counter()
             ints, lof = self._gather(self._dev[0], self._dev[1], padded)
             ints = np.asarray(ints)[:, :n]
             lof = np.asarray(lof)[:n]
         else:
+            t1 = time.perf_counter()
             ints, lof = self._table[:, ids], self.lof[ids]
-        return {
+        t2 = time.perf_counter()
+        out = {
             "vertex": ids,
             "label": ints[0],
             "component": ints[1],
             "community_size": ints[2],
             "lof": lof,
         }
+        t3 = time.perf_counter()
+        with self._stage_lock:
+            self._stages["batches"] += 1
+            self._stages["ids"] += len(ids)
+            self._stages["pad_seconds"] += t1 - t0
+            self._stages["gather_seconds"] += t2 - t1
+            self._stages["host_seconds"] += t3 - t2
+        return out

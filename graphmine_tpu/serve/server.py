@@ -8,10 +8,17 @@ in-flight requests keep the engine they grabbed at entry, so a publish
 never drops or torn-reads a live query (pinned by
 ``tests/test_serve.py::test_server_swap_under_live_queries``).
 
-Endpoints (all JSON):
+Endpoints (JSON unless noted):
 
 ====================  =====================================================
-``GET  /healthz``      liveness + current snapshot version
+``GET  /healthz``      liveness + snapshot version, **snapshot age and
+                       repair debt** (a load balancer drains a replica
+                       whose served state has gone stale)
+``GET  /statusz``      the SLO page: uptime, in-flight count, per-endpoint
+                       latency quantiles (p50/p95/p99), error rates,
+                       repair-debt ledger, batched-query stage split
+``GET  /metrics``      live Prometheus text exposition (counters, gauges,
+                       request-latency histogram buckets)
 ``GET  /snapshot``     current snapshot manifest metadata
 ``GET  /vertex?v=``    one vertex: label, component, LOF, size, decile
 ``GET  /neighbors?v=`` neighbor ids of one vertex
@@ -21,16 +28,24 @@ Endpoints (all JSON):
 ``POST /reload``       reload the store's newest snapshot and swap
 ====================  =====================================================
 
-Observability: every batch resolve emits a ``query_batch`` record, every
-delta a ``delta_apply`` (from the ingestor) and the store a
-``snapshot_publish`` — all span-stamped through the sink's tracer and
-rendered by ``tools/obs_report.py``; the counter/gauge registry exports
-through the existing Prometheus textfile path (``prom_out``).
+**Request observability** (docs/OBSERVABILITY.md "serving SLO"): every
+request runs through one timing middleware — wall time observed into a
+per-endpoint bucket histogram (``graphmine_serve_request_seconds``), an
+``access_log`` record emitted per request (schema-registered; requests
+slower than ``slow_request_s`` also carry the request body's sha256
+digest, so a pathological batch is identifiable without logging its
+payload), and an ``X-Request-Id`` stamped on every response — propagated
+from the client when provided, generated otherwise, and carried by the
+record alongside the sink's span identity so one slow request joins the
+span timeline and the offline JSONL alike.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
+import re
+import secrets
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -38,9 +53,35 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
-from graphmine_tpu.serve.delta import DeltaIngestor, EdgeDelta
+from graphmine_tpu.obs.registry import Registry
+from graphmine_tpu.serve.delta import DeltaIngestor, EdgeDelta, RepairDebt
 from graphmine_tpu.serve.query import QueryEngine
 from graphmine_tpu.serve.snapshot import SnapshotStore
+
+# Client-supplied request ids are echoed into headers, records and logs:
+# constrain them so a hostile header can't smuggle newlines/quotes.
+_REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9._:-]{1,64}$")
+
+# One table per method, mapping path -> _Handler method name. The SAME
+# table resolves the histogram/access_log endpoint label (the path minus
+# its slash) and dispatches the request, so a route can never exist in
+# one place and not the other; unlisted paths 404 and share one
+# "unknown" metric bucket (client typos must not mint unbounded label
+# cardinality).
+_GET_ROUTES = {
+    "/healthz": "_ep_healthz",
+    "/statusz": "_ep_statusz",
+    "/metrics": "_ep_metrics",
+    "/snapshot": "_ep_snapshot",
+    "/vertex": "_ep_vertex",
+    "/neighbors": "_ep_neighbors",
+    "/topk": "_ep_topk",
+}
+_POST_ROUTES = {
+    "/query": "_ep_query",
+    "/delta": "_ep_delta",
+    "/reload": "_ep_reload",
+}
 
 
 def _jsonable(obj):
@@ -68,11 +109,19 @@ class SnapshotServer:
         sink=None,
         prom_out: str | None = None,
         num_shards: int = 1,
+        slow_request_s: float = 1.0,
     ):
         self.store = store
         self.sink = sink
         self.prom_out = prom_out
         self.num_shards = num_shards
+        self.slow_request_s = float(slow_request_s)
+        # The metric surface exists with or without a record sink: a
+        # sinkless server still serves /metrics and /statusz.
+        self.registry: Registry = (
+            sink.registry if sink is not None else Registry()
+        )
+        self.debt = RepairDebt(registry=self.registry)
         snap = store.load(sink=sink)
         if snap is None:
             raise ValueError(
@@ -89,6 +138,11 @@ class SnapshotServer:
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self._host, self._port = host, port
+        self._t0_wall = time.time()
+        self._t0_mono = time.perf_counter()
+        self._inflight = 0
+        self._req_lock = threading.Lock()
+        self._endpoint_errors: dict = {}
         self._export_metrics()
 
     # -- lifecycle --------------------------------------------------------
@@ -125,16 +179,23 @@ class SnapshotServer:
         self._engine = engine  # atomic ref swap: the double-buffer flip
         self._export_metrics()
 
+    def _run_labels(self) -> dict | None:
+        """The run_id label BOTH exposition paths attach — the textfile
+        and the live scrape must emit the same series, or a deployment
+        scraping both double-counts every sample."""
+        tracer = getattr(self.sink, "tracer", None)
+        return {"run_id": tracer.run_id} if tracer is not None else None
+
     def _export_metrics(self) -> None:
-        if self.sink is None:
-            return
-        self.sink.registry.gauge(
+        self.registry.gauge(
             "graphmine_serve_snapshot_version",
             "snapshot version currently serving queries",
         ).set(self._engine.version)
         if self.prom_out:
             try:
-                self.sink.registry.write_textfile(self.prom_out)
+                self.registry.write_textfile(
+                    self.prom_out, labels=self._run_labels()
+                )
             except OSError:
                 pass  # metrics export must never take queries down
 
@@ -160,24 +221,186 @@ class SnapshotServer:
         delta = EdgeDelta.from_pairs(
             insert=payload.get("insert", ()), delete=payload.get("delete", ())
         )
+        # Debt accrues at ACCEPTANCE: batches queued on the publish lock
+        # are pending work the ledger (and /healthz) must already see.
+        self.debt.submitted(delta.num_inserts + delta.num_deletes)
         with self._delta_lock:
-            if self._ingestor is None:
-                self._ingestor = DeltaIngestor(
-                    self.store, sink=self.sink, num_shards=self.num_shards,
-                    snapshot=self._engine.snapshot,
-                )
-            snap = self._ingestor.apply(delta)
+            # Applies settle the ledger inside apply(); they are
+            # serialized on this lock, so an unchanged applies_total at
+            # a raise means THIS batch never settled — drop its pending
+            # entry. (An apply that raised after settling — or a failing
+            # engine build on the already-published snapshot — must NOT
+            # drain a second entry belonging to a batch queued behind
+            # us.)
+            settled_before = self.debt.applies_total
+            try:
+                if self._ingestor is None:
+                    self._ingestor = DeltaIngestor(
+                        self.store, sink=self.sink,
+                        num_shards=self.num_shards,
+                        snapshot=self._engine.snapshot, debt=self.debt,
+                    )
+                snap = self._ingestor.apply(delta)
+            except BaseException:
+                if self.debt.applies_total == settled_before:
+                    self.debt.abandoned()
+                raise
             self._swap(QueryEngine(snap))
-        if self.sink is not None:
-            self.sink.registry.counter(
-                "graphmine_serve_deltas_total", "delta batches ingested"
-            ).inc()
+        self.registry.counter(
+            "graphmine_serve_deltas_total", "delta batches ingested"
+        ).inc()
         return {
             "version": snap.version,
             "snapshot_id": snap.snapshot_id,
             "num_vertices": int(len(snap["labels"])),
             "num_edges": int(len(snap["src"])),
         }
+
+    # -- SLO surfaces -----------------------------------------------------
+    def healthz(self) -> dict:
+        """Liveness + staleness: version, snapshot age, repair debt —
+        enough for a load balancer to drain a replica serving stale
+        results without a second round trip."""
+        eng = self._engine
+        debt = self.debt.snapshot()
+        return {
+            "ok": True,
+            "version": eng.version,
+            "snapshot_id": eng.snapshot.snapshot_id,
+            "num_vertices": eng.num_vertices,
+            "snapshot_age_s": self._snapshot_age_s(eng),
+            "repair_debt_rows": debt["pending_rows"],
+            "ingest_lag_s": debt["ingest_lag_s"],
+        }
+
+    def _snapshot_age_s(self, eng: QueryEngine) -> float:
+        created = eng.snapshot.meta.get("created")
+        base = float(created) if created else self._t0_wall
+        return round(max(0.0, time.time() - base), 3)
+
+    def endpoint_latency(self) -> dict:
+        """Per-endpoint latency/error summary from the request histogram
+        family: count, errors, error_rate, p50/p95/p99 (bucket-estimated
+        — within one bucket of the exact offline quantiles from the
+        ``access_log`` JSONL, the ``tests/test_slo.py`` acceptance)."""
+        fam = self.registry.histogram_family("graphmine_serve_request_seconds")
+        out: dict = {}
+        if fam is None:
+            return out
+        with self._req_lock:
+            errors = dict(self._endpoint_errors)
+        for child in fam.children():
+            ep = child.labels.get("endpoint", "?")
+            snap = child.snapshot()
+            if not snap.count:
+                continue
+            err = errors.get(ep, 0)
+            out[ep] = {
+                "count": snap.count,
+                "errors": err,
+                "error_rate": round(err / snap.count, 4),
+                "mean_s": round(snap.sum / snap.count, 6),
+                "p50_s": round(snap.quantile(0.50), 6),
+                "p95_s": round(snap.quantile(0.95), 6),
+                "p99_s": round(snap.quantile(0.99), 6),
+            }
+        return out
+
+    def statusz(self) -> dict:
+        """The SLO page — and, when a sink is attached, one
+        ``slo_rollup`` record per read, so the offline JSONL carries
+        periodic rollup checkpoints a scrape-less run can still plot."""
+        eng = self._engine
+        with self._req_lock:
+            inflight = self._inflight
+        payload = {
+            "version": eng.version,
+            "snapshot_id": eng.snapshot.snapshot_id,
+            "snapshot_age_s": self._snapshot_age_s(eng),
+            "uptime_s": round(time.perf_counter() - self._t0_mono, 3),
+            "inflight": inflight,
+            "endpoints": self.endpoint_latency(),
+            "repair_debt": self.debt.snapshot(),
+            "query_stages": eng.stage_snapshot(),
+        }
+        if self.sink is not None:
+            self.sink.emit(
+                "slo_rollup",
+                uptime_s=payload["uptime_s"],
+                endpoints=payload["endpoints"],
+                repair_debt=payload["repair_debt"],
+                version=payload["version"],
+                inflight=inflight,
+            )
+        return payload
+
+    def metrics_text(self) -> str:
+        """Live Prometheus exposition — the same deterministic rendering
+        (and the same run_id labels) as the textfile path, served hot."""
+        return self.registry.render_textfile(labels=self._run_labels())
+
+    # -- request middleware hooks -----------------------------------------
+    def _inflight_gauge(self):
+        return self.registry.gauge(
+            "graphmine_serve_inflight_requests",
+            "requests currently being handled",
+        )
+
+    def request_started(self) -> None:
+        # The gauge set stays under _req_lock: two racing updates setting
+        # out of order would park the gauge on a stale value forever.
+        gauge = self._inflight_gauge()
+        with self._req_lock:
+            self._inflight += 1
+            gauge.set(self._inflight)
+
+    def request_finished(
+        self, method: str, endpoint: str, status: int, seconds: float,
+        request_id: str, body: bytes = b"",
+    ) -> None:
+        """The middleware tail: histogram observe + counters +
+        ``access_log`` record. Runs on every request, including errored
+        ones — an SLO page that only counts successes is lying about the
+        tail."""
+        gauge = self._inflight_gauge()
+        with self._req_lock:
+            self._inflight -= 1
+            gauge.set(self._inflight)
+            if status >= 400:
+                self._endpoint_errors[endpoint] = (
+                    self._endpoint_errors.get(endpoint, 0) + 1
+                )
+        reg = self.registry
+        reg.histogram(
+            "graphmine_serve_request_seconds",
+            "HTTP request wall time by endpoint",
+            endpoint=endpoint,
+        ).observe(seconds)
+        reg.counter(
+            "graphmine_serve_http_requests_total", "HTTP requests handled"
+        ).inc()
+        if status >= 400:
+            reg.counter(
+                "graphmine_serve_http_errors_total",
+                "HTTP requests answered with a 4xx/5xx status",
+            ).inc()
+        if self.sink is None:
+            return
+        kv = {
+            "method": method,
+            "endpoint": endpoint,
+            "status": int(status),
+            "seconds": round(seconds, 6),
+            "request_id": request_id,
+        }
+        if seconds >= self.slow_request_s:
+            # Identify the offending payload without logging it: the
+            # digest joins a client-side replay to this exact request.
+            kv["slow"] = True
+            if body:
+                kv["body_sha256"] = hashlib.sha256(body).hexdigest()
+                kv["body_bytes"] = len(body)
+        self.sink.emit("access_log", **kv)
 
     # -- query plumbing (shared with serve_cli's in-process mode) ---------
     def vertex_row(self, engine: QueryEngine, v: int) -> dict:
@@ -191,13 +414,12 @@ class SnapshotServer:
         }
 
     def record_batch(self, endpoint: str, n: int, seconds: float) -> None:
-        if self.sink is None:
-            return
-        self.sink.emit(
-            "query_batch", endpoint=endpoint, n=int(n),
-            seconds=round(seconds, 6),
-        )
-        self.sink.registry.counter(
+        if self.sink is not None:
+            self.sink.emit(
+                "query_batch", endpoint=endpoint, n=int(n),
+                seconds=round(seconds, 6),
+            )
+        self.registry.counter(
             "graphmine_serve_queries_total", "vertex lookups served"
         ).inc(n)
 
@@ -206,15 +428,25 @@ class _Handler(BaseHTTPRequestHandler):
     srv: SnapshotServer  # bound by SnapshotServer.start
 
     # stdlib default logs every request to stderr; the metrics stream is
-    # the intended record of serving traffic.
+    # the intended record of serving traffic (access_log records).
     def log_message(self, fmt, *args):  # noqa: A003
         pass
 
     def _reply(self, code: int, payload: dict) -> None:
         body = json.dumps(_jsonable(payload)).encode()
+        self._send(code, body, "application/json")
+
+    def _reply_text(self, code: int, text: str, content_type: str) -> None:
+        self._send(code, text.encode(), content_type)
+
+    def _send(self, code: int, body: bytes, content_type: str) -> None:
+        self._status = code
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        rid = getattr(self, "_request_id", None)
+        if rid:
+            self.send_header("X-Request-Id", rid)
         self.end_headers()
         self.wfile.write(body)
 
@@ -225,71 +457,125 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         if length <= 0:
             return {}
-        data = json.loads(self.rfile.read(length).decode())
+        self._raw_body = self.rfile.read(length)
+        data = json.loads(self._raw_body.decode())
         if not isinstance(data, dict):
             raise ValueError("request body must be a JSON object")
         return data
 
-    def do_GET(self) -> None:  # noqa: N802
+    # -- the timing middleware --------------------------------------------
+    def _serve(self, method: str, routes: dict) -> None:
+        """One wrapper around every request: resolve the handler AND the
+        endpoint label from the same route table, stamp/propagate the
+        trace id, time the full handle, and ALWAYS run the middleware
+        tail — histogram + counters + access_log — even when the handler
+        errored (a narrow catch turns bad input into a 400; anything
+        else still records as the in-flight 500 before propagating)."""
         url = urlparse(self.path)
-        qs = parse_qs(url.query)
-        # One engine ref for the whole request: a concurrent snapshot
-        # swap must not mix two versions inside one response.
-        eng = self.srv.engine
+        handler = routes.get(url.path)
+        endpoint = url.path.lstrip("/") if handler else "unknown"
+        rid = self.headers.get("X-Request-Id", "")
+        # fullmatch, not match: `$` would accept a trailing newline,
+        # and the id is echoed into a response header verbatim.
+        if not _REQUEST_ID_RE.fullmatch(rid or ""):
+            rid = secrets.token_hex(8)
+        self._request_id = rid
+        self._status = 500
+        self._raw_body = b""
+        self.srv.request_started()
         t0 = time.perf_counter()
         try:
-            if url.path == "/healthz":
-                self._reply(200, {
-                    "ok": True,
-                    "version": eng.version,
-                    "snapshot_id": eng.snapshot.snapshot_id,
-                    "num_vertices": eng.num_vertices,
-                })
-            elif url.path == "/snapshot":
-                self._reply(200, eng.snapshot.meta)
-            elif url.path == "/vertex":
-                v = int(qs["v"][0])
-                row = self.srv.vertex_row(eng, v)
-                self.srv.record_batch("vertex", 1, time.perf_counter() - t0)
-                self._reply(200, row)
-            elif url.path == "/neighbors":
-                v = int(qs["v"][0])
-                nbrs = eng.neighbors(v)
-                self.srv.record_batch("neighbors", 1, time.perf_counter() - t0)
-                self._reply(200, {"vertex": v, "neighbors": nbrs})
-            elif url.path == "/topk":
-                community = int(qs["community"][0])
-                k = int(qs.get("k", ["10"])[0])
-                top = eng.top_outliers(community, k)
-                self.srv.record_batch("topk", len(top), time.perf_counter() - t0)
-                self._reply(200, {
-                    "community": community,
-                    "top": [{"vertex": v, "lof": s} for v, s in top],
-                })
-            else:
+            if handler is None:
                 self._error(404, f"unknown path {url.path!r}")
+            else:
+                getattr(self, handler)(url)
         except (KeyError, ValueError, IndexError) as e:
-            # KeyError.__str__ repr-quotes its message; unwrap it
-            self._error(400, str(e.args[0]) if e.args else str(e))
+            try:
+                # KeyError.__str__ repr-quotes its message; unwrap it
+                self._error(400, str(e.args[0]) if e.args else str(e))
+            except OSError:
+                self._status = 499  # socket died while sending the 400
+        except OSError:
+            # The connection died under us (client disconnect mid-write):
+            # nothing more can be sent, but the SLO surface must not
+            # count an unreceived reply as a served 2xx — record 499
+            # (client closed request), the signal a tail of impatient
+            # clients actually leaves.
+            self._status = 499
+        finally:
+            self.srv.request_finished(
+                method, endpoint, self._status,
+                time.perf_counter() - t0, rid, body=self._raw_body,
+            )
+
+    def do_GET(self) -> None:  # noqa: N802
+        self._serve("GET", _GET_ROUTES)
 
     def do_POST(self) -> None:  # noqa: N802
-        url = urlparse(self.path)
+        self._serve("POST", _POST_ROUTES)
+
+    # -- GET routes --------------------------------------------------------
+    # Handlers that read result state bind `eng = self.srv.engine` ONCE:
+    # a concurrent snapshot swap must not mix two versions inside one
+    # response.
+
+    def _ep_healthz(self, url) -> None:
+        self._reply(200, self.srv.healthz())
+
+    def _ep_statusz(self, url) -> None:
+        self._reply(200, self.srv.statusz())
+
+    def _ep_metrics(self, url) -> None:
+        self._reply_text(
+            200, self.srv.metrics_text(),
+            "text/plain; version=0.0.4; charset=utf-8",
+        )
+
+    def _ep_snapshot(self, url) -> None:
+        self._reply(200, self.srv.engine.snapshot.meta)
+
+    def _ep_vertex(self, url) -> None:
         eng = self.srv.engine
         t0 = time.perf_counter()
-        try:
-            if url.path == "/query":
-                body = self._body()
-                out = eng.query_batch(body.get("vertices", []))
-                self.srv.record_batch(
-                    "query", len(out["vertex"]), time.perf_counter() - t0
-                )
-                self._reply(200, {**out, "version": eng.version})
-            elif url.path == "/delta":
-                self._reply(200, self.srv.apply_delta(self._body()))
-            elif url.path == "/reload":
-                self._reply(200, self.srv.reload())
-            else:
-                self._error(404, f"unknown path {url.path!r}")
-        except (KeyError, ValueError, IndexError) as e:
-            # KeyError.__str__ repr-quotes its message; unwrap it
-            self._error(400, str(e.args[0]) if e.args else str(e))
+        v = int(parse_qs(url.query)["v"][0])
+        row = self.srv.vertex_row(eng, v)
+        self.srv.record_batch("vertex", 1, time.perf_counter() - t0)
+        self._reply(200, row)
+
+    def _ep_neighbors(self, url) -> None:
+        eng = self.srv.engine
+        t0 = time.perf_counter()
+        v = int(parse_qs(url.query)["v"][0])
+        nbrs = eng.neighbors(v)
+        self.srv.record_batch("neighbors", 1, time.perf_counter() - t0)
+        self._reply(200, {"vertex": v, "neighbors": nbrs})
+
+    def _ep_topk(self, url) -> None:
+        eng = self.srv.engine
+        t0 = time.perf_counter()
+        qs = parse_qs(url.query)
+        community = int(qs["community"][0])
+        k = int(qs.get("k", ["10"])[0])
+        top = eng.top_outliers(community, k)
+        self.srv.record_batch("topk", len(top), time.perf_counter() - t0)
+        self._reply(200, {
+            "community": community,
+            "top": [{"vertex": v, "lof": s} for v, s in top],
+        })
+
+    # -- POST routes -------------------------------------------------------
+    def _ep_query(self, url) -> None:
+        eng = self.srv.engine
+        t0 = time.perf_counter()
+        body = self._body()
+        out = eng.query_batch(body.get("vertices", []))
+        self.srv.record_batch(
+            "query", len(out["vertex"]), time.perf_counter() - t0
+        )
+        self._reply(200, {**out, "version": eng.version})
+
+    def _ep_delta(self, url) -> None:
+        self._reply(200, self.srv.apply_delta(self._body()))
+
+    def _ep_reload(self, url) -> None:
+        self._reply(200, self.srv.reload())

@@ -87,6 +87,15 @@ def pytest_configure(config):
         "default CPU pass — select with -m serve or "
         "tools/run_tier1.sh --serve-only",
     )
+    config.addinivalue_line(
+        "markers",
+        "slo: serving-SLO observability suite (tests/test_slo.py: "
+        "bucket histograms + merge associativity, live /metrics and "
+        "/statusz under the query hammer, quantile agreement vs the "
+        "access_log JSONL, repair-debt accounting, request tracing); "
+        "runs in the default CPU pass — select with -m slo or "
+        "tools/run_tier1.sh --slo-only",
+    )
     if not (_needs_reexec() and _invoked_as_pytest_cli()):
         return
     cap = config.pluginmanager.getplugin("capturemanager")

@@ -18,7 +18,12 @@ dir):
 - the **recovery timeline**: every retry / degrade / mesh_degrade /
   tripwire / watchdog_timeout / checkpoint rollback / resume, in causal
   order, each with its span path — *which* incident hit *which* phase on
-  *which* mesh rung.
+  *which* mesh rung;
+- the **serving SLO** section: per-endpoint latency quantiles
+  (nearest-rank over raw ``access_log`` seconds — the exact offline
+  twin of the server's live bucket estimates), error/slow-request
+  rates, and the repair-debt timeline each ``delta_apply``'s ledger
+  snapshot traces out.
 
 Usage::
 
@@ -241,6 +246,71 @@ def _serving_table(records, t0):
     return rows
 
 
+def _percentile(sorted_vals, q):
+    """Nearest-rank percentile over a sorted list — the stdlib-exact
+    offline quantile the live bucket estimate (``/statusz``) is checked
+    against (agreement within one histogram bucket, tests/test_slo.py)."""
+    if not sorted_vals:
+        return 0.0
+    import math
+
+    rank = max(1, math.ceil(q * len(sorted_vals)))
+    return sorted_vals[min(rank, len(sorted_vals)) - 1]
+
+
+def _slo_section(records, t0):
+    """Serving SLO, reconstructed from the JSONL alone: per-endpoint
+    latency quantiles + error rates from ``access_log`` records, and the
+    repair-debt timeline from the ledger snapshots each ``delta_apply``
+    carries. Empty list = no serving-SLO records (batch-only stream)."""
+    access = [r for r in records if r.get("phase") == "access_log"]
+    applies = [
+        r for r in records
+        if r.get("phase") == "delta_apply"
+        and isinstance(r.get("repair_debt"), dict)
+    ]
+    out = []
+    if access:
+        per: dict = {}
+        for r in access:
+            d = per.setdefault(
+                r.get("endpoint", "?"), {"secs": [], "errors": 0, "slow": 0}
+            )
+            d["secs"].append(float(r.get("seconds", 0.0)))
+            if int(r.get("status", 0)) >= 400:
+                d["errors"] += 1
+            if r.get("slow"):
+                d["slow"] += 1
+        out.append(
+            "  endpoint          n    err%  slow       p50       p95"
+            "       p99"
+        )
+        for ep, d in sorted(per.items()):
+            s = sorted(d["secs"])
+            n = len(s)
+            out.append(
+                f"  {ep:<14} {n:>5}  {100.0 * d['errors'] / n:>5.1f}%"
+                f"  {d['slow']:>4}"
+                f"  {_percentile(s, 0.50) * 1e3:>7.2f}ms"
+                f"  {_percentile(s, 0.95) * 1e3:>7.2f}ms"
+                f"  {_percentile(s, 0.99) * 1e3:>7.2f}ms"
+            )
+    if applies:
+        out.append("  repair-debt timeline:")
+        for r in applies:
+            debt = r["repair_debt"]
+            budget = r.get("budget", "?")
+            out.append(
+                f"  {_fmt_offset(r, t0)}  v{r.get('version', '?')}"
+                f"  {r.get('method', '?'):<15}"
+                f"  supersteps={r.get('iterations', '?')}/{budget}"
+                f"  pending_rows={debt.get('pending_rows', '?')}"
+                f"  lag={debt.get('ingest_lag_s', '?')}s"
+                f"  warm_ratio={debt.get('warm_ratio', '?')}"
+            )
+    return out
+
+
 def _recovery_timeline(records, t0):
     events = [r for r in records if r.get("phase") in RECOVERY_PHASES]
     if not events:
@@ -350,6 +420,11 @@ def build_report(records, source: str = "", bad_lines: int = 0) -> str:
         lines.append("")
         lines.append("-- serving (snapshots / deltas / queries) --")
         lines.extend(serving)
+    slo = _slo_section(records, t0)
+    if slo:
+        lines.append("")
+        lines.append("-- serving SLO (latency / errors / repair debt) --")
+        lines.extend(slo)
     lines.append("")
     lines.append("-- recovery timeline --")
     lines.extend(_recovery_timeline(records, t0))

@@ -7,7 +7,7 @@
 # (-m faults: tests/test_resilience.py + the tripwire/reshard cases in
 # tests/test_sharded.py) is part of this default pass.
 #
-# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only] [extra pytest args...]
+# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only|--slo-only] [extra pytest args...]
 #   --faults-only  run just the `faults`-marked recovery suite — the fast
 #                  pre-commit loop when iterating on resilience paths
 #   --obs-only     run just the `obs`-marked tracing/telemetry suite
@@ -22,6 +22,12 @@
 #                  (tests/test_serve.py: snapshot round-trip/rollback,
 #                  delta repair equivalence, query engine, live-swap
 #                  server) — the fast slice when iterating on serve/
+#   --slo-only     run just the `slo`-marked serving-SLO suite
+#                  (tests/test_slo.py: histograms + merge associativity,
+#                  live /metrics + /statusz under the query hammer,
+#                  quantile agreement vs the access_log JSONL, repair
+#                  debt, request tracing) — the fast slice when
+#                  iterating on the SLO observability layer
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -38,6 +44,9 @@ elif [ "${1:-}" = "--ann-only" ]; then
 elif [ "${1:-}" = "--serve-only" ]; then
     shift
     MARKER='serve and not slow'
+elif [ "${1:-}" = "--slo-only" ]; then
+    shift
+    MARKER='slo and not slow'
 fi
 
 LOG="${TIER1_LOG:-/tmp/_t1.log}"

@@ -130,9 +130,14 @@ def cmd_serve(args) -> int:
     from graphmine_tpu.serve.server import SnapshotServer
 
     sink = _sink(args)
+    # A serving process emits one access_log record per request forever;
+    # cap the sink's in-memory copy (the JSONL stream keeps everything
+    # on disk) so RSS doesn't grow linearly with traffic.
+    sink.max_records = 100_000
     server = SnapshotServer(
         _store(args), host=args.host, port=args.port, sink=sink,
         prom_out=args.prom_out, num_shards=args.num_shards,
+        slow_request_s=args.slow_request_s,
     )
     host, port = server.start()
     print(f"serving snapshot v{server.engine.version} on http://{host}:{port}",
@@ -190,8 +195,12 @@ def main(argv=None) -> int:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8337)
     p.add_argument("--prom-out", default=None,
-                   help="Prometheus textfile path (updated on each swap)")
+                   help="Prometheus textfile path (updated on each swap); "
+                        "the live scrape surface is GET /metrics")
     p.add_argument("--num-shards", type=int, default=1)
+    p.add_argument("--slow-request-s", type=float, default=1.0,
+                   help="requests slower than this log their body digest "
+                        "in the access_log record")
     p.set_defaults(fn=cmd_serve)
 
     args = ap.parse_args(argv)
