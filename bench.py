@@ -1223,6 +1223,129 @@ def main_roofline() -> None:
     )
 
 
+def main_blocking() -> None:
+    """Propagation-blocking micro-tier (ISSUE 7): measure the sequential
+    binned-pass slots/s against the random-gather slots/s on the SAME
+    message volume, so the blocked-family crossover constant
+    (``ops/blocking.py``: BLOCKED_MIN_VERTICES / BLOCKED_MIN_MESSAGES) is
+    anchored to a hardware measurement instead of a capacity model.
+
+    Three chained-feedback loops (the roofline tier's measurement
+    discipline — one fori_loop dispatch, best-of-3 windows, data
+    dependence so XLA cannot hoist):
+
+    * ``random_gather``: ``t[idx]`` with uniform-random idx — the fused
+      bucketed kernel's access pattern, the measured ~130M slots/s wall;
+    * ``monotone_gather``: ``t[src_sorted]`` with sorted indices — the
+      blocked bin phase's sequential value stream, isolated;
+    * ``binned_pass``: the full bin phase over a REAL power-law message
+      CSR's BlockedPlan — monotone gather + destination-binned scatter.
+      Each pass delivers M messages whichever layout runs, so slots/s =
+      messages delivered per second is the apples-to-apples rate (the
+      binned pass touches ~2x the bytes per slot; the bet it measures is
+      that sequential+bin-local traffic is cheaper per slot than random).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    _setup_jax_cache()
+
+    v, e, iters = 1 << 20, 1 << 22, 30          # M = 2e = 2^23 slots
+    if _CPU_FALLBACK:
+        v, e, iters = 1 << 17, 1 << 19, 5
+    # CI smoke caps (the roofline tier's convention): the ACTUAL
+    # measurement body must be executable at tiny scale on CPU
+    # (tests/test_blocking.py::test_blocking_tier_body_cpu_smoke).
+    v = int(os.environ.get("GRAPHMINE_BLOCKING_VERTICES", v))
+    e = int(os.environ.get("GRAPHMINE_BLOCKING_EDGES", e))
+    iters = int(os.environ.get("GRAPHMINE_BLOCKING_ITERS", iters))
+
+    from graphmine_tpu.graph.container import _message_csr
+    from graphmine_tpu.ops.blocking import BlockedPlan
+
+    src, dst = powerlaw_edges(v, e, seed=7)
+    t0 = time.perf_counter()
+    ptr, _, send, _ = _message_csr(src, dst, v, True)
+    plan = BlockedPlan.from_ptr(ptr, v, send)
+    plan_seconds = time.perf_counter() - t0
+    m = plan.num_messages
+
+    rng = np.random.default_rng(11)
+    idx_rand = jnp.asarray(rng.integers(0, v, m).astype(np.int32))
+    table0 = jnp.asarray(rng.integers(0, v, v).astype(np.int32))
+
+    def timed(step, x0, elems):
+        """Best-of-3 steady-state rate, all iterations in ONE dispatch
+        (see main_roofline for why: per-call tunnel latency swamps the
+        compute otherwise)."""
+        loop = jax.jit(
+            lambda x: jax.lax.fori_loop(0, iters, lambda i, y: step(y), x)
+        )
+
+        def fetch(x):
+            np.asarray(jax.tree_util.tree_leaves(x)[0][:1])
+
+        fetch(loop(x0))  # compile + settle
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fetch(loop(x0))
+            best = min(best, time.perf_counter() - t0)
+        return elems * iters / best
+
+    # Checksum-into-slot-0 feedback makes iteration i+1 depend on i.
+    random_rate = timed(
+        jax.jit(lambda t: t.at[0].set(t[idx_rand].sum() & 0x7FFFFFF)),
+        table0, m,
+    )
+    mono_rate = timed(
+        jax.jit(lambda t: t.at[0].set(t[plan.src_sorted].sum() & 0x7FFFFFF)),
+        table0, m,
+    )
+
+    def binned(t):
+        vals = t[plan.src_sorted]                       # monotone stream
+        tile = jnp.zeros((plan.tile_alloc,), jnp.int32).at[
+            plan.scatter_pos
+        ].set(vals, unique_indices=True)                # destination bins
+        return t.at[0].set(tile.sum() & 0x7FFFFFF)
+
+    binned_rate = timed(jax.jit(binned), table0, m)
+    ratio = binned_rate / max(random_rate, 1e-9)
+
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "blocking_binned_slots_per_sec_cpu_fallback"
+                    if _CPU_FALLBACK else "blocking_binned_slots_per_sec"
+                ),
+                "value": round(binned_rate),
+                "unit": "slots/s",
+                # ratio of the binned pass over the random gather on the
+                # same message volume — >1 means the blocked layout beats
+                # the gather roofline and the crossover constants hold;
+                # CPU-fallback ratios say nothing about the TPU model.
+                "vs_baseline": 0.0 if _CPU_FALLBACK else round(ratio, 3),
+                "detail": {
+                    "random_gather_slots_per_sec": round(random_rate),
+                    "monotone_gather_slots_per_sec": round(mono_rate),
+                    "binned_pass_slots_per_sec": round(binned_rate),
+                    "binned_vs_random_gather": round(ratio, 3),
+                    "num_vertices": v,
+                    "num_edges": e,
+                    "messages": m,
+                    "num_bins": plan.num_bins,
+                    "tile_slots": plan.tile_slots,
+                    "plan_build_seconds": round(plan_seconds, 3),
+                    "iters": iters,
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
 def main() -> None:
     _run_chip_tier(weighted=False)
 
@@ -1691,6 +1814,7 @@ _REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 _CHILD_TIMEOUT_S = {
     "chip": 900.0,
     "roofline": 900.0,
+    "blocking": 900.0,
     "northstar": 2700.0,
     "sharded": 1800.0,
     "cc": 1800.0,
@@ -1709,14 +1833,17 @@ _CHILD_TIMEOUT_S = {
 # roofline second (validates the hardware model right next to the chip
 # number), then the remaining tiers by evidence value.
 _TIER_ORDER = [
-    "chip", "roofline", "northstar", "sharded", "cc", "e2e", "lof", "snap",
-    "quality", "weighted", "stream", "serve",
+    "chip", "roofline", "blocking", "northstar", "sharded", "cc", "e2e",
+    "lof", "snap", "quality", "weighted", "stream", "serve",
 ]
 # Dead-tunnel fallback order: every tier has a reduced-scale CPU variant
 # except roofline (CPU primitive rates say nothing about the TPU model).
+# (blocking IS here, unlike roofline: its headline is the binned-vs-
+# gather RATIO record shape, which the capture pipeline needs to exist
+# even when the rates themselves are CPU numbers.)
 _FALLBACK_TIERS = [
-    "chip", "northstar", "sharded", "cc", "e2e", "lof", "snap", "quality",
-    "weighted", "stream", "serve",
+    "chip", "northstar", "blocking", "sharded", "cc", "e2e", "lof", "snap",
+    "quality", "weighted", "stream", "serve",
 ]
 
 # Indirection so orchestration tests can stub the inter-probe wait.
@@ -2139,8 +2266,9 @@ if __name__ == "__main__":
     ap.add_argument(
         "--tier",
         choices=[
-            "all", "chip", "roofline", "northstar", "sharded", "cc", "e2e",
-            "lof", "snap", "quality", "weighted", "stream", "serve",
+            "all", "chip", "roofline", "blocking", "northstar", "sharded",
+            "cc", "e2e", "lof", "snap", "quality", "weighted", "stream",
+            "serve",
         ],
         # No-args (the driver's invocation) = the full evidence suite: one
         # healthy TPU window turns every README performance claim into a
@@ -2151,6 +2279,7 @@ if __name__ == "__main__":
     _TIERS = {
         "chip": main,
         "roofline": main_roofline,
+        "blocking": main_blocking,
         "northstar": main_northstar,
         "sharded": main_sharded,
         "cc": main_cc,

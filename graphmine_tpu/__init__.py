@@ -79,21 +79,39 @@ from graphmine_tpu.table import Table, read_parquet
 from graphmine_tpu.ops.svdpp import svd_plus_plus, svdpp_predict
 from graphmine_tpu.interop import from_networkx, graph_from_networkx, to_networkx
 from graphmine_tpu.oracle import graphx_label_propagation
+from graphmine_tpu.ops.blocking import (
+    BlockedPlan,
+    blocked_inflow,
+    build_graph_and_blocked_plan,
+    cc_superstep_blocked,
+    lpa_superstep_blocked,
+    select_superstep_family,
+)
 from graphmine_tpu.pipeline.planner import (
     LofPlan,
     PlanError,
     RunPlan,
+    SuperstepPlan,
     plan_lof,
     plan_run,
+    plan_superstep,
 )
 
 __all__ = [
     "graphx_label_propagation",
     "plan_run",
     "plan_lof",
+    "plan_superstep",
     "RunPlan",
     "LofPlan",
+    "SuperstepPlan",
     "PlanError",
+    "BlockedPlan",
+    "blocked_inflow",
+    "build_graph_and_blocked_plan",
+    "cc_superstep_blocked",
+    "lpa_superstep_blocked",
+    "select_superstep_family",
     "select_lof_impl",
     "vertex_features_host",
     "Graph",

@@ -64,6 +64,12 @@ register("outliers_lof", "seconds", "k", "devices", "features")
 register("outlier_summary", "method")
 register("ivf_fallback", "guard", "detail")
 register("impl_selected", "op", "impl", "n", "reason")
+# plan_build: one per superstep-plan materialization (blocked/bucketed —
+# ops/blocking.emit_plan_records and the driver's single-device build):
+# host build seconds, family, bins/width classes, padded gather slots per
+# edge. Host plan cost grows with the tighter ladders; this record keeps
+# it visible in obs_report instead of hiding inside first-call latency.
+register("plan_build", "op", "family", "seconds", "padded_slots_per_edge")
 
 # ---- serving records (docs/SERVING.md) ------------------------------------
 register("snapshot_publish", "version", "snapshot_id", "path", "bytes",

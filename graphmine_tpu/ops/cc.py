@@ -80,7 +80,7 @@ def cc_superstep_bucketed(labels: jax.Array, plan) -> jax.Array:
 
 def connected_components(
     graph: Graph, max_iter: int = 0, return_iterations: bool = False,
-    plan="auto",
+    plan="auto", sink=None,
 ):
     """Weakly-connected component labels ``[V]`` (smallest member vertex id).
 
@@ -95,23 +95,57 @@ def connected_components(
     ``plan``: a fused :class:`BucketedModePlan` (r5) — supersteps run
     :func:`cc_superstep_bucketed` instead of the segment_min path
     (identical labels every step, tested; measured 2.57x on the
-    100M-edge cc bench tier, `bench_r5_final_tpu.log`). The default
-    ``"auto"`` reuses LPA's per-graph cached fused plan when the message
-    count amortizes the one-time host build (same policy and cache as
+    100M-edge cc bench tier, `bench_r5_final_tpu.log`) — or a
+    :class:`~graphmine_tpu.ops.blocking.BlockedPlan` (r7): supersteps run
+    :func:`~graphmine_tpu.ops.blocking.cc_superstep_blocked`, the
+    destination-binned bin-then-reduce layout past the gather roofline.
+    The default ``"auto"`` resolves the family through
+    :func:`~graphmine_tpu.ops.blocking.select_superstep_family` (the
+    single crossover-policy owner; same per-graph plan cache as
     :func:`~graphmine_tpu.ops.lpa.label_propagation`); ``None`` forces
     the segment_min path. Callers that built the graph with
-    ``build_graph_and_plan`` can pass their plan directly.
+    ``build_graph_and_plan`` / ``build_graph_and_blocked_plan`` can pass
+    their plan directly. ``sink``: optional MetricsSink — auto
+    resolutions emit ``impl_selected`` + ``plan_build`` provenance
+    records (see ``label_propagation``).
     """
+    from graphmine_tpu.ops.blocking import BlockedPlan
+
     if isinstance(plan, str) and plan == "auto":
+        from graphmine_tpu.ops.blocking import (
+            emit_plan_records,
+            select_superstep_family,
+        )
         from graphmine_tpu.ops.lpa import _cached_auto_plan
 
         plan = None
+        if not isinstance(graph.msg_ptr, jax.core.Tracer):
+            family, reason = select_superstep_family(
+                graph.num_vertices, graph.num_messages,
+                weighted=graph.msg_weight is not None,
+            )
+            seconds, cached = 0.0, False
+            if family != "sort":
+                plan, seconds, cached = _cached_auto_plan(graph, family)
+            emit_plan_records(
+                sink, "cc_superstep", plan, reason, seconds, cached,
+                graph.num_edges, graph.num_messages,
+            )
+    if isinstance(plan, BlockedPlan):
+        # Full plan/graph identity check HERE, where the graph is in
+        # hand — cc_superstep_blocked alone can only check V, and a
+        # same-V plan from a different graph would silently mis-reduce.
         if (
-            not isinstance(graph.msg_ptr, jax.core.Tracer)
-            and graph.num_messages >= (1 << 16)
+            plan.num_vertices != graph.num_vertices
+            or plan.num_messages != graph.num_messages
         ):
-            plan = _cached_auto_plan(graph)
-    if plan is not None and plan.send_idx is None:
+            raise ValueError(
+                f"plan built for V={plan.num_vertices}, "
+                f"M={plan.num_messages} but graph has "
+                f"V={graph.num_vertices}, M={graph.num_messages} — "
+                "plan/graph mismatch"
+            )
+    elif plan is not None and plan.send_idx is None:
         plan = None  # non-fused plan: no label-gather indices to min over
     return _connected_components(graph, max_iter, return_iterations, plan)
 
@@ -127,12 +161,16 @@ def _connected_components(
         labels, prev_changed, it = state
         return (prev_changed > 0) & (it < limit)
 
+    from graphmine_tpu.ops.blocking import BlockedPlan, cc_superstep_blocked
+
     def body(state):
         labels, _, it = state
-        new = (
-            cc_superstep(labels, graph) if plan is None
-            else cc_superstep_bucketed(labels, plan)
-        )
+        if plan is None:
+            new = cc_superstep(labels, graph)
+        elif isinstance(plan, BlockedPlan):
+            new = cc_superstep_blocked(labels, plan)
+        else:
+            new = cc_superstep_bucketed(labels, plan)
         changed = jnp.sum(new != labels, dtype=jnp.int32)
         return new, changed, it + 1
 

@@ -51,6 +51,7 @@ def label_propagation(
     init_labels: jax.Array | None = None,
     return_history: bool = False,
     plan="auto",
+    sink=None,
 ):
     """Run ``max_iter`` LPA supersteps; returns int32 labels ``[V]``.
 
@@ -59,32 +60,54 @@ def label_propagation(
     reference lacked — SURVEY §5 metrics).
 
     ``plan``: a
-    :class:`~graphmine_tpu.ops.bucketed_mode.BucketedModePlan` for the
-    graph — switches every superstep to the degree-bucketed dense mode
-    kernel (~3x faster at 10^7 messages; identical results, tested). The
-    default ``"auto"`` builds it from the graph (cached per graph) when
-    the message count amortizes the one-time host build. Auto stays on
-    the sort path when custom ``init_labels`` are given (the fused plan's
+    :class:`~graphmine_tpu.ops.bucketed_mode.BucketedModePlan` (the
+    degree-bucketed dense mode kernel, ~3x the sort superstep at 10^7
+    messages) or a :class:`~graphmine_tpu.ops.blocking.BlockedPlan` (the
+    propagation-blocking bin-then-reduce engine past the gather roofline)
+    — identical labels either way, tested. The default ``"auto"``
+    resolves the family through
+    :func:`~graphmine_tpu.ops.blocking.select_superstep_family` (the
+    single crossover-policy owner) and builds the plan from the graph
+    (cached per graph, per family). Auto stays on the sort path when
+    custom ``init_labels`` are given (the fused plan's
     histogram/sentinel machinery assumes labels in ``[0, V)`` — the
     default ``arange`` initialization guarantees that, arbitrary labels
     don't) or under an enclosing jit trace, where host plan construction
     is impossible. Pass ``None`` to force the sort-based superstep.
+
+    ``sink``: optional MetricsSink — each auto resolution emits an
+    ``impl_selected`` record, and each plan materialization a
+    ``plan_build`` record (family, build seconds, bins/buckets, padded
+    slots/edge), so host plan cost is visible in obs_report instead of
+    hiding inside first-call latency.
     """
+    from graphmine_tpu.ops.blocking import BlockedPlan, emit_plan_records
     from graphmine_tpu.ops.bucketed_mode import BucketedModePlan
 
     if isinstance(plan, str) and plan == "auto":
         plan = None
-        if (
-            init_labels is None
-            and not isinstance(graph.msg_ptr, jax.core.Tracer)
-            and graph.num_messages >= (1 << 16)
-        ):
-            # Weighted graphs ride the fast path too (r2): from_graph
-            # builds the plan's slot-aligned weight payload.
-            plan = _cached_auto_plan(graph)
-    elif plan is not None and not isinstance(plan, BucketedModePlan):
+        if init_labels is None and not isinstance(graph.msg_ptr, jax.core.Tracer):
+            from graphmine_tpu.ops.blocking import select_superstep_family
+
+            family, reason = select_superstep_family(
+                graph.num_vertices, graph.num_messages,
+                weighted=graph.msg_weight is not None,
+            )
+            seconds, cached = 0.0, False
+            if family != "sort":
+                # Weighted graphs ride the fast paths too (r2): both
+                # builders carry the slot-aligned weight payload.
+                plan, seconds, cached = _cached_auto_plan(graph, family)
+            emit_plan_records(
+                sink, "lpa_superstep", plan, reason, seconds, cached,
+                graph.num_edges, graph.num_messages,
+            )
+    elif plan is not None and not isinstance(
+        plan, (BucketedModePlan, BlockedPlan)
+    ):
         raise ValueError(
-            f"plan must be 'auto', None, or a BucketedModePlan; got {plan!r}"
+            "plan must be 'auto', None, a BucketedModePlan or a "
+            f"BlockedPlan; got {plan!r}"
         )
     if (
         isinstance(plan, BucketedModePlan)
@@ -111,23 +134,39 @@ def label_propagation(
 _auto_plan_cache: dict = {}
 
 
-def _cached_auto_plan(graph: Graph):
-    """Fused plan per graph, cached so repeated calls pay the host build
-    (device->host fetch of msg_ptr/msg_send + NumPy bucketing) once.
-    Keyed by the identity of the graph's msg_ptr array; a weakref
-    finalizer evicts the entry when that array is collected."""
+def _cached_auto_plan(graph: Graph, family: str = "bucketed"):
+    """Auto plan per (graph, family), cached so repeated calls pay the
+    host build (device->host fetch of msg_ptr/msg_send + NumPy layout)
+    once. Keyed by the identity of the graph's msg_ptr array; a weakref
+    finalizer evicts the entry when that array is collected. Returns
+    ``(plan, build_seconds, cached)`` — the ``plan_build`` record's raw
+    material (seconds is 0.0 on a cache hit)."""
     import weakref
 
+    from graphmine_tpu.ops.blocking import BlockedPlan, timed_plan_build
     from graphmine_tpu.ops.bucketed_mode import BucketedModePlan
 
     key = id(graph.msg_ptr)
     hit = _auto_plan_cache.get(key)
-    if hit is not None and hit[0]() is graph.msg_ptr:
-        return hit[1]
-    plan = BucketedModePlan.from_graph(graph, with_send=True)
-    ref = weakref.ref(graph.msg_ptr, lambda _, k=key: _auto_plan_cache.pop(k, None))
-    _auto_plan_cache[key] = (ref, plan)
-    return plan
+    if hit is None or hit[0]() is not graph.msg_ptr:
+        ref = weakref.ref(
+            graph.msg_ptr, lambda _, k=key: _auto_plan_cache.pop(k, None)
+        )
+        hit = (ref, {})
+        _auto_plan_cache[key] = hit
+    plans = hit[1]
+    if family in plans:
+        return plans[family], 0.0, True
+    if family == "blocked":
+        plan, seconds = timed_plan_build(lambda: BlockedPlan.from_graph(graph))
+    elif family == "bucketed":
+        plan, seconds = timed_plan_build(
+            lambda: BucketedModePlan.from_graph(graph, with_send=True)
+        )
+    else:
+        raise ValueError(f"no plan to build for family {family!r}")
+    plans[family] = plan
+    return plan, seconds, False
 
 
 @partial(jax.jit, static_argnames=("max_iter", "return_history"))
@@ -147,9 +186,16 @@ def _label_propagation(
     if plan is None:
         superstep = lambda lbl: lpa_superstep(lbl, graph)
     else:
+        from graphmine_tpu.ops.blocking import (
+            BlockedPlan,
+            lpa_superstep_blocked,
+        )
         from graphmine_tpu.ops.bucketed_mode import lpa_superstep_bucketed
 
-        superstep = lambda lbl: lpa_superstep_bucketed(lbl, graph, plan)
+        if isinstance(plan, BlockedPlan):
+            superstep = lambda lbl: lpa_superstep_blocked(lbl, graph, plan)
+        else:
+            superstep = lambda lbl: lpa_superstep_bucketed(lbl, graph, plan)
 
     def step(labels, _):
         new = superstep(labels)

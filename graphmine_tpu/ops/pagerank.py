@@ -27,7 +27,6 @@ from jax import lax
 from graphmine_tpu.graph.container import Graph
 
 
-@partial(jax.jit, static_argnames=("max_iter",))
 def pagerank(
     graph: Graph,
     alpha: float = 0.85,
@@ -35,6 +34,8 @@ def pagerank(
     tol: float = 1e-6,
     reset: jax.Array | None = None,
     weights: jax.Array | None = None,
+    plan="auto",
+    sink=None,
 ) -> jax.Array:
     """PageRank vector ``[V]`` (float32, sums to 1).
 
@@ -46,7 +47,90 @@ def pagerank(
     to 0 are treated as dangling). Converges when the L1 delta drops
     below ``tol`` (checked inside the while_loop — no host sync per
     iteration), bounded by ``max_iter``.
+
+    ``plan``: a :class:`~graphmine_tpu.ops.blocking.BlockedPlan` routes
+    the inflow through the destination-binned bin-then-reduce layout
+    (``blocked_inflow``; sums reassociate, so parity is to float
+    tolerance). Requires a **directed** message CSR
+    (``build_graph(..., symmetric=False)`` — a symmetric CSR carries both
+    directions and would double the inflow) and ``weights=None`` (the
+    per-edge ``weights`` argument is edge-order-aligned, not CSR-aligned;
+    a weighted run refuses loudly rather than silently dropping or
+    misaligning weights — pass ``plan=None``). The default ``"auto"``
+    consults :func:`~graphmine_tpu.ops.blocking.select_superstep_family`
+    and flips to blocked only past the measured crossover on an eligible
+    graph; everything else keeps the segment_sum path bit-for-bit.
+    ``sink``: optional MetricsSink for the ``impl_selected`` /
+    ``plan_build`` provenance records.
     """
+    from graphmine_tpu.ops.blocking import BlockedPlan
+
+    resolved = None
+    if isinstance(plan, str) and plan == "auto":
+        if (
+            weights is None
+            and not graph.symmetric
+            and not isinstance(graph.msg_ptr, jax.core.Tracer)
+        ):
+            from graphmine_tpu.ops.blocking import (
+                emit_plan_records,
+                select_superstep_family,
+            )
+            from graphmine_tpu.ops.lpa import _cached_auto_plan
+
+            family, reason = select_superstep_family(
+                graph.num_vertices, graph.num_messages
+            )
+            if family == "blocked":
+                resolved, seconds, cached = _cached_auto_plan(graph, "blocked")
+                emit_plan_records(
+                    sink, "pagerank_inflow", resolved, reason, seconds,
+                    cached, graph.num_edges, graph.num_messages,
+                )
+    elif isinstance(plan, BlockedPlan):
+        if (
+            plan.num_vertices != graph.num_vertices
+            or plan.num_messages != graph.num_messages
+        ):
+            # blocked_inflow alone can only check V; a same-V plan from a
+            # different graph would silently route rank the wrong way
+            raise ValueError(
+                f"plan built for V={plan.num_vertices}, "
+                f"M={plan.num_messages} but graph has "
+                f"V={graph.num_vertices}, M={graph.num_messages} — "
+                "plan/graph mismatch"
+            )
+        if graph.symmetric:
+            raise ValueError(
+                "blocked PageRank needs a directed message CSR "
+                "(build_graph(..., symmetric=False)); this graph's CSR "
+                "carries both directions and would double the inflow"
+            )
+        if weights is not None:
+            raise ValueError(
+                "blocked PageRank does not carry the edge-aligned weights "
+                "argument (the plan's layout is CSR-aligned); pass "
+                "plan=None for weighted ranks — weights are never "
+                "silently dropped"
+            )
+        resolved = plan
+    elif plan is not None:
+        raise ValueError(
+            f"plan must be 'auto', None, or a BlockedPlan; got {plan!r}"
+        )
+    return _pagerank(graph, alpha, max_iter, tol, reset, weights, resolved)
+
+
+@partial(jax.jit, static_argnames=("max_iter",))
+def _pagerank(
+    graph: Graph,
+    alpha: float = 0.85,
+    max_iter: int = 100,
+    tol: float = 1e-6,
+    reset: jax.Array | None = None,
+    weights: jax.Array | None = None,
+    plan=None,
+) -> jax.Array:
     v = graph.num_vertices
     src, dst = graph.src, graph.dst
     if weights is None:
@@ -70,7 +154,11 @@ def pagerank(
 
     def step(state):
         pr, _, it = state
-        if edge_frac is None:
+        if plan is not None:
+            from graphmine_tpu.ops.blocking import blocked_inflow
+
+            inflow = blocked_inflow(plan, pr * inv_out)
+        elif edge_frac is None:
             inflow = jax.ops.segment_sum((pr * inv_out)[src], dst, num_segments=v)
         else:
             inflow = jax.ops.segment_sum(pr[src] * edge_frac, dst, num_segments=v)

@@ -296,6 +296,26 @@ class ShardedGraph:
     # aligned slot-for-slot with bucket_send (padding slots 0). Empty on
     # unweighted graphs.
     bucket_weight: tuple = ()
+    # Stacked propagation-blocking plan (r7, ops/blocking.py): each
+    # shard's vertex chunk is a BIN GROUP — destination-range bins over
+    # the shard's local CSR, shard-local tiles, the same one-all_gather
+    # ring exchange. blk_src[d]: int32 [Mp] sender ids in sender-major
+    # order (padding = padded_vertices, the label sentinel slot);
+    # blk_pos[d]: each streamed message's slot in the shard's binned tile
+    # (padding messages land in a scratch region past the bins). Per
+    # width class c: blk_row_idx[c] int32 [D, n_c, w_c] TILE slots
+    # (padding = the reserved sentinel slot), blk_row_target[c] int32
+    # [D, n_c] LOCAL owned-vertex indices (padding rows = chunk_size + j
+    # scratch, the bucketed plan's trick), blk_row_weight[c] optional
+    # float32 [D, n_c, w_c]. None/empty = no blocked plan.
+    blk_src: jax.Array | None = None
+    blk_pos: jax.Array | None = None
+    blk_row_idx: tuple = ()
+    blk_row_target: tuple = ()
+    blk_row_weight: tuple = ()
+    blk_tile_alloc: int = dataclasses.field(
+        metadata=dict(static=True), default=0
+    )
 
     @property
     def padded_vertices(self) -> int:
@@ -310,6 +330,8 @@ def partition_graph(
     mesh=None,
     pad_multiple: int = 8,
     build_bucket_plan: bool = False,
+    build_blocked_plan: bool = False,
+    blocked_tile_slots: int | None = None,
 ) -> ShardedGraph:
     """Partition a graph's message CSR into vertex-range shards (host-side).
 
@@ -318,8 +340,18 @@ def partition_graph(
     precomputes the stacked degree-bucket plan the fast LPA shard body
     uses (host work + its own HBM, amortized once per graph like the CSR
     itself) — opt in when the partition feeds LPA; CC/PageRank/ring
-    consumers never read it.
+    consumers never read it. ``build_blocked_plan`` (r7, mutually
+    exclusive with ``build_bucket_plan``) precomputes the stacked
+    propagation-blocking plan instead: each shard's chunk becomes a bin
+    group of shard-local destination tiles (``ops/blocking.py``), used by
+    the blocked LPA **and** CC shard bodies; ``blocked_tile_slots``
+    overrides the per-bin tile budget (tests force multi-bin layouts).
     """
+    if build_bucket_plan and build_blocked_plan:
+        raise ValueError(
+            "build_bucket_plan and build_blocked_plan are mutually "
+            "exclusive — one plan family per partition"
+        )
     if mesh is not None and num_shards is None:
         num_shards = mesh.size
     if num_shards is None:
@@ -392,6 +424,11 @@ def partition_graph(
         bucket_send, bucket_target, bucket_weight = _build_shard_bucket_plan(
             deg, send_pad, counts, vc, d, w_pad
         )
+    blk = {}
+    if build_blocked_plan:
+        blk = _build_shard_blocked_plan(
+            deg, send_pad, counts, vc, d, w_pad, blocked_tile_slots
+        )
 
     # Fields stay host-side (NumPy): shard_graph_arrays does the one
     # device placement, directly to the mesh sharding — no staging copy
@@ -407,6 +444,7 @@ def partition_graph(
         bucket_target=bucket_target,
         msg_weight=w_pad,
         bucket_weight=bucket_weight,
+        **blk,
     )
 
 
@@ -479,6 +517,113 @@ def _build_shard_bucket_plan(deg, send_pad, counts, chunk_size, d, w_pad=None):
     return tuple(bucket_send), tuple(bucket_target), tuple(bucket_weight)
 
 
+def _build_shard_blocked_plan(
+    deg, send_pad, counts, chunk_size, d, w_pad=None, tile_slots=None
+):
+    """Stacked per-shard propagation-blocking plan with uniform shapes.
+
+    Each shard's vertex chunk is a bin group: the shard's LOCAL message
+    CSR is split into destination-range bins (``ops/blocking._blocked_layout``
+    — the single layout owner, so the sharded tiles are semantically
+    identical to the fused plan's), on ONE shared width ladder and ONE
+    tile width (the max across shards) so a single SPMD program serves
+    all devices. Padding messages (the CSR rows past ``counts[s]``)
+    stream the label-sentinel sender and scatter into a per-shard scratch
+    region past the bins; padding rows target ``chunk_size + j`` scratch
+    slots exactly like the bucketed plan. Built with a per-shard host
+    loop (D is small; the per-shard work is vectorized NumPy).
+    """
+    import os as _os
+
+    from graphmine_tpu.ops.blocking import (
+        DEFAULT_TILE_SLOTS,
+        _bin_bounds,
+        _blocked_layout,
+    )
+    from graphmine_tpu.ops.bucketed_mode import _extend_widths
+
+    if tile_slots is None:
+        tile_slots = int(
+            _os.environ.get("GRAPHMINE_BLOCKED_TILE_SLOTS", DEFAULT_TILE_SLOTS)
+        )
+    sentinel_send = chunk_size * d              # the label sentinel slot
+    mp = send_pad.shape[1]
+    widths = _extend_widths(int(deg.max(initial=1)))
+
+    # Local CSR pointers + a first pass for the shared tile width.
+    ptrs, tb = [], 8
+    for s in range(d):
+        ptr_s = np.zeros(chunk_size + 1, dtype=np.int64)
+        np.cumsum(deg[s], out=ptr_s[1:])
+        ptrs.append(ptr_s)
+        bounds = _bin_bounds(ptr_s, tile_slots)
+        sizes = ptr_s[bounds[1:]] - ptr_s[bounds[:-1]]
+        tb = max(tb, -(-int(sizes.max(initial=1)) // 8) * 8)
+
+    shard_layouts, n_bins_max = [], 1
+    for s in range(d):
+        layout = _blocked_layout(
+            ptrs[s], send_pad[s], tile_slots, widths=widths, tile_width=tb,
+            weights=None if w_pad is None else w_pad[s],
+        )
+        shard_layouts.append(layout)
+        n_bins_max = max(n_bins_max, len(layout[2]) - 1)
+
+    tile_total = n_bins_max * tb
+    tile_alloc = tile_total + mp + 1
+    sentinel_slot = tile_alloc - 1
+
+    blk_src = np.full((d, mp), sentinel_send, dtype=np.int32)
+    blk_pos = np.empty((d, mp), dtype=np.int32)
+    class_rows: dict = {}
+    for s, (src_sorted, scatter_pos, _bounds, _tb, rows) in enumerate(
+        shard_layouts
+    ):
+        n = len(src_sorted)
+        blk_src[s, :n] = src_sorted
+        blk_pos[s, :n] = scatter_pos
+        # padding messages: distinct scratch slots past the bins (their
+        # streamed value is the label sentinel; unique indices hold)
+        blk_pos[s, n:] = tile_total + np.arange(n, mp, dtype=np.int64)
+        for c, payload in rows.items():
+            class_rows.setdefault(c, [None] * d)[s] = payload
+
+    blk_row_idx, blk_row_target, blk_row_weight = [], [], []
+    for c in sorted(class_rows):
+        w = int(widths[c])
+        per_shard = class_rows[c]
+        n_c = max(
+            (p[0].shape[0] for p in per_shard if p is not None), default=0
+        )
+        idx_c = np.full((d, n_c, w), sentinel_slot, dtype=np.int32)
+        tgt_c = np.empty((d, n_c), dtype=np.int32)
+        tgt_c[:] = chunk_size + np.arange(n_c, dtype=np.int64)[None, :]
+        wgt_c = (
+            None if w_pad is None else np.zeros((d, n_c, w), dtype=np.float32)
+        )
+        for s, payload in enumerate(per_shard):
+            if payload is None:
+                continue
+            vr, idx, wmat = payload
+            n = len(vr)
+            idx_c[s, :n] = np.where(idx < 0, sentinel_slot, idx)
+            tgt_c[s, :n] = vr
+            if wgt_c is not None:
+                wgt_c[s, :n] = wmat
+        blk_row_idx.append(idx_c)
+        blk_row_target.append(tgt_c)
+        if wgt_c is not None:
+            blk_row_weight.append(wgt_c)
+    return dict(
+        blk_src=blk_src,
+        blk_pos=blk_pos,
+        blk_row_idx=tuple(blk_row_idx),
+        blk_row_target=tuple(blk_row_target),
+        blk_row_weight=tuple(blk_row_weight),
+        blk_tile_alloc=tile_alloc,
+    )
+
+
 def shard_graph_arrays(sg: ShardedGraph, mesh, lpa_only: bool = False) -> ShardedGraph:
     """Place the per-shard arrays on the mesh (leading dim over the vertex axis).
 
@@ -492,8 +637,11 @@ def shard_graph_arrays(sg: ShardedGraph, mesh, lpa_only: bool = False) -> Sharde
     axes = _vertex_axes(mesh)
     spec = NamedSharding(mesh, P(axes, None))
     spec3 = NamedSharding(mesh, P(axes, None, None))
-    if lpa_only and not sg.bucket_send:
-        raise ValueError("lpa_only requires partition_graph(build_bucket_plan=True)")
+    if lpa_only and not sg.bucket_send and sg.blk_src is None:
+        raise ValueError(
+            "lpa_only requires partition_graph(build_bucket_plan=True) or "
+            "partition_graph(build_blocked_plan=True)"
+        )
     place = (lambda a, s: None) if lpa_only else jax.device_put
     return ShardedGraph(
         msg_recv_local=place(sg.msg_recv_local, spec),
@@ -508,6 +656,12 @@ def shard_graph_arrays(sg: ShardedGraph, mesh, lpa_only: bool = False) -> Sharde
         # bucket_weight) — drop it under lpa_only like the rest.
         msg_weight=None if sg.msg_weight is None else place(sg.msg_weight, spec),
         bucket_weight=tuple(jax.device_put(b, spec3) for b in sg.bucket_weight),
+        blk_src=None if sg.blk_src is None else jax.device_put(sg.blk_src, spec),
+        blk_pos=None if sg.blk_pos is None else jax.device_put(sg.blk_pos, spec),
+        blk_row_idx=tuple(jax.device_put(b, spec3) for b in sg.blk_row_idx),
+        blk_row_target=tuple(jax.device_put(t, spec) for t in sg.blk_row_target),
+        blk_row_weight=tuple(jax.device_put(b, spec3) for b in sg.blk_row_weight),
+        blk_tile_alloc=sg.blk_tile_alloc,
     )
 
 
@@ -589,6 +743,74 @@ def _lpa_shard_body_bucketed(
     return lax.all_gather(
         own[:chunk_size].astype(jnp.int32), axes, tiled=True
     )
+
+
+def _blocked_shard_tile(labels_full, blk_src, blk_pos, tile_alloc, fill):
+    """Per-device bin phase (ops/blocking.py §2, shard-local): stream the
+    padded label vector in sender-major order (monotone gather) and
+    scatter each message into its slot of this shard's destination-binned
+    tile. Padding messages carry the sentinel value into scratch slots
+    past the bins; unwritten slots keep ``fill``."""
+    lbl_pad = jnp.concatenate([labels_full, jnp.full((1,), fill, jnp.int32)])
+    vals = lbl_pad[blk_src[0]]
+    tile = jnp.full((tile_alloc,), fill, jnp.int32)
+    return tile.at[blk_pos[0]].set(vals, unique_indices=True)
+
+
+def _lpa_shard_body_blocked(
+    labels_full, blk_src, blk_pos, row_idx, row_target, row_weight=None, *,
+    chunk_size, tile_alloc, axes
+):
+    """Blocked LPA shard body: bin phase into the shard-local tile, then
+    the bucketed-mode row reduce with TILE-local indices (bounded by the
+    tile, not V). Same comms as the other LPA bodies — one tiled
+    all_gather. Padding rows scatter to the ``chunk_size + j`` scratch
+    extension (sliced away), exactly like the bucketed body; see the OOB
+    warning there for why the scratch exists."""
+    from graphmine_tpu.ops.bucketed_mode import (
+        _SENTINEL,
+        _bucket_mode,
+        _bucket_wmode,
+    )
+
+    tile = _blocked_shard_tile(labels_full, blk_src, blk_pos, tile_alloc, _SENTINEL)
+    start = lax.axis_index(axes).astype(jnp.int32) * chunk_size
+    own = lax.dynamic_slice(labels_full, (start,), (chunk_size,))
+    n_max = max((t.shape[-1] for t in row_target), default=0)
+    own = jnp.concatenate([own, jnp.zeros((n_max,), own.dtype)])
+    wmats = row_weight or (None,) * len(row_idx)
+    for ridx, tgt, wmat in zip(row_idx, row_target, wmats):
+        mat = tile[ridx[0]]
+        vals = _bucket_mode(mat) if wmat is None else _bucket_wmode(mat, wmat[0])
+        own = own.at[tgt[0]].set(vals, unique_indices=True)
+    return lax.all_gather(
+        own[:chunk_size].astype(jnp.int32), axes, tiled=True
+    )
+
+
+def _cc_shard_body_blocked(
+    labels_full, blk_src, blk_pos, row_idx, row_target, *,
+    chunk_size, tile_alloc, axes
+):
+    """Blocked CC shard body: the min-reduce twin of
+    :func:`_lpa_shard_body_blocked` — shard-local bin tile, per-row min
+    (the int32-max sentinel never wins), pointer jump on the gathered
+    full vector (no extra comms), matching :func:`_cc_shard_body`
+    step-for-step."""
+    from graphmine_tpu.ops.bucketed_mode import _SENTINEL
+
+    tile = _blocked_shard_tile(labels_full, blk_src, blk_pos, tile_alloc, _SENTINEL)
+    start = lax.axis_index(axes).astype(jnp.int32) * chunk_size
+    own = lax.dynamic_slice(labels_full, (start,), (chunk_size,))
+    n_max = max((t.shape[-1] for t in row_target), default=0)
+    own = jnp.concatenate([own, jnp.zeros((n_max,), own.dtype)])
+    for ridx, tgt in zip(row_idx, row_target):
+        row_min = jnp.min(tile[ridx[0]], axis=1)
+        own = own.at[tgt[0]].min(row_min, unique_indices=True)
+    full = lax.all_gather(
+        own[:chunk_size].astype(jnp.int32), axes, tiled=True
+    )
+    return jnp.minimum(full, full[full])
 
 
 def _cc_shard_body(labels_full, recv_local, send, deg, *, chunk_size, axes):
@@ -771,6 +993,32 @@ def _build_lpa_step(sg: ShardedGraph, mesh):
     repair entry (:func:`_sharded_lpa_fixpoint_jit`). Traced under jit."""
     axes = _vertex_axes(mesh)
     rep = P()
+    if sg.blk_src is not None:
+        # Propagation-blocking path (r7): shard-local bin tiles, same
+        # one-all_gather exchange (partition_graph(build_blocked_plan=True)).
+        n = len(sg.blk_row_idx)
+        nw = len(sg.blk_row_weight)
+        body = shard_map(
+            partial(
+                _lpa_shard_body_blocked, chunk_size=sg.chunk_size,
+                tile_alloc=sg.blk_tile_alloc, axes=axes,
+            ),
+            mesh=mesh,
+            in_specs=(
+                rep,
+                P(axes, None),
+                P(axes, None),
+                (P(axes, None, None),) * n,
+                (P(axes, None),) * n,
+                (P(axes, None, None),) * nw,
+            ),
+            out_specs=rep,
+            check_vma=False,
+        )
+        return lambda l: body(
+            l, sg.blk_src, sg.blk_pos, sg.blk_row_idx, sg.blk_row_target,
+            sg.blk_row_weight,
+        )
     if sg.bucket_send:
         # Fast path: stacked degree-bucket plan (built by partition_graph);
         # weighted graphs carry slot-aligned bucket_weight matrices (r2).
@@ -906,15 +1154,38 @@ def _sharded_cc_jit(
 ):
     _check_mesh(sg, mesh)
     in_specs, rep = _shard_specs(mesh)
-    body = shard_map(
-        partial(_cc_shard_body, chunk_size=sg.chunk_size, axes=_vertex_axes(mesh)),
-        mesh=mesh,
-        in_specs=in_specs,
-        out_specs=rep,
-        check_vma=False,
-    )
+    axes = _vertex_axes(mesh)
+    if sg.blk_src is not None:
+        # Blocked CC shard body (r7): shard-local bin tiles, same
+        # fixpoint driver, bit-identical labels (virtual-mesh parity).
+        n = len(sg.blk_row_idx)
+        body = shard_map(
+            partial(
+                _cc_shard_body_blocked, chunk_size=sg.chunk_size,
+                tile_alloc=sg.blk_tile_alloc, axes=axes,
+            ),
+            mesh=mesh,
+            in_specs=(
+                rep, P(axes, None), P(axes, None),
+                (P(axes, None, None),) * n, (P(axes, None),) * n,
+            ),
+            out_specs=rep,
+            check_vma=False,
+        )
+        step = lambda l: body(
+            l, sg.blk_src, sg.blk_pos, sg.blk_row_idx, sg.blk_row_target
+        )
+    else:
+        body = shard_map(
+            partial(_cc_shard_body, chunk_size=sg.chunk_size, axes=axes),
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=rep,
+            check_vma=False,
+        )
+        step = lambda l: body(l, sg.msg_recv_local, sg.msg_send, sg.degrees)
     return _fixpoint_supersteps(
-        lambda l: body(l, sg.msg_recv_local, sg.msg_send, sg.degrees), sg,
+        step, sg,
         max_iter, tripwire_every=tripwire_every, init_labels=init_labels,
         collect=telemetry,
     )

@@ -210,6 +210,42 @@ def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
     # when that path will run — it is pure HBM/host waste for louvain,
     # graphframes, and sharded runs.
     wants_plan = run_plan is not None and run_plan.schedule == "single"
+    # Which plan FAMILY that single-device path runs (r7): the planner
+    # resolves blocked vs bucketed at plan time through the single
+    # crossover-policy owner (ops/blocking.select_superstep_family), with
+    # the blocked→bucketed degradation rung — same provenance treatment
+    # as the r6 IVF flip. "sort" at tiny scale still builds the bucketed
+    # plan here (the shared-CSR-pass build is the historical single-path
+    # behavior; the plan is cheap exactly where "sort" wins).
+    sstep_plan = None
+    if wants_plan:
+        import dataclasses as _dc
+
+        from graphmine_tpu.pipeline.planner import plan_superstep
+
+        sstep_plan = plan_superstep(
+            table.num_vertices, 2 * table.num_edges,
+            weighted=table.weights is not None,
+        )
+        if sstep_plan.family == "sort" and not os.environ.get(
+            "GRAPHMINE_SUPERSTEP_FAMILY"
+        ):
+            # AUTO resolved "sort" on size alone — but the single-device
+            # path has always built the fused plan in the SAME
+            # message-CSR pass as the Graph, so the crossover's
+            # plan-build-cost rationale doesn't apply here: keep the
+            # bucketed kernel and say so, rather than record a family
+            # the driver doesn't run. An EXPLICIT env force of "sort"
+            # is honored as-is (the sort superstep really runs).
+            sstep_plan = _dc.replace(
+                sstep_plan, family="bucketed", degrade_to="sort",
+                reason=sstep_plan.reason + " — driver single path: plan "
+                "build shares the graph's CSR pass, bucketed kernel kept",
+            )
+        m.emit(
+            "impl_selected", op="lpa_superstep", impl=sstep_plan.family,
+            n=2 * table.num_edges, reason=sstep_plan.reason,
+        )
     # Scale-out mode (r3): when the planner chose a distributed schedule
     # AND the whole graph cannot also fit one device, the full Graph stays
     # HOST-side NumPy — partitioning slices it onto the mesh, and the
@@ -226,12 +262,29 @@ def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
                "LPA over the intra-community subgraph, sharded kNN/LOF)")
     def _build():
         resilience.fault_point("build_graph")
-        if wants_plan:
+        if wants_plan and sstep_plan.family != "sort":
+            from graphmine_tpu.ops.blocking import (
+                build_graph_and_blocked_plan,
+                plan_build_stats,
+            )
             from graphmine_tpu.ops.bucketed_mode import build_graph_and_plan
 
-            g, plan = build_graph_and_plan(
+            builder = (
+                build_graph_and_blocked_plan
+                if sstep_plan.family == "blocked" else build_graph_and_plan
+            )
+            t0 = time.perf_counter()
+            g, plan = builder(
                 table.src, table.dst, num_vertices=table.num_vertices,
                 edge_weights=table.weights,
+            )
+            # plan_build: the host plan cost, visible in obs_report
+            # instead of hiding inside first-call latency (the
+            # impl_selected record above already carries the rationale).
+            m.emit(
+                "plan_build", op="lpa_superstep",
+                seconds=round(time.perf_counter() - t0, 6), cached=False,
+                **plan_build_stats(plan, table.num_edges),
             )
             # single-element holder, not the bare plan: the LPA loop can
             # release the fused plan's padded device matrices when the
@@ -260,7 +313,8 @@ def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
     else:
         with m.span("lpa"):
             labels = _run_lpa(
-                config, table, graph, m, plan_holder, n_dev, run_plan
+                config, table, graph, m, plan_holder, n_dev, run_plan,
+                sstep_plan,
             )
         q = None
 
@@ -602,7 +656,7 @@ def _emit_superstep_telemetry(
 
 def _run_lpa(
     config: PipelineConfig, table: EdgeTable, graph: Graph, m: MetricsSink,
-    plan_holder: list, n_dev: int, run_plan,
+    plan_holder: list, n_dev: int, run_plan, sstep_plan=None,
 ):
     """Community detection with backend dispatch, checkpointing and
     per-iteration metrics. Runs iterations one jit call at a time so the
@@ -749,18 +803,46 @@ def _run_lpa(
             current["chunk_size"] = graph.num_vertices
             step = jax.jit(lpa_superstep)
             return lambda lbl: step(lbl, graph)
-        # "single": fused degree-bucketed kernel (ops/bucketed_mode.py):
-        # ~3x the sort-based superstep, identical labels. The plan was
-        # built alongside the Graph from one shared message-CSR pass
-        # (wants_plan in run_pipeline is true exactly for this branch).
+        if variant == "single_bucketed":
+            # Blocked→bucketed degradation rung (r7): the blocked plan's
+            # tile + stream arrays were released on entry (plan_holder
+            # cleared below); rebuild the degree-bucketed fused plan —
+            # identical labels, less HBM than tile + rows — and record
+            # its host cost like every other plan build.
+            from graphmine_tpu.ops.blocking import plan_build_stats
+            from graphmine_tpu.ops.bucketed_mode import lpa_superstep_bucketed
+            from graphmine_tpu.ops.lpa import _cached_auto_plan
+
+            plan, secs, cached = _cached_auto_plan(graph, "bucketed")
+            m.emit(
+                "plan_build", op="lpa_superstep", seconds=round(secs, 6),
+                cached=cached, **plan_build_stats(plan, graph.num_edges),
+            )
+            current["chunk_size"] = graph.num_vertices
+            step = jax.jit(lpa_superstep_bucketed)
+            return lambda lbl: step(lbl, graph, plan)
+        # "single": the planner-resolved fused plan family — the
+        # degree-bucketed kernel (ops/bucketed_mode.py, ~3x the sort
+        # superstep) or the propagation-blocking bin-then-reduce engine
+        # (ops/blocking.py, past the gather roofline); identical labels
+        # either way. The plan was built alongside the Graph from one
+        # shared message-CSR pass (wants_plan in run_pipeline is true
+        # exactly for this branch).
+        from graphmine_tpu.ops.blocking import (
+            BlockedPlan,
+            lpa_superstep_blocked,
+        )
         from graphmine_tpu.ops.bucketed_mode import lpa_superstep_bucketed
 
         if plan_holder[0] is None:
             raise ValueError("single-device LPA requires the fused plan "
                              "built by run_pipeline (wants_plan)")
         current["chunk_size"] = graph.num_vertices
-        step = jax.jit(lpa_superstep_bucketed)
         plan = plan_holder[0]
+        step = jax.jit(
+            lpa_superstep_blocked if isinstance(plan, BlockedPlan)
+            else lpa_superstep_bucketed
+        )
         return lambda lbl: step(lbl, graph, plan)
 
     def save_ck(iteration: int) -> None:
@@ -1010,7 +1092,10 @@ def _run_lpa(
         elastic_device_ladder,
     )
 
-    rungs = degradation_ladder(run_plan.schedule, n_dev)
+    rungs = degradation_ladder(
+        run_plan.schedule, n_dev,
+        family=sstep_plan.family if sstep_plan is not None else "bucketed",
+    )
     # Elastic device rungs (DEGRADABLE_DEVICE failures): halved mesh,
     # resumed from salvage/checkpoint, running the variant CURRENT at
     # descent time (variant=None) — a memory degradation that already
@@ -1029,9 +1114,19 @@ def _run_lpa(
             device_rungs.append(
                 ("single_sort@1dev", make_runner("single_sort", 1))
             )
+    # An explicitly forced "sort" family (env) runs the sort superstep
+    # as its primary — no plan was built, and "single" would demand one.
+    primary = (
+        "single_sort"
+        if (
+            run_plan.schedule == "single"
+            and sstep_plan is not None and sstep_plan.family == "sort"
+        )
+        else run_plan.schedule
+    )
     with maybe_profile(config.profile_dir, sink=m):
         labels = resilience.run_phase(
-            "lpa", make_runner(run_plan.schedule), policy, m,
+            "lpa", make_runner(primary), policy, m,
             ladder=tuple((v, make_runner(v)) for v in rungs),
             device_ladder=tuple(device_rungs),
             # supersteps advanced since the last failure => a NEW incident:

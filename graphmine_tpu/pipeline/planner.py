@@ -139,7 +139,9 @@ def estimate_bytes_per_device(
     raise ValueError(f"unknown schedule {schedule!r}")
 
 
-def degradation_ladder(schedule: str, num_devices: int) -> list[str]:
+def degradation_ladder(
+    schedule: str, num_devices: int, family: str = "bucketed"
+) -> list[str]:
     """Successive LPA operating points after resource exhaustion under
     ``schedule`` — the planner's answer to "the plan fit on paper but the
     device disagreed" (fragmentation, a co-tenant, an optimistic budget).
@@ -147,6 +149,11 @@ def degradation_ladder(schedule: str, num_devices: int) -> list[str]:
     Each rung trades speed for strictly less per-device memory, per the
     model above:
 
+    - ``single`` with the ``blocked`` plan family (r7) →
+      ``single_bucketed`` → ``single_sort``: first drop the blocked
+      plan's tile + stream arrays and rebuild the degree-bucketed fused
+      plan (the r5/r6-measured path, less HBM than tile + rows), then
+      drop plans entirely for the sort superstep.
     - ``single`` → ``single_sort``: drop the fused kernel's padded bucket
       matrices and per-bucket gather transients (~5E of the 36 B/edge);
       the plain sort-based superstep runs over the bare message CSR.
@@ -160,6 +167,10 @@ def degradation_ladder(schedule: str, num_devices: int) -> list[str]:
     last good label state, recording a ``degrade`` metrics event.
     """
     if schedule == "single" or num_devices <= 1:
+        if family == "blocked":
+            return ["single_bucketed", "single_sort"]
+        if family == "sort":
+            return []  # already the memory floor; the failure surfaces
         return ["single_sort"]
     if schedule == "replicated":
         return ["ring"]
@@ -188,6 +199,48 @@ def elastic_device_ladder(schedule: str, num_devices: int) -> list[int]:
         rungs.append(d)
         d //= 2
     return rungs
+
+
+@dataclass(frozen=True)
+class SuperstepPlan:
+    """Resolved superstep plan family for one graph (r7).
+
+    ``family`` is the selected layout (``"blocked"`` / ``"bucketed"`` /
+    ``"sort"``); ``degrade_to`` is the family a resource failure steps
+    down to — blocked degrades to bucketed (drop the tile + stream
+    arrays, keep dense rows), bucketed to sort (drop all padded plan
+    matrices), sort has nowhere leaner to go."""
+
+    family: str        # "blocked" | "bucketed" | "sort"
+    degrade_to: str    # next rung's family
+    reason: str        # one-line selection rationale (measured provenance)
+
+
+_SUPERSTEP_DEGRADE = {"blocked": "bucketed", "bucketed": "sort", "sort": "sort"}
+
+
+def plan_superstep(
+    num_vertices: int, num_messages: int, requested: str = "auto",
+    weighted: bool = False,
+) -> SuperstepPlan:
+    """Resolve the LPA/CC superstep plan family at plan time.
+
+    Thin planner wrapper over
+    :func:`graphmine_tpu.ops.blocking.select_superstep_family` (the
+    single crossover-policy owner, with the measured-provenance table)
+    so the driver's single-device dispatch AND its blocked→bucketed
+    degradation rung come from one plan-time decision — the same
+    treatment :func:`plan_lof` gives the IVF flip. NOTE: imports the ops
+    layer (hence jax) lazily, like ``plan_lof``.
+    """
+    from graphmine_tpu.ops.blocking import select_superstep_family
+
+    family, reason = select_superstep_family(
+        num_vertices, num_messages, requested=requested, weighted=weighted
+    )
+    return SuperstepPlan(
+        family=family, degrade_to=_SUPERSTEP_DEGRADE[family], reason=reason
+    )
 
 
 @dataclass(frozen=True)

@@ -89,6 +89,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "blocking: propagation-blocking superstep suite "
+        "(tests/test_blocking.py: blocked-vs-sort bit parity for "
+        "LPA/CC/PageRank fused + sharded, the crossover policy owner, "
+        "plan_build records, the blocking bench-tier smoke); runs in the "
+        "default CPU pass — select with -m blocking or "
+        "tools/run_tier1.sh --blocking-only",
+    )
+    config.addinivalue_line(
+        "markers",
         "slo: serving-SLO observability suite (tests/test_slo.py: "
         "bucket histograms + merge associativity, live /metrics and "
         "/statusz under the query hammer, quantile agreement vs the "

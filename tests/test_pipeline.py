@@ -214,13 +214,21 @@ def test_lof_auto_policy_deploys_through_driver(tmp_path, monkeypatch):
         )
 
     res = run_pipeline(cfg())
-    sel = [r for r in res.metrics.records if r["phase"] == "impl_selected"]
+    # the driver now also records the LPA superstep-family selection
+    # (r7, op="lpa_superstep"); the LOF assertion keys on its op
+    sel = [
+        r for r in res.metrics.records
+        if r["phase"] == "impl_selected" and r["op"] == "lof_knn"
+    ]
     assert sel and sel[0]["impl"] == "exact" and sel[0]["requested"] == "auto"
     assert res.lof is not None and res.lof.shape == (800,)
 
     monkeypatch.setenv("GRAPHMINE_LOF_IVF_MIN_N", "500")
     res2 = run_pipeline(cfg())
-    sel2 = [r for r in res2.metrics.records if r["phase"] == "impl_selected"]
+    sel2 = [
+        r for r in res2.metrics.records
+        if r["phase"] == "impl_selected" and r["op"] == "lof_knn"
+    ]
     assert sel2 and sel2[0]["impl"] == "ivf"
     assert res2.lof is not None
     # approximate scores track the exact run

@@ -148,6 +148,19 @@ def _phase_waterfall(records, t0):
                 f" (n={r.get('n', '?')}, k={r.get('k', '?')}) — "
                 f"{r.get('reason', '')}"
             )
+    # plan builds (r7): the host cost of materializing a superstep plan
+    # (bins/buckets + padded slots/edge) — visible here instead of
+    # hiding inside first-call latency.
+    for r in records:
+        if r.get("phase") == "plan_build":
+            cached = " (cached)" if r.get("cached") else ""
+            out.append(
+                f"  [plan_build] {r.get('op', '?')}: {r.get('family', '?')}"
+                f" in {float(r.get('seconds', 0.0)):.3f}s{cached} — "
+                f"bins={r.get('bins', '?')}, "
+                f"classes={r.get('width_classes', '?')}, "
+                f"slots/edge={r.get('padded_slots_per_edge', '?')}"
+            )
     return out
 
 

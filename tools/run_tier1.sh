@@ -7,7 +7,7 @@
 # (-m faults: tests/test_resilience.py + the tripwire/reshard cases in
 # tests/test_sharded.py) is part of this default pass.
 #
-# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only|--slo-only] [extra pytest args...]
+# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only|--slo-only|--blocking-only] [extra pytest args...]
 #   --faults-only  run just the `faults`-marked recovery suite — the fast
 #                  pre-commit loop when iterating on resilience paths
 #   --obs-only     run just the `obs`-marked tracing/telemetry suite
@@ -22,6 +22,11 @@
 #                  (tests/test_serve.py: snapshot round-trip/rollback,
 #                  delta repair equivalence, query engine, live-swap
 #                  server) — the fast slice when iterating on serve/
+#   --blocking-only run just the `blocking`-marked propagation-blocking
+#                  suite (tests/test_blocking.py: blocked-vs-sort bit
+#                  parity for LPA/CC/PageRank fused + sharded, crossover
+#                  policy, plan_build records, bench-tier smoke) — the
+#                  fast slice when iterating on ops/blocking.py
 #   --slo-only     run just the `slo`-marked serving-SLO suite
 #                  (tests/test_slo.py: histograms + merge associativity,
 #                  live /metrics + /statusz under the query hammer,
@@ -47,6 +52,9 @@ elif [ "${1:-}" = "--serve-only" ]; then
 elif [ "${1:-}" = "--slo-only" ]; then
     shift
     MARKER='slo and not slow'
+elif [ "${1:-}" = "--blocking-only" ]; then
+    shift
+    MARKER='blocking and not slow'
 fi
 
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
