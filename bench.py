@@ -774,6 +774,106 @@ def main_stream() -> None:
     )
 
 
+def _serve_write_load(tmp, src, dst, labels, cc, lof, fp, v):
+    """The serve tier's sustained-write-load sub-record: fire burst
+    batches from concurrent submitters at 3 intensities and record the
+    admission outcome mix. Bounds scale with intensity so the high rung
+    actually sheds — the record captures degradation BEHAVIOR, not just
+    throughput."""
+    import threading
+
+    from graphmine_tpu.serve.admission import (
+        AdmissionBounds,
+        AdmissionController,
+    )
+    from graphmine_tpu.serve.server import SnapshotServer
+    from graphmine_tpu.serve.snapshot import SnapshotStore
+    from graphmine_tpu.testing import faults as _faults
+
+    intensities = (
+        ("low", 6, 20), ("medium", 10, 60), ("high", 14, 180),
+    )
+    if not _CPU_FALLBACK:
+        intensities = (
+            ("low", 8, 100), ("medium", 12, 400), ("high", 16, 1600),
+        )
+    out = []
+    arrays = {
+        "src": src, "dst": dst, "labels": labels, "cc_labels": cc, "lof": lof,
+    }
+    for name, batches, rows in intensities:
+        root = os.path.join(tmp, f"wl_{name}")
+        store = SnapshotStore(root)
+        store.publish(arrays, fingerprint=fp)
+        bounds = AdmissionBounds(
+            max_pending_rows=max(rows * batches // 2, rows + 1),
+            max_queue_depth=4,
+            deadline_s=120.0,
+        )
+        server = SnapshotServer(
+            store, admission=AdmissionController(bounds=bounds)
+        )
+        payloads = _faults.delta_burst(
+            v, batches=batches, rows_per_batch=rows, seed=13,
+            delete_frac=0.2, base_src=src, base_dst=dst,
+        )
+        debt_high = [0]
+        stop = threading.Event()
+
+        def _sample():
+            while not stop.is_set():
+                debt_high[0] = max(
+                    debt_high[0], server.debt.snapshot()["pending_rows"]
+                )
+                time.sleep(0.005)
+
+        results = []
+        t0 = time.perf_counter()
+        sampler = threading.Thread(target=_sample)
+        sampler.start()
+        threads = []
+        for p in payloads:
+            t = threading.Thread(
+                target=lambda pl=p: results.append(server.apply_delta(pl))
+            )
+            t.start()
+            threads.append(t)
+            time.sleep(0.002)
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        stop.set()
+        sampler.join()
+        server.stop()
+        verdicts = server.admission.snapshot()["verdicts"]
+        debt = server.debt.snapshot()
+        applies = debt["applies_warm"] + debt["applies_cold"]
+        shed = sum(1 for r in results if r.get("verdict") == "shed")
+        out.append({
+            "intensity": name,
+            "batches": batches,
+            "rows_per_batch": rows,
+            "seconds": round(elapsed, 3),
+            "accepted_batches": len(results) - shed,
+            "shed_batches": shed,
+            "verdicts": verdicts,
+            "applies": applies,
+            "publishes_per_sec": round(applies / elapsed, 3)
+            if elapsed > 0 else 0.0,
+            "accepted_rows_per_sec": round(
+                debt["rows_applied_total"] / elapsed
+            ) if elapsed > 0 else 0,
+            "coalesced_into": round(
+                (len(results) - shed) / applies, 2
+            ) if applies else None,
+            "debt_high_water_rows": debt_high[0],
+            "debt_bound_rows": bounds.max_pending_rows,
+            "warm_ratio": debt["warm_ratio"],
+            "lof_deferred": server.admission.snapshot()["lof_deferred"],
+        })
+    return out
+
+
 def main_serve() -> None:
     """Serving tier (r7, docs/SERVING.md): the steady-state numbers the
     serve/ subsystem exists for — query resolve throughput (single-vertex
@@ -920,6 +1020,15 @@ def main_serve() -> None:
                 if repair_s > 0 else None,
                 "version": rec["version"],
             })
+
+        # sustained write load through the admission path (r8): concurrent
+        # burst submitters against one server at three intensities —
+        # accepted/coalesced/shed mix, publish cadence and the repair-debt
+        # high-water mark are the overload numbers the next silicon window
+        # should capture alongside the delta ladder (ROADMAP silicon
+        # backlog). In-process apply_delta (no HTTP) so the measured path
+        # is admission + coalesce + repair, not socket handling.
+        write_load = _serve_write_load(tmp, src, dst, labels, cc, lof, fp, v)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -957,6 +1066,10 @@ def main_serve() -> None:
                     },
                     "query_stages": engine.stage_snapshot(),
                     "delta_ladder": ladder,
+                    # admission-path degradation under sustained write
+                    # bursts (accepted/coalesced/shed mix, publish
+                    # cadence, debt high-water vs bound per intensity)
+                    "write_load": write_load,
                     "device": str(jax.devices()[0]),
                 },
             }

@@ -89,6 +89,18 @@ register("access_log", "method", "endpoint", "status", "seconds",
          "request_id")
 register("slo_rollup", "uptime_s", "endpoints", "repair_debt")
 
+# ---- serving admission control (docs/SERVING.md "admission control") ------
+# admission: one per AdmissionController.resolve — the provenance trail
+# of every accept/queue/coalesce/shed verdict with the debt state that
+# decided it; delta_coalesce: one per merged apply group; delta_shed:
+# one per refused/dropped batch (stage says where: admission front door,
+# deadline expiry on the queue, shutdown drain).
+register("admission", "verdict", "reason", "queue_depth", "rows",
+         "repair_debt")
+register("delta_coalesce", "batches", "inserts", "deletes", "rows_in",
+         "rows_out")
+register("delta_shed", "stage", "reason", "rows", "retry_after_s")
+
 # ---- recovery / resilience records (docs/RESILIENCE.md) -------------------
 register("retry", "stage", "attempt", "backoff_s", "error")
 register("retries_exhausted", "stage", "attempts", "error")
@@ -107,7 +119,7 @@ RECOVERY_PHASES = frozenset((
     "retry", "retries_exhausted", "degrade", "mesh_degrade", "tripwire",
     "watchdog_timeout", "resume", "checkpoint_rollback",
     "checkpoint_rollback_ok", "ivf_fallback", "quarantine",
-    "repair_fallback",
+    "repair_fallback", "delta_shed",
 ))
 
 

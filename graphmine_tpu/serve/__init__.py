@@ -17,9 +17,20 @@ forced a cold full recompute. This package is the steady-state side
   snapshot, with a batched one-device-gather path;
 - :mod:`~graphmine_tpu.serve.server` — a stdlib HTTP front end that
   double-buffers snapshots so a delta publish swaps atomically under
-  live queries.
+  live queries;
+- :mod:`~graphmine_tpu.serve.admission` — write-path overload
+  protection: ONE policy owner resolving every incoming delta to
+  accept/queue/coalesce/shed against the live repair-debt state, with
+  order-exact delta coalescing and an LOF-defer degradation rung
+  (docs/SERVING.md "admission control").
 """
 
+from graphmine_tpu.serve.admission import (
+    AdmissionBounds,
+    AdmissionController,
+    AdmissionDecision,
+    coalesce_deltas,
+)
 from graphmine_tpu.serve.delta import (
     DeltaIngestor,
     EdgeDelta,
@@ -30,6 +41,9 @@ from graphmine_tpu.serve.query import QueryEngine
 from graphmine_tpu.serve.snapshot import Snapshot, SnapshotStore
 
 __all__ = [
+    "AdmissionBounds",
+    "AdmissionController",
+    "AdmissionDecision",
     "DeltaIngestor",
     "EdgeDelta",
     "QueryEngine",
@@ -37,4 +51,5 @@ __all__ = [
     "RepairResult",
     "Snapshot",
     "SnapshotStore",
+    "coalesce_deltas",
 ]

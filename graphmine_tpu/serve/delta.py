@@ -62,12 +62,21 @@ MAX_NEW_VERTICES = 1 << 20
 
 @dataclass
 class EdgeDelta:
-    """One edge insert/delete batch (directed endpoints, dense ids)."""
+    """One edge insert/delete batch (directed endpoints, dense ids).
+
+    ``insert_weight``: optional float32 per-insert edge weights (weighted
+    snapshots — r9). ``None`` = unweighted inserts; splicing into a
+    weighted snapshot then defaults them to 1.0. Deletes are always
+    keyed by ``(src, dst)`` alone — a delete removes ONE occurrence of
+    the directed edge, whatever its weight (multiset semantics; the
+    earliest-position occurrence goes first, deterministically).
+    """
 
     insert_src: np.ndarray = field(default_factory=lambda: np.empty(0, np.int64))
     insert_dst: np.ndarray = field(default_factory=lambda: np.empty(0, np.int64))
     delete_src: np.ndarray = field(default_factory=lambda: np.empty(0, np.int64))
     delete_dst: np.ndarray = field(default_factory=lambda: np.empty(0, np.int64))
+    insert_weight: np.ndarray | None = None
 
     def __post_init__(self):
         for name in ("insert_src", "insert_dst", "delete_src", "delete_dst"):
@@ -77,31 +86,68 @@ class EdgeDelta:
             or self.delete_src.shape != self.delete_dst.shape
         ):
             raise ValueError("src/dst arrays must be equal-length")
+        if self.insert_weight is not None:
+            w = np.asarray(self.insert_weight, np.float32)
+            if w.shape != self.insert_src.shape:
+                raise ValueError(
+                    "insert_weight must be one float per insert row"
+                )
+            if len(w) and (not np.isfinite(w).all() or (w < 0).any()):
+                raise ValueError(
+                    "insert_weight must be non-negative and finite"
+                )
+            self.insert_weight = w
 
     @classmethod
     def from_pairs(cls, insert=(), delete=()) -> "EdgeDelta":
         """Build from ``[(src, dst), ...]`` pair lists (the JSON wire
-        shape the HTTP front end accepts). Malformed input — null,
-        non-iterable, non-numeric, or fractional ids — raises ValueError
-        (the HTTP layer's 400), never TypeError, and never silently
-        truncates ``1.9`` to vertex ``1``. Integral floats (``40.0``,
-        which JSON encoders routinely emit for integers) are accepted.
+        shape the HTTP front end accepts); insert rows may uniformly be
+        ``(src, dst, weight)`` triples for weighted snapshots. Malformed
+        input — null, non-iterable, non-numeric, fractional ids, or
+        mixed 2/3-wide insert rows — raises ValueError (the HTTP
+        layer's 400), never TypeError, and never silently truncates
+        ``1.9`` to vertex ``1``. Integral floats (``40.0``, which JSON
+        encoders routinely emit for integers) are accepted as ids.
         """
 
         from graphmine_tpu.serve.query import _as_int_ids
 
-        def _pairs(name, pairs):
+        def _rows(name, pairs, widths):
             try:
                 lst = list(pairs)
             except TypeError as e:
                 raise ValueError(
                     f"{name} must be an array of [src, dst] pairs ({e})"
                 ) from e
-            return _as_int_ids(lst, name).reshape(-1, 2)
+            try:
+                seen = {len(r) for r in lst}
+            except TypeError as e:
+                raise ValueError(
+                    f"{name} rows must be [src, dst] pairs ({e})"
+                ) from e
+            if seen and seen not in [{w} for w in widths]:
+                raise ValueError(
+                    f"{name} rows must uniformly be "
+                    f"{' or '.join(str(w) for w in widths)} wide "
+                    f"(got widths {sorted(seen)})"
+                )
+            return lst, (seen.pop() if seen else widths[0])
 
-        ins = _pairs("insert", insert)
-        del_ = _pairs("delete", delete)
-        return cls(ins[:, 0], ins[:, 1], del_[:, 0], del_[:, 1])
+        ins, iw = _rows("insert", insert, (2, 3))
+        del_, _ = _rows("delete", delete, (2,))
+        weight = None
+        if iw == 3 and ins:
+            try:
+                weight = np.asarray([r[2] for r in ins], np.float32)
+            except (TypeError, ValueError) as e:
+                raise ValueError(f"insert weights must be numeric ({e})") from e
+            ins = [(r[0], r[1]) for r in ins]
+        ins_ids = _as_int_ids(ins, "insert").reshape(-1, 2)
+        del_ids = _as_int_ids(del_, "delete").reshape(-1, 2)
+        return cls(
+            ins_ids[:, 0], ins_ids[:, 1], del_ids[:, 0], del_ids[:, 1],
+            insert_weight=weight,
+        )
 
     @property
     def num_inserts(self) -> int:
@@ -141,22 +187,47 @@ def validate_delta(
     return EdgeDelta(
         delta.insert_src[ok_i], delta.insert_dst[ok_i],
         delta.delete_src[ok_d], delta.delete_dst[ok_d],
+        insert_weight=(
+            None if delta.insert_weight is None
+            else delta.insert_weight[ok_i]
+        ),
     ), q
 
 
-def splice_edges(src, dst, num_vertices: int, delta: EdgeDelta):
+def splice_edges(src, dst, num_vertices: int, delta: EdgeDelta, weights=None):
     """Apply a validated delta to host edge arrays.
 
     Inserts append (multiplicity kept); each delete row removes ONE
     matching directed occurrence (multiset delete — deleting an edge
-    that appears 3x leaves 2). Returns
+    that appears 3x leaves 2; the earliest array position goes first,
+    which makes weighted splices deterministic too). Returns
     ``(src', dst', num_vertices', stats)`` with
     ``stats = {inserted, deleted, unmatched_deletes}``; the vertex space
     only ever grows (deletes remove edges, never vertices — stable ids
     are the serving contract).
+
+    ``weights``: the snapshot's per-edge float weights (weighted graphs,
+    r9). When given, the return is the FIVE-tuple
+    ``(src', dst', weights', num_vertices', stats)`` — deleted rows drop
+    their weight with them, inserted rows carry ``delta.insert_weight``
+    (default 1.0 when the delta is unweighted). Passing a weighted delta
+    against ``weights=None`` raises: silently discarding client weights
+    would change weighted-LPA semantics without a trace.
     """
     src = np.asarray(src, np.int64)
     dst = np.asarray(dst, np.int64)
+    if weights is None and delta.insert_weight is not None:
+        raise ValueError(
+            "delta carries insert weights but the snapshot is unweighted; "
+            "republish the snapshot with a weights array or drop the "
+            "weight column from the delta"
+        )
+    if weights is not None:
+        weights = np.asarray(weights, np.float32)
+        if weights.shape != src.shape:
+            raise ValueError(
+                f"weights has {weights.shape} entries for {src.shape} edges"
+            )
     v_new = int(
         max(
             num_vertices,
@@ -193,6 +264,13 @@ def splice_edges(src, dst, num_vertices: int, delta: EdgeDelta):
         "deleted": int((~keep).sum()),
         "unmatched_deletes": unmatched,
     }
+    if weights is not None:
+        ins_w = (
+            delta.insert_weight if delta.insert_weight is not None
+            else np.ones(delta.num_inserts, np.float32)
+        )
+        w2 = np.concatenate([weights[keep], ins_w]).astype(np.float32)
+        return src2.astype(np.int32), dst2.astype(np.int32), w2, v_new, stats
     return src2.astype(np.int32), dst2.astype(np.int32), v_new, stats
 
 
@@ -472,6 +550,8 @@ class RepairDebt:
         self.budget_granted_total = 0
         self.last_budget_frac = 0.0
         self.rows_applied_total = 0
+        self.sheds_total = 0
+        self.rows_shed_total = 0
         self._registry = registry
 
     def submitted(self, rows: int, t: float | None = None) -> None:
@@ -481,12 +561,18 @@ class RepairDebt:
             self._pending_rows += int(rows)
         self._export()
 
-    def applied(self, method: str, iterations: int, budget: int) -> None:
-        """One delta batch published; drains the oldest pending entry
-        (no-op on the pending side when the ingestor is driven directly,
-        without a front end calling :meth:`submitted`)."""
+    def applied(
+        self, method: str, iterations: int, budget: int, batches: int = 1
+    ) -> None:
+        """One delta apply published; drains the ``batches`` oldest
+        pending entries — a coalesced apply settles every batch it
+        merged, not just one (no-op on the pending side when the
+        ingestor is driven directly, without a front end calling
+        :meth:`submitted`)."""
         with self._lock:
-            if self._pending:
+            for _ in range(max(1, int(batches))):
+                if not self._pending:
+                    break
                 _, rows = self._pending.popleft()
                 self._pending_rows -= rows
                 self.rows_applied_total += rows
@@ -524,14 +610,26 @@ class RepairDebt:
 
     def abandoned(self) -> None:
         """A submitted batch will never publish (validation raised, the
-        ingestor refused the snapshot): drop the oldest pending entry so
-        the ledger doesn't report a phantom backlog forever. FIFO is an
-        approximation under concurrent submitters — the ledger is
-        advisory telemetry, and totals rebalance as the queue drains."""
+        ingestor refused the snapshot, admission shed it off the queue):
+        drop the oldest pending entry so the ledger doesn't report a
+        phantom backlog forever. FIFO is an approximation under
+        concurrent submitters — the ledger is advisory telemetry, and
+        totals rebalance as the queue drains."""
         with self._lock:
             if self._pending:
                 _, rows = self._pending.popleft()
                 self._pending_rows -= rows
+        self._export()
+
+    def shed(self, rows: int) -> None:
+        """Admission control refused ``rows`` delta rows (a 503 the
+        client must retry) — the lost-write accounting the serve bench
+        tier's shed rate reads. Pure accounting: sheds at the front door
+        were never :meth:`submitted`, so nothing drains here (a
+        queued-then-shed batch pairs this with :meth:`abandoned`)."""
+        with self._lock:
+            self.sheds_total += 1
+            self.rows_shed_total += int(rows)
         self._export()
 
     def ingest_lag_s(self, now: float | None = None) -> float:
@@ -561,6 +659,8 @@ class RepairDebt:
                 "budget_granted_total": self.budget_granted_total,
                 "last_budget_frac": self.last_budget_frac,
                 "rows_applied_total": self.rows_applied_total,
+                "sheds_total": self.sheds_total,
+                "rows_shed_total": self.rows_shed_total,
             }
 
     def _export(self) -> None:
@@ -783,16 +883,22 @@ class DeltaIngestor:
                 "pipeline snapshot (--snapshot-out) before ingesting deltas"
             )
         self.snapshot = snap
-        if snap.get("weights") is not None:
-            raise ValueError(
-                "snapshot carries per-edge weights: delta repair runs "
-                "UNWEIGHTED propagations, and warm-repairing weighted-LPA "
-                "labels with unweighted supersteps would silently change "
-                "their semantics. Re-run the batch pipeline for weighted "
-                "graphs (weighted delta repair is a ROADMAP item)"
-            )
         self.src = np.asarray(snap["src"], np.int32)
         self.dst = np.asarray(snap["dst"], np.int32)
+        # Weighted snapshots ingest deltas end-to-end (r9): the graph is
+        # rebuilt with edge_weights, so warm LPA/sampled-check/cold
+        # fallback all run the WEIGHTED supersteps (weight-sum mode,
+        # ops/lpa.py) — CC is weight-oblivious min-propagation. The loud
+        # refusal below remains only for a genuinely unsupported shape:
+        # a weights column that doesn't align with the edge arrays.
+        w = snap.get("weights")
+        self.weights = None if w is None else np.asarray(w, np.float32)
+        if self.weights is not None and self.weights.shape != self.src.shape:
+            raise ValueError(
+                f"snapshot weights array has {self.weights.shape} entries "
+                f"for {self.src.shape} edges; this store is damaged or was "
+                "published by an incompatible writer — republish it"
+            )
         self.labels = np.asarray(snap["labels"], np.int32)
         self.cc_labels = np.asarray(
             snap.get("cc_labels", snap["labels"]), np.int32
@@ -812,6 +918,13 @@ class DeltaIngestor:
         # padded shard shapes of the last sharded apply (jit-cache
         # eviction key; see _clear_sharded_jit_caches)
         self._shard_jit_key = None
+        # LOF-staleness backlog (admission rung 2, serve/admission.py):
+        # vertices whose scores a deferred apply skipped. The next
+        # lof_mode="refresh" apply re-scores the union. A snapshot loaded
+        # already-stale has no backlog list — the first refresh then
+        # re-scores everything (rare, and the honest recovery).
+        self._stale_aff = np.empty(0, np.int64)
+        self._stale_all = bool(snap.meta.get("lof_stale", False))
 
     @property
     def num_vertices(self) -> int:
@@ -946,14 +1059,31 @@ class DeltaIngestor:
             self.lof = lof
         self._centers = self._stream._centers
 
-    def apply(self, delta: EdgeDelta) -> Snapshot:
+    def apply(
+        self, delta: EdgeDelta, lof_mode: str = "refresh", batches: int = 1,
+    ) -> Snapshot:
         """Validate, splice, repair, rescore and publish one delta batch.
 
         Returns the newly published snapshot (its ``parent`` is the
         snapshot this ingestor last published/loaded). Emits one
         ``delta_apply`` record carrying the quarantine counts, the repair
         method (warm vs fallback) and the per-stage outcome.
+
+        ``lof_mode="defer"`` (admission rung 2, serve/admission.py):
+        skip the per-delta LOF refresh — the dominant non-repair cost —
+        and publish with the outlier column marked stale
+        (``lof_stale`` manifest flag). Labels are NEVER deferred: repair
+        plus the sampled exact check run unconditionally, so served
+        labels stay verified. The deferred vertices accumulate and the
+        next ``refresh`` apply re-scores the whole backlog.
+
+        ``batches``: how many submitted delta batches this apply settles
+        in the debt ledger (a coalesced apply settles its whole group).
         """
+        if lof_mode not in ("refresh", "defer"):
+            raise ValueError(
+                f"lof_mode must be 'refresh' or 'defer', got {lof_mode!r}"
+            )
         t0 = time.perf_counter()
         span = (
             self.sink.span("delta_apply") if self.sink is not None
@@ -961,21 +1091,30 @@ class DeltaIngestor:
         )
         with span:
             clean, quarantine = validate_delta(delta, self.num_vertices)
-            src2, dst2, v2, stats = splice_edges(
-                self.src, self.dst, self.num_vertices, clean
-            )
+            if self.weights is not None:
+                src2, dst2, w2, v2, stats = splice_edges(
+                    self.src, self.dst, self.num_vertices, clean,
+                    weights=self.weights,
+                )
+            else:
+                src2, dst2, v2, stats = splice_edges(
+                    self.src, self.dst, self.num_vertices, clean
+                )
+                w2 = None
             quarantine["unmatched_deletes"] += stats.pop("unmatched_deletes")
             from graphmine_tpu.graph.container import build_graph
 
-            graph = build_graph(src2, dst2, num_vertices=v2)
+            graph = build_graph(
+                src2, dst2, num_vertices=v2, edge_weights=w2
+            )
             t_r = time.perf_counter()
             result = self._repair(graph, clean)
             repair_seconds = time.perf_counter() - t_r
-            self.src, self.dst = src2, dst2
+            self.src, self.dst, self.weights = src2, dst2, w2
             self.labels, self.cc_labels = result.labels, result.cc_labels
             aff = affected_vertices(clean)
             t_l = time.perf_counter()
-            self._refresh_lof(graph, result.labels, aff)
+            lof_stale = self._lof_pass(graph, result.labels, aff, lof_mode)
             lof_seconds = time.perf_counter() - t_l
 
             from graphmine_tpu.ops.census import census_table
@@ -991,13 +1130,18 @@ class DeltaIngestor:
                 "census_sizes": np.asarray(sizes),
                 "census_edges": np.asarray(edge_counts),
             }
+            if self.weights is not None:
+                arrays["weights"] = self.weights
             if self._centers is not None:
                 arrays["lof_centers"] = np.asarray(self._centers, np.float32)
             snap = self.store.publish(
                 arrays,
-                fingerprint=graph_fingerprint(self.src, self.dst),
+                fingerprint=graph_fingerprint(
+                    self.src, self.dst, self.weights
+                ),
                 run_id=self.snapshot.meta.get("run_id", ""),
                 mesh_shape=[self.num_shards],
+                extra_meta={"lof_stale": True} if lof_stale else None,
                 sink=self.sink,
             )
             self.snapshot = snap
@@ -1005,7 +1149,7 @@ class DeltaIngestor:
             # repair_debt snapshot reflects this apply as drained.
             self.debt.applied(
                 method=result.method, iterations=result.iterations,
-                budget=result.budget,
+                budget=result.budget, batches=batches,
             )
             if self.sink is not None:
                 self.sink.emit(
@@ -1020,6 +1164,9 @@ class DeltaIngestor:
                     version=snap.version,
                     num_vertices=v2,
                     num_edges=len(self.src),
+                    batches=int(batches),
+                    lof_mode=lof_mode,
+                    lof_stale=bool(lof_stale),
                     seconds=round(time.perf_counter() - t0, 4),
                     # stage split: the repair-vs-recompute comparison the
                     # bench serve tier reports is the repair term; LOF
@@ -1032,6 +1179,33 @@ class DeltaIngestor:
                     repair_debt=self.debt.snapshot(),
                 )
         return snap
+
+    def _lof_pass(
+        self, graph, labels: np.ndarray, aff: np.ndarray, lof_mode: str
+    ) -> bool:
+        """Refresh — or defer — the LOF column for this apply. Returns
+        whether the published column is stale. Deferred applies still
+        pad the column for vertex growth (new vertices score 0, same as
+        a refresh would seed them) so every published array stays
+        [V]-aligned."""
+        v = graph.num_vertices
+        if lof_mode == "defer":
+            if len(self.lof) < v:
+                self.lof = np.concatenate(
+                    [self.lof, np.zeros(v - len(self.lof), np.float32)]
+                )
+            self._stale_aff = np.union1d(self._stale_aff, aff.astype(np.int64))
+            return True
+        if self._stale_all:
+            # loaded from an already-stale snapshot with no backlog
+            # list: the only honest repair is re-scoring everything
+            aff = np.arange(v, dtype=np.int64)
+            self._stale_all = False
+        elif len(self._stale_aff):
+            aff = np.union1d(self._stale_aff, aff.astype(np.int64))
+        self._stale_aff = np.empty(0, np.int64)
+        self._refresh_lof(graph, labels, aff)
+        return False
 
 
 def _null_ctx():

@@ -71,6 +71,11 @@ class QueryEngine:
             "batches": 0, "ids": 0,
             "pad_seconds": 0.0, "gather_seconds": 0.0, "host_seconds": 0.0,
         }
+        # Deferred-LOF staleness (admission rung 2, serve/admission.py):
+        # when the publish skipped the outlier refresh under write
+        # pressure, results carry this flag so readers can tell a fresh
+        # score from one that predates the latest deltas.
+        self.lof_stale = bool(snapshot.meta.get("lof_stale", False))
         self.labels = np.asarray(snapshot["labels"], np.int32)
         v = len(self.labels)
         self.num_vertices = v

@@ -38,23 +38,48 @@ payload), and an ``X-Request-Id`` stamped on every response — propagated
 from the client when provided, generated otherwise, and carried by the
 record alongside the sink's span identity so one slow request joins the
 span timeline and the offline JSONL alike.
+
+**Write-path overload protection** (r9, docs/SERVING.md "admission
+control"): POST /delta no longer convoys on one publish lock. Every
+batch resolves through ONE
+:class:`~graphmine_tpu.serve.admission.AdmissionController` —
+accept/queue/coalesce/shed — and accepted batches park on a bounded
+apply queue drained by one background worker that MERGES everything
+waiting into a single splice + repair
+(:func:`~graphmine_tpu.serve.admission.coalesce_deltas`). Batches still
+queued when their deadline passes are shed (the client stopped
+listening); shed verdicts answer **503 + Retry-After** with a structured
+body, and ``/healthz`` carries an ``overloaded`` field driven by the
+same bounds so a balancer drains a saturated replica without duplicating
+thresholds.
 """
 
 from __future__ import annotations
 
 import hashlib
 import json
+import math
 import re
 import secrets
 import threading
 import time
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
 from graphmine_tpu.obs.registry import Registry
-from graphmine_tpu.serve.delta import DeltaIngestor, EdgeDelta, RepairDebt
+from graphmine_tpu.serve.admission import (
+    AdmissionController,
+    coalesce_deltas,
+)
+from graphmine_tpu.serve.delta import (
+    DeltaIngestor,
+    EdgeDelta,
+    RepairDebt,
+    validate_delta,
+)
 from graphmine_tpu.serve.query import QueryEngine
 from graphmine_tpu.serve.snapshot import SnapshotStore
 
@@ -98,6 +123,27 @@ def _jsonable(obj):
     return obj
 
 
+class _PendingDelta:
+    """One accepted batch parked on the apply queue. State transitions
+    (always under the queue condition's lock): ``queued`` →
+    ``applying`` → ``done``/``error``, or ``queued`` → ``shed``
+    (deadline passed / shutdown). ``event`` fires exactly once, at the
+    terminal transition."""
+
+    __slots__ = ("delta", "rows", "deadline", "status", "result", "error",
+                 "event", "shed_reason")
+
+    def __init__(self, delta: EdgeDelta, rows: int, deadline: float):
+        self.delta = delta
+        self.rows = rows
+        self.deadline = deadline
+        self.status = "queued"
+        self.result: dict | None = None
+        self.error: BaseException | None = None
+        self.event = threading.Event()
+        self.shed_reason = ""
+
+
 class SnapshotServer:
     """Query server + delta ingest endpoint over one snapshot store."""
 
@@ -110,6 +156,7 @@ class SnapshotServer:
         prom_out: str | None = None,
         num_shards: int = 1,
         slow_request_s: float = 1.0,
+        admission: AdmissionController | None = None,
     ):
         self.store = store
         self.sink = sink
@@ -122,6 +169,16 @@ class SnapshotServer:
             sink.registry if sink is not None else Registry()
         )
         self.debt = RepairDebt(registry=self.registry)
+        # The single write-path policy owner (serve/admission.py). A
+        # caller-supplied controller keeps its own bounds; the default
+        # reads GRAPHMINE_ADMIT_* env.
+        self.admission = admission if admission is not None else (
+            AdmissionController(sink=sink, registry=self.registry)
+        )
+        if self.admission.sink is None:
+            self.admission.sink = sink
+        if self.admission.registry is None:
+            self.admission.registry = self.registry
         snap = store.load(sink=sink)
         if snap is None:
             raise ValueError(
@@ -133,8 +190,16 @@ class SnapshotServer:
         self._engine = QueryEngine(snap)
         self._ingestor: DeltaIngestor | None = None
         # One publisher at a time — the store's generation rotation (and
-        # the ingestor's host state) assume it.
+        # the ingestor's host state) assume it. Held by the apply worker
+        # around each apply+swap, and by /reload.
         self._delta_lock = threading.Lock()
+        # The bounded apply queue (admission gates its depth) + the one
+        # background worker that drains/coalesces it.
+        self._queue: deque = deque()
+        self._queue_cv = threading.Condition()
+        self._applying = False
+        self._worker: threading.Thread | None = None
+        self._worker_stop = False
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self._host, self._port = host, port
@@ -169,6 +234,49 @@ class SnapshotServer:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        # Drain the apply worker: anything still queued is shed with a
+        # shutdown verdict (its submitter gets the structured 503 rather
+        # than a hung socket), then the worker exits its loop.
+        with self._queue_cv:
+            self._worker_stop = True
+            leftovers = list(self._queue)
+            self._queue.clear()
+            for p in leftovers:
+                p.status = "shed"
+                p.shed_reason = "server shutting down"
+            self._queue_cv.notify_all()
+        for p in leftovers:
+            self.debt.abandoned()
+            self.debt.shed(p.rows)
+            self.admission.record_shed(
+                p.shed_reason, p.rows, 0, self.debt.snapshot(),
+                stage="shutdown",
+            )
+            p.event.set()
+        if self._worker is not None:
+            self._worker.join(timeout=30)
+            self._worker = None
+        self._worker_stop = False
+
+    def _ensure_worker(self) -> None:
+        """Start the apply worker lazily (first delta) so in-process
+        users (serve_cli one-shots, the bench tier) get the full
+        admission path without calling :meth:`start`."""
+        with self._queue_cv:
+            if self._worker_stop:
+                # stop() is mid-shutdown: it already shed everything
+                # queued (including this caller's batch). Spawning a
+                # fresh worker here would clear the stop flag under
+                # stop()'s feet and leave it joining a thread that
+                # never exits.
+                return
+            if self._worker is not None and self._worker.is_alive():
+                return
+            self._worker = threading.Thread(
+                target=self._apply_worker, name="graphmine-delta-apply",
+                daemon=True,
+            )
+            self._worker.start()
 
     # -- snapshot swap ----------------------------------------------------
     @property
@@ -216,22 +324,206 @@ class SnapshotServer:
             return {"version": self._engine.version, "swapped": swapped}
 
     def apply_delta(self, payload: dict) -> dict:
-        """Ingest one delta batch (the POST /delta body) and swap the
-        fresh snapshot in under live queries."""
+        """Ingest one delta batch (the POST /delta body) through
+        admission control. Returns the publish result — or, on a shed,
+        a structured refusal dict (``verdict: "shed"``) the HTTP layer
+        turns into 503 + Retry-After.
+
+        The caller blocks until its batch publishes (possibly coalesced
+        with others — ``coalesced`` in the result says how many batches
+        the publish carried) or until its deadline passes while still
+        queued, in which case it is shed: an apply the client has
+        stopped waiting for would spend repair budget on an answer
+        nobody reads.
+        """
         delta = EdgeDelta.from_pairs(
             insert=payload.get("insert", ()), delete=payload.get("delete", ())
         )
-        # Debt accrues at ACCEPTANCE: batches queued on the publish lock
-        # are pending work the ledger (and /healthz) must already see.
-        self.debt.submitted(delta.num_inserts + delta.num_deletes)
+        if (
+            delta.insert_weight is not None
+            and self._engine.snapshot.get("weights") is None
+        ):
+            # Refuse HERE, before the batch can queue: merged into a
+            # coalesced group, this splice-time error would fail every
+            # innocent batch in the group with it (sequential applies
+            # would only fail this one).
+            raise ValueError(
+                "delta carries insert weights but the served snapshot is "
+                "unweighted; drop the weight column or republish a "
+                "weighted snapshot"
+            )
+        rows = delta.num_inserts + delta.num_deletes
+        # Only memory-cheap work happens under the queue lock (the
+        # worker, /healthz and every other handler contend on it); the
+        # sink's record writes — potentially a disk fsync each — happen
+        # after release.
+        with self._queue_cv:
+            if self._worker_stop:
+                # stop() already drained the queue; parking here would
+                # wait on a worker that is exiting
+                return self._shed_payload(
+                    "server shutting down",
+                    self.admission.bounds.retry_after_s,
+                )
+            debt_at_resolve = self.debt.snapshot()
+            decision = self.admission.resolve(
+                rows=rows, queue_depth=len(self._queue),
+                debt=debt_at_resolve, applying=self._applying, emit=False,
+            )
+            if decision.verdict != "shed":
+                # Debt accrues at ACCEPTANCE: batches parked on the
+                # apply queue are pending work the ledger (and
+                # /healthz) must already see — it is exactly what the
+                # shed bound reads.
+                self.debt.submitted(rows)
+                pending = _PendingDelta(
+                    delta, rows,
+                    time.monotonic() + self.admission.bounds.deadline_s,
+                )
+                self._queue.append(pending)
+                self._queue_cv.notify_all()
+        self.admission.emit_admission(decision, debt_at_resolve)
+        if decision.verdict == "shed":
+            self.debt.shed(rows)
+            self.admission.record_shed(
+                decision.reason, rows, decision.queue_depth,
+                self.debt.snapshot(),
+            )
+            return self._shed_payload(decision.reason, decision.retry_after_s)
+        self._ensure_worker()
+
+        # Wait for a terminal state. First leg: bounded by the deadline —
+        # a batch STILL QUEUED past it is shed here (deadline-aware
+        # shedding; the worker's pop applies the same rule, whichever
+        # side gets there first).
+        pending.event.wait(
+            max(0.0, pending.deadline - time.monotonic()) + 0.05
+        )
+        shed_now = False
+        with self._queue_cv:
+            if pending.status == "queued" and pending.deadline <= time.monotonic():
+                try:
+                    self._queue.remove(pending)
+                except ValueError:
+                    pass  # the worker popped it between wait and lock
+                else:
+                    pending.status = "shed"
+                    pending.shed_reason = (
+                        f"deadline {self.admission.bounds.deadline_s:g}s "
+                        "passed while queued"
+                    )
+                    shed_now = True
+        if shed_now:
+            self.debt.abandoned()
+            self.debt.shed(pending.rows)
+            self.admission.record_shed(
+                pending.shed_reason, pending.rows, len(self._queue),
+                self.debt.snapshot(), stage="deadline",
+            )
+            pending.event.set()
+        # Second leg: unbounded-by-deadline — once APPLYING, the apply
+        # finishes (its runtime is bounded by the repair budget) and the
+        # client gets the real outcome, never a 503 for published work.
+        pending.event.wait()
+        if pending.status == "done":
+            return pending.result
+        if pending.status == "shed":
+            return self._shed_payload(
+                pending.shed_reason, self.admission.bounds.retry_after_s
+            )
+        raise pending.error
+
+    def _shed_payload(self, reason: str, retry_after_s: float) -> dict:
+        return {
+            "verdict": "shed",
+            "error": "overloaded: delta shed by admission control",
+            "reason": reason,
+            "retry_after_s": float(retry_after_s),
+        }
+
+    # -- the apply worker --------------------------------------------------
+    def _pop_group(self) -> tuple[list, list]:
+        """Under the queue lock: pop everything waiting (bounded by
+        max_queue_depth — the queue never exceeds it by construction),
+        splitting expired-deadline batches out for shedding."""
+        group, expired = [], []
+        now = time.monotonic()
+        while self._queue:
+            p = self._queue.popleft()
+            if p.status != "queued":
+                continue  # a handler-side deadline shed won the race
+            if p.deadline <= now:
+                p.status = "shed"
+                p.shed_reason = (
+                    f"deadline {self.admission.bounds.deadline_s:g}s "
+                    "passed while queued"
+                )
+                expired.append(p)
+            else:
+                p.status = "applying"
+                group.append(p)
+        return group, expired
+
+    def _apply_worker(self) -> None:
+        """Drain the apply queue: one iteration = one coalesced publish.
+
+        Every popped batch is ALWAYS resolved (done/shed/error) — the
+        ``finally`` discipline below is what lets handlers block on
+        ``pending.event`` without a liveness caveat."""
+        while True:
+            with self._queue_cv:
+                while not self._queue and not self._worker_stop:
+                    self._queue_cv.wait(timeout=0.5)
+                if self._worker_stop and not self._queue:
+                    return
+                group, expired = self._pop_group()
+                self._applying = bool(group)
+            for p in expired:
+                try:
+                    # Telemetry must never take the worker down: a full
+                    # disk killing the sink's JSONL write would strand
+                    # every already-popped 'applying' batch on an event
+                    # that nobody will ever set.
+                    self.debt.abandoned()
+                    self.debt.shed(p.rows)
+                    self.admission.record_shed(
+                        p.shed_reason, p.rows, len(self._queue),
+                        self.debt.snapshot(), stage="deadline",
+                    )
+                except Exception:  # noqa: BLE001 — bookkeeping only
+                    pass
+                finally:
+                    p.event.set()
+            if not group:
+                continue
+            try:
+                result = self._apply_group(group)
+                for p in group:
+                    p.status, p.result = "done", result
+            except BaseException as e:  # resolve, then keep serving
+                for p in group:
+                    p.status, p.error = "error", e
+            finally:
+                with self._queue_cv:
+                    self._applying = False
+                for p in group:
+                    p.event.set()
+
+    def _apply_group(self, group: list) -> dict:
+        """Apply one popped group as a single publish: validate each
+        batch, coalesce when more than one waited, re-resolve the LOF
+        rung at apply time (pressure may have moved while they sat
+        queued), swap the fresh engine in."""
         with self._delta_lock:
-            # Applies settle the ledger inside apply(); they are
-            # serialized on this lock, so an unchanged applies_total at
-            # a raise means THIS batch never settled — drop its pending
-            # entry. (An apply that raised after settling — or a failing
-            # engine build on the already-published snapshot — must NOT
-            # drain a second entry belonging to a batch queued behind
-            # us.)
+            # Applies settle the ledger inside apply(); the worker is the
+            # only applier, so an unchanged applies_total at a raise
+            # means THIS group never settled — drop its pending entries.
+            # The guard covers the whole group path (ingestor build,
+            # validation, coalesce, apply): any of them failing means
+            # these batches will never publish. (An apply that raised
+            # after settling — or a failing engine build on the
+            # already-published snapshot — must NOT drain entries
+            # belonging to batches queued behind us.)
             settled_before = self.debt.applies_total
             try:
                 if self._ingestor is None:
@@ -240,30 +532,67 @@ class SnapshotServer:
                         num_shards=self.num_shards,
                         snapshot=self._engine.snapshot, debt=self.debt,
                     )
-                snap = self._ingestor.apply(delta)
+                ing = self._ingestor
+                if len(group) > 1:
+                    cleans, quarantined = [], 0
+                    # Validate each batch against the vertex space AS
+                    # GROWN by the batches before it — exactly what
+                    # sequential applies would see. Against the fixed
+                    # base count, a delete referencing a vertex an
+                    # earlier batch in the group created would be
+                    # quarantined here and the coalesced apply would
+                    # serve an edge the sequential applies delete.
+                    v_cur = ing.num_vertices
+                    for p in group:
+                        clean, q = validate_delta(p.delta, v_cur)
+                        cleans.append(clean)
+                        quarantined += sum(q.values())
+                        if clean.num_inserts:
+                            v_cur = max(
+                                v_cur,
+                                int(clean.insert_src.max()) + 1,
+                                int(clean.insert_dst.max()) + 1,
+                            )
+                    merged, info = coalesce_deltas(cleans, ing.src, ing.dst)
+                    info["quarantined_rows"] = quarantined
+                    self.admission.record_coalesce(info, self.debt.snapshot())
+                else:
+                    merged = group[0].delta
+                lof_mode = self.admission.lof_mode(self.debt.snapshot())
+                snap = ing.apply(
+                    merged, lof_mode=lof_mode, batches=len(group)
+                )
             except BaseException:
                 if self.debt.applies_total == settled_before:
-                    self.debt.abandoned()
+                    for _ in group:
+                        self.debt.abandoned()
                 raise
             self._swap(QueryEngine(snap))
         self.registry.counter(
             "graphmine_serve_deltas_total", "delta batches ingested"
-        ).inc()
+        ).inc(len(group))
         return {
             "version": snap.version,
             "snapshot_id": snap.snapshot_id,
             "num_vertices": int(len(snap["labels"])),
             "num_edges": int(len(snap["src"])),
+            "coalesced": len(group),
+            "lof_stale": bool(snap.meta.get("lof_stale", False)),
         }
 
     # -- SLO surfaces -----------------------------------------------------
     def healthz(self) -> dict:
-        """Liveness + staleness: version, snapshot age, repair debt —
-        enough for a load balancer to drain a replica serving stale
-        results without a second round trip."""
+        """Liveness + staleness: version, snapshot age, repair debt, and
+        the ``overloaded`` drain signal — enough for a load balancer to
+        drain a stale OR saturated replica without a second round trip
+        and without duplicating the admission thresholds (the field is
+        driven by the same bounds that decide the shed verdict)."""
         eng = self._engine
         debt = self.debt.snapshot()
-        return {
+        with self._queue_cv:
+            depth = len(self._queue)
+        overloaded, why = self.admission.overloaded(depth, debt)
+        out = {
             "ok": True,
             "version": eng.version,
             "snapshot_id": eng.snapshot.snapshot_id,
@@ -271,7 +600,13 @@ class SnapshotServer:
             "snapshot_age_s": self._snapshot_age_s(eng),
             "repair_debt_rows": debt["pending_rows"],
             "ingest_lag_s": debt["ingest_lag_s"],
+            "overloaded": overloaded,
+            "delta_queue_depth": depth,
+            "lof_stale": eng.lof_stale,
         }
+        if overloaded:
+            out["overload_reason"] = why
+        return out
 
     def _snapshot_age_s(self, eng: QueryEngine) -> float:
         created = eng.snapshot.meta.get("created")
@@ -313,6 +648,8 @@ class SnapshotServer:
         eng = self._engine
         with self._req_lock:
             inflight = self._inflight
+        with self._queue_cv:
+            depth, applying = len(self._queue), self._applying
         payload = {
             "version": eng.version,
             "snapshot_id": eng.snapshot.snapshot_id,
@@ -322,6 +659,12 @@ class SnapshotServer:
             "endpoints": self.endpoint_latency(),
             "repair_debt": self.debt.snapshot(),
             "query_stages": eng.stage_snapshot(),
+            "admission": {
+                **self.admission.snapshot(),
+                "queue_depth": depth,
+                "applying": applying,
+                "lof_stale": eng.lof_stale,
+            },
         }
         if self.sink is not None:
             self.sink.emit(
@@ -404,7 +747,7 @@ class SnapshotServer:
 
     # -- query plumbing (shared with serve_cli's in-process mode) ---------
     def vertex_row(self, engine: QueryEngine, v: int) -> dict:
-        return {
+        row = {
             "vertex": int(v),
             "label": engine.membership(v),
             "component": engine.component(v),
@@ -412,6 +755,12 @@ class SnapshotServer:
             "community_size": engine.community_size(v),
             "community_decile": engine.community_decile(v),
         }
+        if engine.lof_stale:
+            # deferred-refresh staleness flag (admission rung 2): the
+            # label/component columns are verified-fresh, the LOF score
+            # may predate the last few deltas
+            row["lof_stale"] = True
+        return row
 
     def record_batch(self, endpoint: str, n: int, seconds: float) -> None:
         if self.sink is not None:
@@ -432,18 +781,23 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # noqa: A003
         pass
 
-    def _reply(self, code: int, payload: dict) -> None:
+    def _reply(self, code: int, payload: dict, headers: dict | None = None) -> None:
         body = json.dumps(_jsonable(payload)).encode()
-        self._send(code, body, "application/json")
+        self._send(code, body, "application/json", headers=headers)
 
     def _reply_text(self, code: int, text: str, content_type: str) -> None:
         self._send(code, text.encode(), content_type)
 
-    def _send(self, code: int, body: bytes, content_type: str) -> None:
+    def _send(
+        self, code: int, body: bytes, content_type: str,
+        headers: dict | None = None,
+    ) -> None:
         self._status = code
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         rid = getattr(self, "_request_id", None)
         if rid:
             self.send_header("X-Request-Id", rid)
@@ -572,10 +926,23 @@ class _Handler(BaseHTTPRequestHandler):
         self.srv.record_batch(
             "query", len(out["vertex"]), time.perf_counter() - t0
         )
-        self._reply(200, {**out, "version": eng.version})
+        payload = {**out, "version": eng.version}
+        if eng.lof_stale:
+            payload["lof_stale"] = True
+        self._reply(200, payload)
 
     def _ep_delta(self, url) -> None:
-        self._reply(200, self.srv.apply_delta(self._body()))
+        out = self.srv.apply_delta(self._body())
+        if out.get("verdict") == "shed":
+            # the structured refusal: 503 + a Retry-After the client's
+            # backoff can obey without parsing the body
+            self._reply(503, out, headers={
+                "Retry-After": str(
+                    max(1, math.ceil(out.get("retry_after_s", 1.0)))
+                ),
+            })
+        else:
+            self._reply(200, out)
 
     def _ep_reload(self, url) -> None:
         self._reply(200, self.srv.reload())

@@ -143,30 +143,142 @@ def _release_abandoned_sleepers() -> None:
         _sleepers.clear()
 
 
+def _parked_sleep(seconds: float):
+    """An interruptible ``seconds`` sleep registered with the abandoned-
+    sleeper release (see ``_sleepers``) — shared by :func:`hang` and
+    :func:`slow_repair`."""
+    import threading
+
+    global _sleepers_lock
+    if _sleepers_lock is None:
+        _sleepers_lock = threading.Lock()
+    ev = threading.Event()
+    with _sleepers_lock:
+        _sleepers.append(ev)
+    ev.wait(seconds)
+    with _sleepers_lock:
+        if ev in _sleepers:
+            _sleepers.remove(ev)
+
+
 def hang(seconds: float):
     """Return a 'factory' that sleeps instead of raising — a hung device
     call for watchdog tests. The watchdog abandons the worker thread, so
     the sleep is interruptible: uninstalling the injector releases any
     abandoned sleepers (a process exiting right after the timeout must
     not race runtime teardown against a still-parked thread)."""
-    import threading
-
-    global _sleepers_lock
-    if _sleepers_lock is None:
-        _sleepers_lock = threading.Lock()
 
     def _sleep():
-        ev = threading.Event()
-        with _sleepers_lock:
-            _sleepers.append(ev)
-        ev.wait(seconds)
-        with _sleepers_lock:
-            if ev in _sleepers:
-                _sleepers.remove(ev)
+        _parked_sleep(seconds)
         return None
 
     _sleep.is_hang = True
     return _sleep
+
+
+# ---- serve-side injectors (ISSUE 8: write-path overload chaos) -------------
+
+
+def slow_repair(seconds: float):
+    """A slowed delta repair: install at the ``delta_repair`` seam
+    (``serve/delta.py::_verify_or_fallback``) with ``repeat=`` covering
+    the burst, and every apply stalls ``seconds`` before verifying —
+    the deterministic stand-in for a repair that outgrew its working
+    set. Unlike :func:`hang` it is the APPLY PATH that slows, so queued
+    deltas pile up behind the publish worker and the admission ladder
+    (coalesce → defer → shed) is what keeps the backlog bounded. The
+    sleep is interruptible on injector uninstall, and the repaired
+    state passes through untouched (``wants_ctx`` so the ctx-carrying
+    seam doesn't hand a positional payload to a plain factory)."""
+
+    def _stall(**ctx):
+        _parked_sleep(seconds)
+        return None
+
+    _stall.wants_ctx = True
+    _stall.is_slow_repair = True
+    return _stall
+
+
+def delta_burst(
+    num_vertices: int,
+    batches: int,
+    rows_per_batch: int,
+    seed: int = 0,
+    delete_frac: float = 0.0,
+    base_src=None,
+    base_dst=None,
+):
+    """Deterministic write-burst generator: ``batches`` POST /delta
+    payload dicts of ``rows_per_batch`` rows each, drawn from a seeded
+    RNG so a chaos test's admission verdicts replay identically.
+    ``delete_frac`` of each batch's rows are deletes sampled from
+    ``base_src``/``base_dst`` (matching deletes) when given, else from
+    the id space (mostly-unmatched deletes — the quarantine path)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    n_del = int(rows_per_batch * delete_frac)
+    n_ins = rows_per_batch - n_del
+    payloads = []
+    for _ in range(batches):
+        ins = rng.integers(0, num_vertices, size=(n_ins, 2))
+        payload = {"insert": ins.tolist()}
+        if n_del:
+            if base_src is not None and len(base_src):
+                idx = rng.integers(0, len(base_src), n_del)
+                payload["delete"] = [
+                    [int(base_src[i]), int(base_dst[i])] for i in idx
+                ]
+            else:
+                payload["delete"] = rng.integers(
+                    0, num_vertices, size=(n_del, 2)
+                ).tolist()
+        payloads.append(payload)
+    return payloads
+
+
+def slow_client_post(
+    host: str,
+    port: int,
+    path: str,
+    payload: dict,
+    chunk_bytes: int = 8,
+    delay_s: float = 0.01,
+    timeout_s: float = 30.0,
+):
+    """POST ``payload`` dribbling the body ``chunk_bytes`` at a time with
+    ``delay_s`` between writes — the slow-loris-shaped client a threaded
+    server must tolerate without stalling OTHER requests (each handler
+    thread blocks only on its own socket). Returns
+    ``(status_code, parsed_json_body)``."""
+    import json as _json
+    import socket
+
+    body = _json.dumps(payload).encode()
+    head = (
+        f"POST {path} HTTP/1.1\r\nHost: {host}:{port}\r\n"
+        f"Content-Type: application/json\r\n"
+        f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+    ).encode()
+    with socket.create_connection((host, port), timeout=timeout_s) as sock:
+        sock.sendall(head)
+        for i in range(0, len(body), chunk_bytes):
+            sock.sendall(body[i: i + chunk_bytes])
+            if delay_s:
+                import time as _time
+
+                _time.sleep(delay_s)
+        raw = b""
+        while True:
+            got = sock.recv(65536)
+            if not got:
+                break
+            raw += got
+    status_line, _, rest = raw.partition(b"\r\n")
+    status = int(status_line.split()[1])
+    _, _, resp_body = rest.partition(b"\r\n\r\n")
+    return status, _json.loads(resp_body.decode())
 
 
 @dataclass

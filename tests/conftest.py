@@ -98,6 +98,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "admission: write-path admission-control suite "
+        "(tests/test_admission.py: the accept/queue/coalesce/shed policy "
+        "owner, order-exact delta coalescing, deadline shedding, the "
+        "LOF-defer rung, and the overload chaos acceptance test); runs "
+        "in the default CPU pass — select with -m admission or "
+        "tools/run_tier1.sh --admission-only",
+    )
+    config.addinivalue_line(
+        "markers",
         "slo: serving-SLO observability suite (tests/test_slo.py: "
         "bucket histograms + merge associativity, live /metrics and "
         "/statusz under the query hammer, quantile agreement vs the "

@@ -441,10 +441,108 @@ def test_delta_check_samples_vary_across_applies(tmp_path, monkeypatch):
     assert not np.array_equal(seen[0], seen[1])
 
 
-def test_weighted_snapshot_refused_by_ingestor(tmp_path):
-    """A weighted run's snapshot keeps its weights array; the delta path
-    must refuse it loudly — unweighted repair supersteps would silently
-    change weighted-LPA label semantics."""
+def _publish_weighted(tmp_path, intra=2.0):
+    """A weighted community graph snapshot: heavy intra-clique edges +
+    weak bridges between cliques. Weighted LPA's weight-sum mode keeps
+    the cliques despite the bridges (the case unweighted repair would
+    get wrong — bridges count as full votes unweighted), and the
+    fixpoint is reachable from any init, which makes warm-vs-cold
+    equality decidable."""
+    src, dst, v = _community_graph(extra=[(0, 12), (12, 26)])
+    w = np.full(len(src), intra, np.float32)
+    w[-2:] = 0.25  # the bridges
+    g = build_graph(src, dst, num_vertices=v, edge_weights=w)
+    labels, cc, _ = cold_recompute(g)
+    store = SnapshotStore(str(tmp_path / "snap"))
+    store.publish(
+        {
+            "src": src, "dst": dst, "weights": w, "labels": labels,
+            "cc_labels": cc, "lof": np.zeros(v, np.float32),
+        },
+        fingerprint=graph_fingerprint(src, dst, w),
+    )
+    return store, src, dst, w, v
+
+
+@pytest.mark.parametrize(
+    "insert,delete",
+    [
+        ([(40, 12, 2.0), (40, 13, 2.0), (40, 14, 2.0)], []),
+        ([], [(0, 1), (0, 2), (26, 27)]),
+        ([(40, 26, 2.0), (40, 27, 2.0), (40, 28, 2.0)], [(12, 13), (12, 14)]),
+    ],
+    ids=["insert_only", "delete_only", "mixed"],
+)
+def test_weighted_delta_repair_matches_cold_weighted(tmp_path, insert, delete):
+    """Weighted snapshots ingest deltas end-to-end (ISSUE 8): the spliced
+    weights thread through warm repair and the sampled exact check via
+    the weighted-LPA supersteps, and the published labels equal a cold
+    WEIGHTED recompute of the spliced graph — the parity pin that says
+    weighted delta semantics are the batch pipeline's, not an unweighted
+    approximation."""
+    from graphmine_tpu.serve.delta import splice_edges as _splice
+
+    store, src, dst, w, v = _publish_weighted(tmp_path)
+    sink = _sink()
+    ing = DeltaIngestor(store, sink=sink, lof_k=4, check_samples=16)
+    delta = EdgeDelta.from_pairs(insert=insert, delete=delete)
+    snap = ing.apply(delta)
+    rec = [r for r in sink.records if r["phase"] == "delta_apply"][-1]
+    assert rec["method"] == "warm", rec
+    clean, _ = validate_delta(delta, v)
+    s2, d2, w2, v2, _ = _splice(src, dst, v, clean, weights=w)
+    cold_l, cold_c, _ = cold_recompute(
+        build_graph(s2, d2, num_vertices=v2, edge_weights=w2)
+    )
+    np.testing.assert_array_equal(snap["labels"], cold_l)
+    np.testing.assert_array_equal(snap["cc_labels"], cold_c)
+    np.testing.assert_array_equal(snap["weights"], w2)
+    assert validate_records(sink.records) == []
+
+
+def test_weighted_delta_default_weight_and_chaining(tmp_path):
+    """Weightless insert rows against a weighted snapshot default to
+    weight 1.0, and consecutive weighted deltas chain (the spliced
+    weights array stays edge-aligned across applies)."""
+    store, src, dst, w, v = _publish_weighted(tmp_path)
+    ing = DeltaIngestor(store, lof_k=4, check_samples=16)
+    ing.apply(EdgeDelta.from_pairs(insert=[(40, 12), (40, 13)]))
+    assert ing.weights is not None and len(ing.weights) == len(ing.src)
+    assert ing.weights[-1] == 1.0  # the defaulted insert
+    snap = ing.apply(EdgeDelta.from_pairs(delete=[(40, 12)]))
+    assert len(snap["weights"]) == len(snap["src"]) == len(src) + 1
+    # loads refuse under the wrong (unweighted) fingerprint: weighted
+    # and unweighted dynamics must never share a snapshot identity
+    with pytest.raises(FingerprintMismatch):
+        store.load(fingerprint=graph_fingerprint(snap["src"], snap["dst"]))
+
+
+def test_weighted_delta_refusals():
+    """The loud refusals that REMAIN after weighted ingest landed —
+    genuinely unsupported shapes only: a weighted delta against an
+    unweighted snapshot (silently dropping client weights would change
+    semantics), misaligned weights arrays, malformed wire weights."""
+    from graphmine_tpu.serve.delta import splice_edges as _splice
+
+    src, dst, v = _community_graph()
+    weighted_delta = EdgeDelta.from_pairs(insert=[(1, 2, 3.5)])
+    with pytest.raises(ValueError, match="unweighted"):
+        _splice(src, dst, v, weighted_delta)
+    with pytest.raises(ValueError, match="entries for"):
+        _splice(src, dst, v, weighted_delta,
+                weights=np.ones(3, np.float32))
+    with pytest.raises(ValueError, match="uniformly"):
+        EdgeDelta.from_pairs(insert=[(1, 2, 3.5), (1, 2)])
+    with pytest.raises(ValueError, match="non-negative"):
+        EdgeDelta.from_pairs(insert=[(1, 2, -1.0)])
+    with pytest.raises(ValueError, match="non-negative"):
+        EdgeDelta.from_pairs(insert=[(1, 2, float("nan"))])
+
+
+def test_weighted_snapshot_misaligned_weights_refused(tmp_path):
+    """A weights column that doesn't align with the edge arrays is a
+    damaged/incompatible store — the ingestor refuses loudly instead of
+    repairing with garbage."""
     src, dst, v = _community_graph()
     g = build_graph(src, dst, num_vertices=v)
     labels, cc, _ = cold_recompute(g)
@@ -452,11 +550,11 @@ def test_weighted_snapshot_refused_by_ingestor(tmp_path):
     store.publish(
         {
             "src": src, "dst": dst, "labels": labels, "cc_labels": cc,
-            "weights": np.ones(len(src), np.float32),
+            "weights": np.ones(len(src) - 3, np.float32),
         },
         fingerprint=graph_fingerprint(src, dst),
     )
-    with pytest.raises(ValueError, match="UNWEIGHTED"):
+    with pytest.raises(ValueError, match="damaged"):
         DeltaIngestor(store)
 
 

@@ -562,9 +562,11 @@ def test_statusz_emits_schema_valid_slo_rollup(tmp_path):
 
 
 def test_refused_delta_abandons_debt_without_double_drain(tmp_path):
-    """A delta the ingestor refuses (weighted snapshot) must drain its
-    OWN pending entry and nothing else — /healthz on a drained queue
-    reports zero backlog, and no phantom apply is counted."""
+    """A delta the ingestor refuses (a snapshot whose weights column is
+    misaligned with its edge arrays — the loud damaged-store refusal)
+    must drain its OWN pending entry and nothing else — /healthz on a
+    drained queue reports zero backlog, and no phantom apply is
+    counted."""
     src, dst, v = _community_graph()
     g = build_graph(src, dst, num_vertices=v)
     labels, cc, _ = cold_recompute(g)
@@ -572,7 +574,7 @@ def test_refused_delta_abandons_debt_without_double_drain(tmp_path):
     store.publish(
         {
             "src": src, "dst": dst, "labels": labels, "cc_labels": cc,
-            "weights": np.ones(len(src), np.float32),
+            "weights": np.ones(len(src) - 3, np.float32),
         },
         fingerprint=graph_fingerprint(src, dst),
     )

@@ -22,8 +22,11 @@ dir):
 - the **serving SLO** section: per-endpoint latency quantiles
   (nearest-rank over raw ``access_log`` seconds — the exact offline
   twin of the server's live bucket estimates), error/slow-request
-  rates, and the repair-debt timeline each ``delta_apply``'s ledger
-  snapshot traces out.
+  rates, the repair-debt timeline each ``delta_apply``'s ledger
+  snapshot traces out, and (r9) the **admission timeline** beside it —
+  every accept/queue/coalesce/shed verdict with the debt state that
+  decided it, coalesce merges, and shed events (RUNBOOKS §8 keys its
+  triage off this view).
 
 Usage::
 
@@ -313,7 +316,7 @@ def _slo_section(records, t0):
         for r in applies:
             debt = r["repair_debt"]
             budget = r.get("budget", "?")
-            out.append(
+            row = (
                 f"  {_fmt_offset(r, t0)}  v{r.get('version', '?')}"
                 f"  {r.get('method', '?'):<15}"
                 f"  supersteps={r.get('iterations', '?')}/{budget}"
@@ -321,6 +324,68 @@ def _slo_section(records, t0):
                 f"  lag={debt.get('ingest_lag_s', '?')}s"
                 f"  warm_ratio={debt.get('warm_ratio', '?')}"
             )
+            if int(r.get("batches", 1) or 1) > 1:
+                row += f"  coalesced={r['batches']}"
+            if r.get("lof_stale"):
+                row += "  LOF-STALE"
+            out.append(row)
+    out.extend(_admission_timeline(records, t0))
+    return out
+
+
+def _admission_timeline(records, t0):
+    """Admission-control timeline (r8, docs/SERVING.md "admission
+    control"): every resolve verdict with the debt state that decided
+    it, coalesce merges, and shed events — the first thing RUNBOOKS §8
+    says to read when /delta starts returning 503s. Rendered next to the
+    repair-debt timeline so "why did it shed" and "how far behind was
+    repair" line up on one clock."""
+    events = [
+        r for r in records
+        if r.get("phase") in ("admission", "delta_coalesce", "delta_shed")
+    ]
+    if not events:
+        return []
+    out = ["  admission timeline:"]
+    verdicts: dict = {}
+    for r in events:
+        phase = r["phase"]
+        debt = r.get("repair_debt") or {}
+        if phase == "admission":
+            verdicts[r.get("verdict", "?")] = (
+                verdicts.get(r.get("verdict", "?"), 0) + 1
+            )
+            out.append(
+                f"  {_fmt_offset(r, t0)}  admission  "
+                f"{r.get('verdict', '?'):<8}"
+                f"  rows={r.get('rows', '?')}"
+                f"  queue={r.get('queue_depth', '?')}"
+                f"  pending_rows={debt.get('pending_rows', '?')}"
+                f"  lag={debt.get('ingest_lag_s', '?')}s"
+                + (
+                    f"  [{r.get('reason', '')}]"
+                    if r.get("verdict") in ("shed",) else ""
+                )
+            )
+        elif phase == "delta_coalesce":
+            out.append(
+                f"  {_fmt_offset(r, t0)}  coalesce   "
+                f"{r.get('batches', '?')} batches -> "
+                f"+{r.get('inserts', '?')}/-{r.get('deletes', '?')} rows "
+                f"(cancelled={r.get('cancelled_pairs', 0)}, "
+                f"rows {r.get('rows_in', '?')}->{r.get('rows_out', '?')})"
+            )
+        else:  # delta_shed
+            out.append(
+                f"  {_fmt_offset(r, t0)}  SHED       "
+                f"stage={r.get('stage', '?')}  rows={r.get('rows', '?')}"
+                f"  retry_after={r.get('retry_after_s', '?')}s"
+                f"  [{r.get('reason', '')}]"
+            )
+    if verdicts:
+        total = sum(verdicts.values())
+        mix = "  ".join(f"{k}={v}" for k, v in sorted(verdicts.items()))
+        out.append(f"  admission verdicts: {total} resolutions ({mix})")
     return out
 
 

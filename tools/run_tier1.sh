@@ -7,7 +7,7 @@
 # (-m faults: tests/test_resilience.py + the tripwire/reshard cases in
 # tests/test_sharded.py) is part of this default pass.
 #
-# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only|--slo-only|--blocking-only] [extra pytest args...]
+# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only|--slo-only|--blocking-only|--admission-only] [extra pytest args...]
 #   --faults-only  run just the `faults`-marked recovery suite — the fast
 #                  pre-commit loop when iterating on resilience paths
 #   --obs-only     run just the `obs`-marked tracing/telemetry suite
@@ -33,6 +33,12 @@
 #                  quantile agreement vs the access_log JSONL, repair
 #                  debt, request tracing) — the fast slice when
 #                  iterating on the SLO observability layer
+#   --admission-only run just the `admission`-marked write-path
+#                  overload suite (tests/test_admission.py: the
+#                  accept/queue/coalesce/shed policy owner, order-exact
+#                  coalescing parity, deadline shedding, LOF-defer rung,
+#                  and the burst + slow-repair chaos acceptance test) —
+#                  the fast slice when iterating on serve/admission.py
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -55,6 +61,9 @@ elif [ "${1:-}" = "--slo-only" ]; then
 elif [ "${1:-}" = "--blocking-only" ]; then
     shift
     MARKER='blocking and not slow'
+elif [ "${1:-}" = "--admission-only" ]; then
+    shift
+    MARKER='admission and not slow'
 fi
 
 LOG="${TIER1_LOG:-/tmp/_t1.log}"

@@ -96,7 +96,15 @@ def cmd_delta(args) -> int:
     from graphmine_tpu.serve.delta import DeltaIngestor, EdgeDelta
 
     def pairs(values):
-        return [tuple(int(x) for x in v.split(",")) for v in values or ()]
+        # SRC,DST or (weighted snapshots) SRC,DST,WEIGHT
+        out = []
+        for v in values or ():
+            parts = v.split(",")
+            if len(parts) == 3:
+                out.append((int(parts[0]), int(parts[1]), float(parts[2])))
+            else:
+                out.append(tuple(int(x) for x in parts))
+        return out
 
     if args.file:
         with open(args.file) as f:
@@ -181,8 +189,9 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("delta", help="apply one insert/delete batch")
     common(p)
-    p.add_argument("--insert", action="append", metavar="SRC,DST",
-                   help="edge to insert (repeatable)")
+    p.add_argument("--insert", action="append", metavar="SRC,DST[,W]",
+                   help="edge to insert (repeatable; the third field is "
+                        "the edge weight for weighted snapshots)")
     p.add_argument("--delete", action="append", metavar="SRC,DST",
                    help="edge to delete (repeatable)")
     p.add_argument("--file", default=None,
@@ -190,7 +199,13 @@ def main(argv=None) -> int:
     p.add_argument("--num-shards", type=int, default=1)
     p.set_defaults(fn=cmd_delta)
 
-    p = sub.add_parser("serve", help="run the HTTP query server")
+    p = sub.add_parser(
+        "serve", help="run the HTTP query server",
+        description="Run the HTTP query server. Write-path admission "
+        "bounds (docs/SERVING.md 'admission control') come from the "
+        "GRAPHMINE_ADMIT_* environment: MAX_PENDING_ROWS, MAX_LAG_S, "
+        "MAX_QUEUE_DEPTH, DEFER_FRAC, DEADLINE_S, RETRY_AFTER_S.",
+    )
     common(p)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8337)
