@@ -874,6 +874,116 @@ def _serve_write_load(tmp, src, dst, labels, cc, lof, fp, v):
     return out
 
 
+def _serve_replicated_read(tmp, arrays, fp, v):
+    """The serve tier's replicated-read sub-record (r10): hammer the
+    SAME batched-query workload through the fleet router at 1 vs 3
+    replicas and record qps + tail latency. On the CPU fallback all
+    replicas share one interpreter (GIL), so the honest headline is the
+    ROUTER PATH's overhead and shape — per-process replica scaling is a
+    silicon/multi-host number (ROADMAP backlog); the record shape is
+    what the capture pipeline needs to exist either way."""
+    import threading
+
+    from graphmine_tpu.serve.fleet import (
+        FleetConfig,
+        FleetRouter,
+        ReplicaSpec,
+    )
+    from graphmine_tpu.serve.server import SnapshotServer
+    from graphmine_tpu.serve.snapshot import SnapshotStore
+
+    requests, hammer_threads, batch = (120, 4, 64)
+    if not _CPU_FALLBACK:
+        requests, hammer_threads, batch = (800, 8, 256)
+    rng = np.random.default_rng(17)
+    ids = rng.integers(0, v, batch).tolist()
+    payload = json.dumps({"vertices": ids}).encode()
+    out = []
+    for nrep in (1, 3):
+        root = os.path.join(tmp, f"replicated_{nrep}")
+        store = SnapshotStore(root)
+        store.publish(arrays, fingerprint=fp)
+        servers = [SnapshotServer(store) for _ in range(nrep)]
+        addrs = [s.start() for s in servers]
+        specs = [
+            ReplicaSpec(f"r{i}", h, p) for i, (h, p) in enumerate(addrs)
+        ]
+        router = FleetRouter(
+            specs, writer="r0",
+            config=FleetConfig(probe_interval_s=0.05, quorum=1,
+                               read_timeout_s=5.0),
+        )
+        rh, rp = router.start()
+        deadline = time.monotonic() + 30
+        while (
+            router.replica_set.committed_version() is None
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        import urllib.request
+
+        lat_lock = threading.Lock()
+        latencies = []
+        errors = [0]
+
+        def hammer(n, rh=rh, rp=rp):
+            local, errs = [], 0
+            for _ in range(n):
+                t0 = time.perf_counter()
+                try:
+                    req = urllib.request.Request(
+                        f"http://{rh}:{rp}/query", data=payload,
+                        headers={"Content-Type": "application/json"},
+                    )
+                    with urllib.request.urlopen(req, timeout=60) as r:
+                        r.read()
+                    local.append(time.perf_counter() - t0)
+                except Exception:  # noqa: BLE001 — count, keep hammering
+                    errs += 1
+            with lat_lock:
+                latencies.extend(local)
+                errors[0] += errs
+
+        per = requests // hammer_threads
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=hammer, args=(per,))
+            for _ in range(hammer_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        router.stop()
+        for s in servers:
+            s.stop()
+        ok_requests = len(latencies)
+        if ok_requests:
+            lat = np.asarray(sorted(latencies))
+            p50, p99 = np.percentile(lat, [50, 99])
+        else:  # every request failed: an honest zero row, not a crash
+            p50 = p99 = 0.0
+        out.append({
+            "replicas": nrep,
+            "requests": per * hammer_threads,
+            "ok": ok_requests,
+            "errors": errors[0],
+            "batch": batch,
+            "seconds": round(elapsed, 3),
+            "lookups_per_sec": round(ok_requests * batch / elapsed)
+            if elapsed > 0 else 0,
+            "p50_ms": round(float(p50) * 1e3, 2),
+            "p99_ms": round(float(p99) * 1e3, 2),
+        })
+    return {
+        "rungs": out,
+        "qps_3_over_1": round(
+            out[1]["lookups_per_sec"] / out[0]["lookups_per_sec"], 2
+        ) if out[0]["lookups_per_sec"] else None,
+    }
+
+
 def main_serve() -> None:
     """Serving tier (r7, docs/SERVING.md): the steady-state numbers the
     serve/ subsystem exists for — query resolve throughput (single-vertex
@@ -1029,6 +1139,13 @@ def main_serve() -> None:
         # backlog). In-process apply_delta (no HTTP) so the measured path
         # is admission + coalesce + repair, not socket handling.
         write_load = _serve_write_load(tmp, src, dst, labels, cc, lof, fp, v)
+
+        # replicated reads through the fleet router (r10): 1 vs 3
+        # replicas behind consistent-version routing — the router-path
+        # qps/p99 record the silicon backlog window should capture
+        # alongside write_load (CPU-fallback: replicas share the GIL,
+        # so this measures the routing tier, not replica scaling).
+        replicated_read = _serve_replicated_read(tmp, arrays, fp, v)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -1070,6 +1187,8 @@ def main_serve() -> None:
                     # bursts (accepted/coalesced/shed mix, publish
                     # cadence, debt high-water vs bound per intensity)
                     "write_load": write_load,
+                    # fleet-router read path at 1 vs 3 replicas (r10)
+                    "replicated_read": replicated_read,
                     "device": str(jax.devices()[0]),
                 },
             }
@@ -1937,7 +2056,9 @@ _CHILD_TIMEOUT_S = {
     "quality": 1200.0,
     "weighted": 900.0,
     "stream": 1200.0,
-    "serve": 1200.0,
+    # serve grew the replicated_read fleet sub-record in r10 (1- and
+    # 3-replica router hammers on top of write_load)
+    "serve": 1500.0,
 }
 
 # Healthy-TPU capture order: chip first (its number headlines the final

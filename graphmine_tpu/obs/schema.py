@@ -101,6 +101,21 @@ register("delta_coalesce", "batches", "inserts", "deletes", "rows_in",
          "rows_out")
 register("delta_shed", "stage", "reason", "rows", "retry_after_s")
 
+# ---- replicated serving fleet (docs/SERVING.md "Fleet") --------------------
+# replica_health: one per replica state-machine transition (joining/
+# healthy/degraded/draining/down) from the fleet prober or the rolling
+# reload; breaker_transition: one per circuit-breaker state change
+# (closed/open/half_open) with the deciding window stats; fleet_route:
+# one per routed request — verdict served/no_replica/stale_pin/
+# forwarded/read_only/writer_unreachable with the attempt count and the
+# version the response was pinned at; fleet_degraded: the loud read-only
+# flip when the writer is lost (and its restoration).
+register("replica_health", "replica", "from_state", "to_state", "reason")
+register("breaker_transition", "replica", "from_state", "to_state",
+         "reason")
+register("fleet_route", "endpoint", "verdict", "attempts")
+register("fleet_degraded", "reason", "read_only")
+
 # ---- recovery / resilience records (docs/RESILIENCE.md) -------------------
 register("retry", "stage", "attempt", "backoff_s", "error")
 register("retries_exhausted", "stage", "attempts", "error")
@@ -119,7 +134,8 @@ RECOVERY_PHASES = frozenset((
     "retry", "retries_exhausted", "degrade", "mesh_degrade", "tripwire",
     "watchdog_timeout", "resume", "checkpoint_rollback",
     "checkpoint_rollback_ok", "ivf_fallback", "quarantine",
-    "repair_fallback", "delta_shed",
+    "repair_fallback", "delta_shed", "breaker_transition",
+    "fleet_degraded",
 ))
 
 

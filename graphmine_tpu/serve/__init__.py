@@ -22,7 +22,12 @@ forced a cold full recompute. This package is the steady-state side
   protection: ONE policy owner resolving every incoming delta to
   accept/queue/coalesce/shed against the live repair-debt state, with
   order-exact delta coalescing and an LOF-defer degradation rung
-  (docs/SERVING.md "admission control").
+  (docs/SERVING.md "admission control");
+- :mod:`~graphmine_tpu.serve.fleet` — the replicated tier: a front
+  router with consistent-version routing over N replicas, per-replica
+  circuit breakers, single-writer forwarding (writer loss = read-only,
+  never split-brain) and zero-downtime rolling reload
+  (docs/SERVING.md "Fleet").
 """
 
 from graphmine_tpu.serve.admission import (
@@ -37,6 +42,13 @@ from graphmine_tpu.serve.delta import (
     RepairDebt,
     RepairResult,
 )
+from graphmine_tpu.serve.fleet import (
+    CircuitBreaker,
+    FleetConfig,
+    FleetRouter,
+    ReplicaSet,
+    ReplicaSpec,
+)
 from graphmine_tpu.serve.query import QueryEngine
 from graphmine_tpu.serve.snapshot import Snapshot, SnapshotStore
 
@@ -44,9 +56,14 @@ __all__ = [
     "AdmissionBounds",
     "AdmissionController",
     "AdmissionDecision",
+    "CircuitBreaker",
     "DeltaIngestor",
     "EdgeDelta",
+    "FleetConfig",
+    "FleetRouter",
     "QueryEngine",
+    "ReplicaSet",
+    "ReplicaSpec",
     "RepairDebt",
     "RepairResult",
     "Snapshot",

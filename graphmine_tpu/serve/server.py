@@ -11,9 +11,12 @@ never drops or torn-reads a live query (pinned by
 Endpoints (JSON unless noted):
 
 ====================  =====================================================
-``GET  /healthz``      liveness + snapshot version, **snapshot age and
-                       repair debt** (a load balancer drains a replica
-                       whose served state has gone stale)
+``GET  /healthz``      liveness (``ok``) + **readiness** (``ready``:
+                       false while draining or stale-beyond-bound) +
+                       snapshot version, snapshot age and repair debt —
+                       the one documented probe contract
+                       (docs/SERVING.md "healthz schema") the fleet
+                       prober and external balancers key off
 ``GET  /statusz``      the SLO page: uptime, in-flight count, per-endpoint
                        latency quantiles (p50/p95/p99), error rates,
                        repair-debt ledger, batched-query stage split
@@ -25,8 +28,19 @@ Endpoints (JSON unless noted):
 ``GET  /topk?community=&k=``  top-k LOF outliers of one community
 ``POST /query``        ``{"vertices": [...]}`` — the batched gather path
 ``POST /delta``        ``{"insert": [[s,d],...], "delete": [[s,d],...]}``
+                       (``X-Deadline-Ms`` narrows the queued deadline)
 ``POST /reload``       reload the store's newest snapshot and swap
+``POST /drain``        flip readiness off (``ready: false``) — take the
+                       replica out of rotation without killing it
+``POST /undrain``      restore readiness
 ====================  =====================================================
+
+**Fleet integration** (r10, serve/fleet.py): read endpoints honor an
+``X-Serve-Version`` pin (409 on mismatch — the router's mixed-version
+guard closes at the replica, where the swap happens), and the apply
+worker REBASES on an unseen external publish before building on the
+served engine (the /reload-vs-inflight-delta contract under the fleet
+prober's reload cadence — see ``_apply_group``).
 
 **Request observability** (docs/OBSERVABILITY.md "serving SLO"): every
 request runs through one timing middleware — wall time observed into a
@@ -59,6 +73,7 @@ from __future__ import annotations
 import hashlib
 import json
 import math
+import os
 import re
 import secrets
 import threading
@@ -106,6 +121,8 @@ _POST_ROUTES = {
     "/query": "_ep_query",
     "/delta": "_ep_delta",
     "/reload": "_ep_reload",
+    "/drain": "_ep_drain",
+    "/undrain": "_ep_undrain",
 }
 
 
@@ -130,13 +147,17 @@ class _PendingDelta:
     (deadline passed / shutdown). ``event`` fires exactly once, at the
     terminal transition."""
 
-    __slots__ = ("delta", "rows", "deadline", "status", "result", "error",
-                 "event", "shed_reason")
+    __slots__ = ("delta", "rows", "deadline", "deadline_s", "status",
+                 "result", "error", "event", "shed_reason")
 
-    def __init__(self, delta: EdgeDelta, rows: int, deadline: float):
+    def __init__(
+        self, delta: EdgeDelta, rows: int, deadline: float,
+        deadline_s: float,
+    ):
         self.delta = delta
         self.rows = rows
         self.deadline = deadline
+        self.deadline_s = deadline_s  # the budget, for shed messages
         self.status = "queued"
         self.result: dict | None = None
         self.error: BaseException | None = None
@@ -157,12 +178,36 @@ class SnapshotServer:
         num_shards: int = 1,
         slow_request_s: float = 1.0,
         admission: AdmissionController | None = None,
+        ready_max_age_s: float | None = None,
     ):
         self.store = store
         self.sink = sink
         self.prom_out = prom_out
         self.num_shards = num_shards
         self.slow_request_s = float(slow_request_s)
+        # Readiness bound (liveness vs readiness split, docs/SERVING.md
+        # "healthz schema"): past this snapshot age the replica reports
+        # ready: false so a balancer/fleet prober stops routing to it.
+        # None (default, or unset env GRAPHMINE_READY_MAX_AGE_S) = age
+        # never gates readiness.
+        if ready_max_age_s is None:
+            raw = os.environ.get("GRAPHMINE_READY_MAX_AGE_S")
+            if raw is not None:
+                try:
+                    ready_max_age_s = float(raw)
+                except ValueError as e:
+                    raise ValueError(
+                        f"GRAPHMINE_READY_MAX_AGE_S={raw!r} is not a float"
+                    ) from e
+        self.ready_max_age_s = ready_max_age_s
+        self._draining = False
+        # Chaos seams (testing/faults.py replica_slow / replica_stale):
+        # per-instance, so one replica of an in-process fleet can be
+        # slowed or version-pinned without touching its peers (the
+        # global fault_point hook is process-wide). Production value is
+        # the zero/False no-op.
+        self.chaos_delay_s = 0.0
+        self.chaos_hold_version = False
         # The metric surface exists with or without a record sink: a
         # sinkless server still serves /metrics and /statusz.
         self.registry: Registry = (
@@ -315,6 +360,12 @@ class SnapshotServer:
         delta on top of the STALE state would silently discard the
         externally published snapshot's edges (its next publish would
         still chain version numbers from the store's manifest)."""
+        if self.chaos_hold_version:
+            # replica_stale injector: this replica never advances
+            return {
+                "version": self._engine.version, "swapped": False,
+                "held": True,
+            }
         with self._delta_lock:
             snap = self.store.load(sink=self.sink)
             swapped = snap is not None and snap.version != self._engine.version
@@ -323,7 +374,7 @@ class SnapshotServer:
                 self._ingestor = None
             return {"version": self._engine.version, "swapped": swapped}
 
-    def apply_delta(self, payload: dict) -> dict:
+    def apply_delta(self, payload: dict, deadline_s: float | None = None) -> dict:
         """Ingest one delta batch (the POST /delta body) through
         admission control. Returns the publish result — or, on a shed,
         a structured refusal dict (``verdict: "shed"``) the HTTP layer
@@ -334,8 +385,15 @@ class SnapshotServer:
         the publish carried) or until its deadline passes while still
         queued, in which case it is shed: an apply the client has
         stopped waiting for would spend repair budget on an answer
-        nobody reads.
+        nobody reads. ``deadline_s`` (the ``X-Deadline-Ms`` header,
+        propagated end-to-end by the fleet router and serve_cli) narrows
+        the queued-batch deadline below the admission default — a
+        client's budget can tighten the envelope, never widen it.
         """
+        bound = self.admission.bounds.deadline_s
+        deadline_s = bound if deadline_s is None else max(
+            0.001, min(float(deadline_s), bound)
+        )
         delta = EdgeDelta.from_pairs(
             insert=payload.get("insert", ()), delete=payload.get("delete", ())
         )
@@ -377,8 +435,7 @@ class SnapshotServer:
                 # shed bound reads.
                 self.debt.submitted(rows)
                 pending = _PendingDelta(
-                    delta, rows,
-                    time.monotonic() + self.admission.bounds.deadline_s,
+                    delta, rows, time.monotonic() + deadline_s, deadline_s,
                 )
                 self._queue.append(pending)
                 self._queue_cv.notify_all()
@@ -409,8 +466,8 @@ class SnapshotServer:
                 else:
                     pending.status = "shed"
                     pending.shed_reason = (
-                        f"deadline {self.admission.bounds.deadline_s:g}s "
-                        "passed while queued"
+                        f"deadline {pending.deadline_s:g}s passed while "
+                        "queued"
                     )
                     shed_now = True
         if shed_now:
@@ -455,8 +512,7 @@ class SnapshotServer:
             if p.deadline <= now:
                 p.status = "shed"
                 p.shed_reason = (
-                    f"deadline {self.admission.bounds.deadline_s:g}s "
-                    "passed while queued"
+                    f"deadline {p.deadline_s:g}s passed while queued"
                 )
                 expired.append(p)
             else:
@@ -513,8 +569,25 @@ class SnapshotServer:
         """Apply one popped group as a single publish: validate each
         batch, coalesce when more than one waited, re-resolve the LOF
         rung at apply time (pressure may have moved while they sat
-        queued), swap the fresh engine in."""
+        queued), swap the fresh engine in.
+
+        REBASE GUARD (the /reload-vs-inflight-delta contract, pinned
+        under the fleet prober's reload cadence in tests/test_fleet.py):
+        before building on the served engine, peek the store's newest
+        version. An external publish the server hasn't reloaded yet —
+        a /reload racing this apply, or a prober cadence that hasn't
+        fired — means applying on the served snapshot would chain a new
+        version number from the store's manifest while silently
+        DISCARDING the external snapshot's edges. Reload-in-place first
+        (swap + drop the stale ingestor), then apply on top: the delta
+        rebases instead of clobbering."""
         with self._delta_lock:
+            newest = self.store.peek_version()
+            if newest is not None and newest != self._engine.version:
+                fresh = self.store.load(sink=self.sink)
+                if fresh is not None and fresh.version != self._engine.version:
+                    self._swap(QueryEngine(fresh))
+                    self._ingestor = None
             # Applies settle the ledger inside apply(); the worker is the
             # only applier, so an unchanged applies_total at a raise
             # means THIS group never settled — drop its pending entries.
@@ -580,20 +653,54 @@ class SnapshotServer:
             "lof_stale": bool(snap.meta.get("lof_stale", False)),
         }
 
+    # -- liveness vs readiness --------------------------------------------
+    def drain(self) -> dict:
+        """Flip readiness off (``ready: false``) while keeping the
+        process fully alive — the balancer/fleet-prober contract for
+        taking a replica out of rotation without killing in-flight
+        work. Idempotent; :meth:`undrain` restores."""
+        self._draining = True
+        return self.healthz()
+
+    def undrain(self) -> dict:
+        self._draining = False
+        return self.healthz()
+
+    def _ready(self, eng) -> tuple[bool, str]:
+        """The readiness verdict (``/healthz`` ``ready``): false while
+        draining or while the served snapshot is stale beyond the
+        configured age bound. Liveness (``ok``) is separate — a
+        draining or stale replica is alive, just not routable."""
+        if self._draining:
+            return False, "draining"
+        age = self._snapshot_age_s(eng)
+        if self.ready_max_age_s is not None and age > self.ready_max_age_s:
+            return False, (
+                f"snapshot_age {age:.1f}s > ready_max_age_s "
+                f"{self.ready_max_age_s:g}s"
+            )
+        return True, ""
+
     # -- SLO surfaces -----------------------------------------------------
     def healthz(self) -> dict:
-        """Liveness + staleness: version, snapshot age, repair debt, and
-        the ``overloaded`` drain signal — enough for a load balancer to
-        drain a stale OR saturated replica without a second round trip
-        and without duplicating the admission thresholds (the field is
-        driven by the same bounds that decide the shed verdict)."""
+        """Liveness + readiness + staleness: version, snapshot age,
+        repair debt, the ``overloaded`` drain signal, and ``ready`` —
+        the one documented contract (docs/SERVING.md "healthz schema")
+        the fleet prober and external balancers key off. ``ok`` is
+        liveness (the process answers); ``ready`` is routability (false
+        while draining or stale-beyond-bound); ``overloaded`` is the
+        write-path drain signal, driven by the same admission bounds
+        that decide the shed verdict."""
         eng = self._engine
         debt = self.debt.snapshot()
         with self._queue_cv:
             depth = len(self._queue)
         overloaded, why = self.admission.overloaded(depth, debt)
+        ready, not_ready_why = self._ready(eng)
         out = {
             "ok": True,
+            "ready": ready,
+            "draining": self._draining,
             "version": eng.version,
             "snapshot_id": eng.snapshot.snapshot_id,
             "num_vertices": eng.num_vertices,
@@ -604,6 +711,8 @@ class SnapshotServer:
             "delta_queue_depth": depth,
             "lof_stale": eng.lof_stale,
         }
+        if not ready:
+            out["not_ready_reason"] = not_ready_why
         if overloaded:
             out["overload_reason"] = why
         return out
@@ -837,6 +946,9 @@ class _Handler(BaseHTTPRequestHandler):
         self._status = 500
         self._raw_body = b""
         self.srv.request_started()
+        chaos = self.srv.chaos_delay_s
+        if chaos > 0:
+            time.sleep(chaos)  # replica_slow injector (testing/faults.py)
         t0 = time.perf_counter()
         try:
             if handler is None:
@@ -873,6 +985,30 @@ class _Handler(BaseHTTPRequestHandler):
     # a concurrent snapshot swap must not mix two versions inside one
     # response.
 
+    def _pin_ok(self, eng) -> bool:
+        """The fleet router's consistency pin: an ``X-Serve-Version``
+        header demands the response come from exactly that snapshot
+        version. A replica that swapped between the router's pick and
+        this handler answers 409 and the router retries elsewhere —
+        the mixed-version window closes at the replica, where the swap
+        actually happens (the engine is already bound, so the check and
+        the response read one version)."""
+        want = self.headers.get("X-Serve-Version", "")
+        if not want:
+            return True
+        try:
+            want_v = int(want)
+        except ValueError:
+            return True
+        if want_v == eng.version:
+            return True
+        self._reply(409, {
+            "error": "version mismatch",
+            "version": eng.version,
+            "requested": want_v,
+        })
+        return False
+
     def _ep_healthz(self, url) -> None:
         self._reply(200, self.srv.healthz())
 
@@ -886,10 +1022,15 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
     def _ep_snapshot(self, url) -> None:
-        self._reply(200, self.srv.engine.snapshot.meta)
+        eng = self.srv.engine
+        if not self._pin_ok(eng):
+            return
+        self._reply(200, eng.snapshot.meta)
 
     def _ep_vertex(self, url) -> None:
         eng = self.srv.engine
+        if not self._pin_ok(eng):
+            return
         t0 = time.perf_counter()
         v = int(parse_qs(url.query)["v"][0])
         row = self.srv.vertex_row(eng, v)
@@ -898,6 +1039,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _ep_neighbors(self, url) -> None:
         eng = self.srv.engine
+        if not self._pin_ok(eng):
+            return
         t0 = time.perf_counter()
         v = int(parse_qs(url.query)["v"][0])
         nbrs = eng.neighbors(v)
@@ -906,6 +1049,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _ep_topk(self, url) -> None:
         eng = self.srv.engine
+        if not self._pin_ok(eng):
+            return
         t0 = time.perf_counter()
         qs = parse_qs(url.query)
         community = int(qs["community"][0])
@@ -920,6 +1065,8 @@ class _Handler(BaseHTTPRequestHandler):
     # -- POST routes -------------------------------------------------------
     def _ep_query(self, url) -> None:
         eng = self.srv.engine
+        if not self._pin_ok(eng):
+            return
         t0 = time.perf_counter()
         body = self._body()
         out = eng.query_batch(body.get("vertices", []))
@@ -932,7 +1079,16 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(200, payload)
 
     def _ep_delta(self, url) -> None:
-        out = self.srv.apply_delta(self._body())
+        # X-Deadline-Ms (r9 deadline semantics, end-to-end): the
+        # client's remaining budget narrows the queued-batch deadline.
+        deadline_s = None
+        raw_ms = self.headers.get("X-Deadline-Ms", "")
+        if raw_ms:
+            try:
+                deadline_s = max(1, int(raw_ms)) / 1000.0
+            except ValueError:
+                deadline_s = None
+        out = self.srv.apply_delta(self._body(), deadline_s=deadline_s)
         if out.get("verdict") == "shed":
             # the structured refusal: 503 + a Retry-After the client's
             # backoff can obey without parsing the body
@@ -946,3 +1102,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _ep_reload(self, url) -> None:
         self._reply(200, self.srv.reload())
+
+    def _ep_drain(self, url) -> None:
+        self._reply(200, self.srv.drain())
+
+    def _ep_undrain(self, url) -> None:
+        self._reply(200, self.srv.undrain())

@@ -32,6 +32,13 @@ mesh-degradation rungs), and :func:`poison_labels` is a ctx-aware
 *mutator* that silently corrupts one shard of the driver's label state —
 exercising the divergence tripwires, which must catch corruption that
 announces nothing.
+
+Fleet-level faults (ISSUE 9): :func:`replica_kill` /
+:func:`replica_slow` / :func:`replica_stale` act on ONE in-process
+replica of a serving fleet — a dead listener, a slow data plane behind a
+live health probe, a version-pinned stale replica — the three failure
+shapes the fleet router's state machine, circuit breakers and
+committed-version routing exist to absorb (tests/test_fleet.py).
 """
 
 from __future__ import annotations
@@ -279,6 +286,56 @@ def slow_client_post(
     status = int(status_line.split()[1])
     _, _, resp_body = rest.partition(b"\r\n\r\n")
     return status, _json.loads(resp_body.decode())
+
+
+# ---- fleet-level injectors (ISSUE 9: replicated serving chaos) -------------
+#
+# These act on ONE in-process replica (a serve.server.SnapshotServer),
+# not the global fault_point seam — a 3-replica fleet chaos test must be
+# able to kill one replica, slow another, and leave the third healthy
+# inside one process. The seams they drive (chaos_delay_s /
+# chaos_hold_version, per-instance attributes the production middleware
+# and reload() consult) are the serve-side analog of the resilience
+# fault hook: zero-cost no-ops in production, deterministic handles in
+# chaos tests.
+
+
+def replica_kill(server) -> None:
+    """Hard-kill a replica's HTTP listener in place — every subsequent
+    connection is refused, exactly what the fleet router sees when a
+    replica process dies. Unlike ``SnapshotServer.stop()`` there is no
+    graceful queue drain: the 'process' just stops answering. The
+    Python object survives, so the test can still inspect its state;
+    'restarting the replica' is constructing a fresh SnapshotServer on
+    the same port (ThreadingHTTPServer sets SO_REUSEADDR)."""
+    httpd = server._httpd
+    server._httpd = None
+    if httpd is not None:
+        httpd.shutdown()
+        httpd.server_close()
+    t = server._thread
+    server._thread = None
+    if t is not None:
+        t.join(timeout=10)
+
+
+def replica_slow(server, seconds: float) -> None:
+    """Slow ONE replica: every request (including its /healthz) stalls
+    ``seconds`` before handling. With the fleet's generous probe timeout
+    the replica stays alive-and-healthy while its data-plane latency
+    blows the router's per-attempt read timeout — the exact shape that
+    must open the per-replica circuit breaker rather than mark the
+    replica down. ``replica_slow(server, 0.0)`` heals it."""
+    server.chaos_delay_s = float(seconds)
+
+
+def replica_stale(server, hold: bool = True) -> None:
+    """Pin ONE replica to its current snapshot version: /reload becomes
+    a no-op (``swapped: false, held: true``), so the replica falls
+    behind every publish — the stale replica the committed-version rule
+    must keep out of the read path without ever surfacing a
+    mixed-version answer. ``replica_stale(server, False)`` releases."""
+    server.chaos_hold_version = bool(hold)
 
 
 @dataclass

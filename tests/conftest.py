@@ -107,6 +107,16 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "fleet: replicated-serving-fleet suite (tests/test_fleet.py: "
+        "per-replica circuit breakers, quorum committed-version "
+        "routing, writer loss = read-only, zero-downtime rolling "
+        "reload, the reload-vs-inflight-delta rebase, serve_cli client "
+        "retries, and the 3-replica kill+slow+roll chaos acceptance "
+        "test); runs in the default CPU pass — select with -m fleet or "
+        "tools/run_tier1.sh --fleet-only",
+    )
+    config.addinivalue_line(
+        "markers",
         "slo: serving-SLO observability suite (tests/test_slo.py: "
         "bucket histograms + merge associativity, live /metrics and "
         "/statusz under the query hammer, quantile agreement vs the "

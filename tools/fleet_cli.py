@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Fleet CLI: run a replicated serving fleet behind one router.
+
+The operator surface of ``graphmine_tpu/serve/fleet.py``
+(docs/SERVING.md "Fleet") — the first multi-process subsystem in the
+tree::
+
+    # publish a snapshot first (pipeline --snapshot-out, or serve_cli)
+    python tools/fleet_cli.py up --store /data/snap --replicas 3 \
+        --port 8400 --metrics-out /data/fleet_metrics.jsonl
+
+    python tools/fleet_cli.py status --url http://127.0.0.1:8400
+    python tools/fleet_cli.py roll   --url http://127.0.0.1:8400
+
+``up`` spawns N replica *processes* (``serve_cli.py serve``, each its
+own port off ``--replica-base-port``) over ONE shared snapshot store,
+waits for each to answer ``/healthz``, and runs the router in the
+foreground until interrupted — replica 0 is the designated writer
+(single-publisher contract; writer loss = read-only fleet, never
+split-brain). ``status`` prints the router's ``/fleetz`` (per-replica
+state/version/breaker, committed version, read-only verdict); ``roll``
+triggers the zero-downtime rolling reload (drain → /reload → re-probe →
+rejoin, one replica at a time, writer last) after an external publish.
+
+Clients talk to the router exactly like a single server —
+``serve_cli.py query/delta --url http://host:PORT`` gets the
+consistent-version routing, retries, and 503+Retry-After semantics for
+free. Fleet knobs follow the ``GRAPHMINE_FLEET_*`` env convention
+(serve/fleet.py ``FleetConfig``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+_REPO = __file__.rsplit("/", 2)[0]
+if _REPO not in sys.path:  # allow `python tools/fleet_cli.py` from anywhere
+    sys.path.insert(0, _REPO)
+
+
+def _get_json(url: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _wait_healthz(host: str, port: int, timeout_s: float) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            if _get_json(f"http://{host}:{port}/healthz", 2.0).get("ok"):
+                return True
+        except Exception:  # noqa: BLE001 — still starting
+            pass
+        time.sleep(0.2)
+    return False
+
+
+def cmd_up(args) -> int:
+    import signal
+
+    from graphmine_tpu.obs.spans import Tracer
+    from graphmine_tpu.pipeline.metrics import MetricsSink
+    from graphmine_tpu.serve.fleet import FleetRouter, ReplicaSpec
+
+    # SIGTERM (docker stop, a supervisor, subprocess.terminate) must run
+    # the same teardown as Ctrl-C — otherwise the replica child
+    # processes leak past the router's death.
+    signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
+
+    procs: list = []
+    router = None
+    serve_cli = f"{_REPO}/tools/serve_cli.py"
+    try:
+        for i in range(args.replicas):
+            port = args.replica_base_port + i
+            cmd = [
+                sys.executable, serve_cli, "serve",
+                "--store", args.store, "--host", args.host,
+                "--port", str(port),
+            ]
+            if args.metrics_out:
+                cmd += ["--metrics-out", f"{args.metrics_out}.replica{i}"]
+            procs.append(subprocess.Popen(cmd))
+        for i in range(args.replicas):
+            port = args.replica_base_port + i
+            if not _wait_healthz(args.host, port, args.startup_timeout_s):
+                print(
+                    f"fleet_cli: replica {i} on port {port} never answered "
+                    f"/healthz within {args.startup_timeout_s:g}s",
+                    file=sys.stderr,
+                )
+                return 2
+        sink = None
+        if args.metrics_out:
+            sink = MetricsSink(stream_path=args.metrics_out, tracer=Tracer())
+            sink.max_records = 100_000
+        specs = [
+            ReplicaSpec(f"replica-{i}", args.host, args.replica_base_port + i)
+            for i in range(args.replicas)
+        ]
+        router = FleetRouter(
+            specs, writer="replica-0", host=args.host, port=args.port,
+            sink=sink,
+        )
+        host, port = router.start()
+        print(
+            f"fleet: {args.replicas} replica(s) behind http://{host}:{port} "
+            f"(writer replica-0 on port {args.replica_base_port})",
+            file=sys.stderr,
+        )
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if router is not None:
+            router.stop()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return 0
+
+
+def cmd_status(args) -> int:
+    try:
+        out = _get_json(f"{args.url.rstrip('/')}/fleetz")
+    except (urllib.error.URLError, OSError) as e:
+        print(f"fleet_cli: router unreachable at {args.url}: {e}",
+              file=sys.stderr)
+        return 2
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+def cmd_roll(args) -> int:
+    req = urllib.request.Request(
+        f"{args.url.rstrip('/')}/roll", data=b"{}", method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=args.timeout_s) as r:
+            out = json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        out = json.loads(e.read())
+    except (urllib.error.URLError, OSError) as e:
+        print(f"fleet_cli: router unreachable at {args.url}: {e}",
+              file=sys.stderr)
+        return 2
+    print(json.dumps(out, indent=1))
+    return 0 if out.get("ok") else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("up", help="spawn N replica processes + the router")
+    p.add_argument("--store", required=True, help="shared snapshot store")
+    p.add_argument("--replicas", type=int, default=3)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8400,
+                   help="the router's port (clients talk here)")
+    p.add_argument("--replica-base-port", type=int, default=8450,
+                   help="replica i listens on base+i")
+    p.add_argument("--metrics-out", default=None,
+                   help="router records here; replica i appends to "
+                        "PATH.replicaI")
+    p.add_argument("--startup-timeout-s", type=float, default=60.0)
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("status", help="print the router's /fleetz")
+    p.add_argument("--url", required=True, help="router base URL")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("roll", help="trigger a zero-downtime rolling reload")
+    p.add_argument("--url", required=True, help="router base URL")
+    p.add_argument("--timeout-s", type=float, default=300.0)
+    p.set_defaults(fn=cmd_roll)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,7 +26,12 @@ dir):
   snapshot traces out, and (r9) the **admission timeline** beside it —
   every accept/queue/coalesce/shed verdict with the debt state that
   decided it, coalesce merges, and shed events (RUNBOOKS §8 keys its
-  triage off this view).
+  triage off this view);
+- the **fleet** section (r10): replica health-state transitions,
+  the circuit-breaker timeline, fleet-degraded (read-only) flips, and
+  the route-verdict mix — which replica states and breaker episodes
+  explain the 503s a reader saw (RUNBOOKS §9 keys its triage off this
+  view).
 
 Usage::
 
@@ -211,6 +216,8 @@ _DETAIL_KEYS = {
     "ivf_fallback": ("guard",),
     "quarantine": (),
     "repair_fallback": ("stage", "reason"),
+    "breaker_transition": ("replica", "from_state", "to_state"),
+    "fleet_degraded": ("read_only", "writer"),
 }
 
 _SERVING_PHASES = ("snapshot_publish", "snapshot_load", "delta_apply",
@@ -389,6 +396,66 @@ def _admission_timeline(records, t0):
     return out
 
 
+def _fleet_section(records, t0):
+    """Replicated-fleet timeline (r10, docs/SERVING.md "Fleet"): replica
+    state-machine transitions, the breaker timeline, read-only flips and
+    the route-verdict mix — RUNBOOKS §9's "read the fleet timeline
+    before restarting anything" view. Empty list = no fleet records
+    (single-process stream)."""
+    health = [r for r in records if r.get("phase") == "replica_health"]
+    breakers = [r for r in records if r.get("phase") == "breaker_transition"]
+    degraded = [r for r in records if r.get("phase") == "fleet_degraded"]
+    routes = [r for r in records if r.get("phase") == "fleet_route"]
+    if not (health or breakers or degraded or routes):
+        return []
+    out = []
+    if health:
+        out.append("  replica health transitions:")
+        for r in health:
+            v = r.get("version")
+            out.append(
+                f"  {_fmt_offset(r, t0)}  {r.get('replica', '?'):<12}"
+                f"  {r.get('from_state', '?'):>8} -> "
+                f"{r.get('to_state', '?'):<8}"
+                f"{f'  v{v}' if v is not None else ''}"
+                f"  [{r.get('reason', '')}]"
+            )
+    if breakers:
+        out.append("  breaker timeline:")
+        for r in breakers:
+            out.append(
+                f"  {_fmt_offset(r, t0)}  {r.get('replica', '?'):<12}"
+                f"  {r.get('from_state', '?'):>9} -> "
+                f"{r.get('to_state', '?'):<9}"
+                f"  [{r.get('reason', '')}]"
+            )
+    for r in degraded:
+        verdict = (
+            "FLEET READ-ONLY" if r.get("read_only") else "fleet writes restored"
+        )
+        out.append(
+            f"  {_fmt_offset(r, t0)}  {verdict}  [{r.get('reason', '')}]"
+        )
+    if routes:
+        verdicts: dict = {}
+        attempts_total = 0
+        retried = 0
+        for r in routes:
+            verdicts[r.get("verdict", "?")] = (
+                verdicts.get(r.get("verdict", "?"), 0) + 1
+            )
+            a = int(r.get("attempts", 0) or 0)
+            attempts_total += a
+            if a > 1:
+                retried += 1
+        mix = "  ".join(f"{k}={v}" for k, v in sorted(verdicts.items()))
+        out.append(
+            f"  route verdicts: {len(routes)} requests ({mix}); "
+            f"{attempts_total} replica attempts, {retried} needed retry"
+        )
+    return out
+
+
 def _recovery_timeline(records, t0):
     events = [r for r in records if r.get("phase") in RECOVERY_PHASES]
     if not events:
@@ -503,6 +570,11 @@ def build_report(records, source: str = "", bad_lines: int = 0) -> str:
         lines.append("")
         lines.append("-- serving SLO (latency / errors / repair debt) --")
         lines.extend(slo)
+    fleet = _fleet_section(records, t0)
+    if fleet:
+        lines.append("")
+        lines.append("-- fleet (replica health / breakers / routing) --")
+        lines.extend(fleet)
     lines.append("")
     lines.append("-- recovery timeline --")
     lines.extend(_recovery_timeline(records, t0))

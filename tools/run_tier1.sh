@@ -7,7 +7,7 @@
 # (-m faults: tests/test_resilience.py + the tripwire/reshard cases in
 # tests/test_sharded.py) is part of this default pass.
 #
-# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only|--slo-only|--blocking-only|--admission-only] [extra pytest args...]
+# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only|--slo-only|--blocking-only|--admission-only|--fleet-only] [extra pytest args...]
 #   --faults-only  run just the `faults`-marked recovery suite — the fast
 #                  pre-commit loop when iterating on resilience paths
 #   --obs-only     run just the `obs`-marked tracing/telemetry suite
@@ -39,6 +39,13 @@
 #                  coalescing parity, deadline shedding, LOF-defer rung,
 #                  and the burst + slow-repair chaos acceptance test) —
 #                  the fast slice when iterating on serve/admission.py
+#   --fleet-only   run just the `fleet`-marked replicated-serving suite
+#                  (tests/test_fleet.py: circuit breakers, quorum
+#                  committed-version routing, writer loss = read-only,
+#                  rolling reload, the reload-vs-inflight-delta rebase,
+#                  serve_cli client retries, and the 3-replica
+#                  kill+slow+roll chaos acceptance test) — the fast
+#                  slice when iterating on serve/fleet.py
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -64,6 +71,9 @@ elif [ "${1:-}" = "--blocking-only" ]; then
 elif [ "${1:-}" = "--admission-only" ]; then
     shift
     MARKER='admission and not slow'
+elif [ "${1:-}" = "--fleet-only" ]; then
+    shift
+    MARKER='fleet and not slow'
 fi
 
 LOG="${TIER1_LOG:-/tmp/_t1.log}"

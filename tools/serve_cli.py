@@ -19,18 +19,123 @@ one-shot in-process operations against the store directory (no server
 needed). Every subcommand that mutates or resolves emits the serving
 records (``query_batch`` / ``delta_apply`` / ``snapshot_publish``) —
 point ``tools/obs_report.py`` at ``--metrics-out`` for the joined view.
+
+**HTTP client mode** (r10): ``query`` and ``delta`` take ``--url`` to
+talk to a running server or fleet router instead of the store
+directory — with client-side resilience: a 503 (admission shed, fleet
+unavailable) is retried up to ``--max-retries`` times with
+decorrelated-jitter backoff (the r3 retry policy), honoring the
+server's ``Retry-After`` hint, and ``--deadline-ms`` bounds the whole
+exchange AND propagates as ``X-Deadline-Ms`` so the server/router sheds
+work the client has stopped waiting for::
+
+    python tools/serve_cli.py delta --url http://127.0.0.1:8400 \
+        --insert 10,11 --deadline-ms 5000 --max-retries 4
+    python tools/serve_cli.py query --url http://127.0.0.1:8400 --vertex 12 44
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import random
 import sys
 import time
+import urllib.error
+import urllib.request
 
 _REPO = __file__.rsplit("/", 2)[0]
 if _REPO not in sys.path:  # allow `python tools/serve_cli.py` from anywhere
     sys.path.insert(0, _REPO)
+
+
+def request_with_retries(
+    url: str,
+    payload: dict | None = None,
+    deadline_ms: int | None = None,
+    max_retries: int = 4,
+    timeout_s: float = 30.0,
+    sleep=time.sleep,
+    rng: random.Random | None = None,
+) -> dict:
+    """One HTTP exchange (POST ``payload``, or GET when ``payload`` is
+    None) with bounded client-side resilience.
+
+    Retries 503s (admission sheds, fleet-unavailable) and transport
+    failures up to ``max_retries`` extra attempts. The delay before
+    attempt ``n`` is the r3 decorrelated-jitter backoff
+    (:func:`~graphmine_tpu.pipeline.resilience.backoff_s`, seeded per
+    process so a fleet of clients never retries in lockstep), floored by
+    the server's ``Retry-After`` hint when one came back — the client
+    obeys the server's own estimate of when capacity returns instead of
+    hammering through it. ``deadline_ms`` bounds the WHOLE exchange and
+    rides every attempt as ``X-Deadline-Ms`` (the r9 deadline semantics
+    end-to-end): the server sheds a batch still queued past the budget,
+    and the client stops retrying when the budget is gone.
+
+    Returns ``{"status", "body", "headers", "attempts"}``; transport
+    failures with no retries left return ``status: 0`` with the error
+    under ``body["error"]``.
+    """
+    from graphmine_tpu.pipeline.resilience import ResilienceConfig, backoff_s
+
+    policy = ResilienceConfig(backoff_base_s=0.2, backoff_max_s=5.0)
+    rng = rng if rng is not None else random.Random(
+        f"serve_cli:{os.getpid()}"
+    )
+    deadline = (
+        time.monotonic() + deadline_ms / 1000.0
+        if deadline_ms is not None else None
+    )
+    attempt = 0
+    while True:
+        attempt += 1
+        headers = {"Content-Type": "application/json"}
+        attempt_timeout = timeout_s
+        if deadline is not None:
+            remaining_ms = int((deadline - time.monotonic()) * 1000)
+            if remaining_ms <= 0 and attempt > 1:
+                return last  # noqa: F821 — set on every prior iteration
+            remaining_ms = max(1, remaining_ms)
+            headers["X-Deadline-Ms"] = str(remaining_ms)
+            attempt_timeout = min(timeout_s, remaining_ms / 1000.0)
+        req = urllib.request.Request(
+            url,
+            data=None if payload is None else json.dumps(payload).encode(),
+            headers=headers,
+        )
+        resp_headers: dict = {}
+        try:
+            with urllib.request.urlopen(req, timeout=attempt_timeout) as r:
+                status, raw, resp_headers = r.status, r.read(), dict(r.headers)
+        except urllib.error.HTTPError as e:
+            status, raw, resp_headers = e.code, e.read(), dict(e.headers)
+        except Exception as e:  # noqa: BLE001 — transport weather: retryable
+            status, raw = 0, json.dumps({"error": repr(e)}).encode()
+        try:
+            body = json.loads(raw.decode()) if raw else {}
+        except ValueError:
+            body = {"error": raw.decode(errors="replace")}
+        last = {
+            "status": status, "body": body, "headers": resp_headers,
+            "attempts": attempt,
+        }
+        if status not in (0, 503) or attempt > max_retries:
+            return last
+        delay = backoff_s(policy, attempt, rng)
+        retry_after = resp_headers.get("Retry-After", "")
+        if retry_after:
+            try:
+                delay = max(delay, float(retry_after))
+            except ValueError:
+                pass
+        if deadline is not None:
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                return last
+            delay = min(delay, budget)
+        sleep(delay)
 
 
 def _sink(args):
@@ -64,6 +169,37 @@ def cmd_query(args) -> int:
     from graphmine_tpu.serve.query import QueryEngine
     from graphmine_tpu.serve.server import _jsonable
 
+    if args.url:
+        base = args.url.rstrip("/")
+        kw = {
+            "deadline_ms": args.deadline_ms,
+            "max_retries": args.max_retries,
+        }
+        merged: dict = {}
+        calls = []
+        if args.vertex:
+            calls.append((f"{base}/query", {"vertices": list(args.vertex)}))
+        if args.neighbors is not None:
+            calls.append((f"{base}/neighbors?v={args.neighbors}", None))
+        if args.community is not None:
+            calls.append((
+                f"{base}/topk?community={args.community}&k={args.topk}",
+                None,
+            ))
+        if not calls:  # bare `query --url`: still resolve something
+            calls.append((f"{base}/query", {"vertices": []}))
+        worst, attempts = 200, 0
+        for call_url, payload in calls:
+            out = request_with_retries(call_url, payload, **kw)
+            attempts += out["attempts"]
+            if out["status"] != 200:
+                worst = out["status"]
+            merged.update(out["body"])
+        print(json.dumps({
+            "status": worst, "attempts": attempts, **merged,
+        }))
+        return 0 if worst == 200 else 1
+
     sink = _sink(args)
     snap = _store(args).load(sink=sink)
     if snap is None:
@@ -93,8 +229,6 @@ def cmd_query(args) -> int:
 
 
 def cmd_delta(args) -> int:
-    from graphmine_tpu.serve.delta import DeltaIngestor, EdgeDelta
-
     def pairs(values):
         # SRC,DST or (weighted snapshots) SRC,DST,WEIGHT
         out = []
@@ -109,13 +243,30 @@ def cmd_delta(args) -> int:
     if args.file:
         with open(args.file) as f:
             payload = json.load(f)
-        delta = EdgeDelta.from_pairs(
-            insert=payload.get("insert", ()), delete=payload.get("delete", ())
-        )
     else:
-        delta = EdgeDelta.from_pairs(
-            insert=pairs(args.insert), delete=pairs(args.delete)
+        payload = {
+            "insert": [list(p) for p in pairs(args.insert)],
+            "delete": [list(p) for p in pairs(args.delete)],
+        }
+    if args.url:
+        out = request_with_retries(
+            f"{args.url.rstrip('/')}/delta", payload,
+            deadline_ms=args.deadline_ms,
+            max_retries=args.max_retries,
         )
+        print(json.dumps({
+            "status": out["status"], "attempts": out["attempts"],
+            **out["body"],
+        }))
+        return 0 if out["status"] == 200 else 1
+    # in-process path: the ingest machinery (device repair code,
+    # compiles) loads only here — --url mode stays HTTP + the host-side
+    # retry policy
+    from graphmine_tpu.serve.delta import DeltaIngestor, EdgeDelta
+
+    delta = EdgeDelta.from_pairs(
+        insert=payload.get("insert", ()), delete=payload.get("delete", ())
+    )
     sink = _sink(args)
     ing = DeltaIngestor(_store(args), sink=sink, num_shards=args.num_shards)
     snap = ing.apply(delta)
@@ -166,18 +317,32 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     sub = ap.add_subparsers(dest="cmd", required=True)
 
-    def common(p):
-        p.add_argument("--store", required=True,
+    def common(p, store_required=True):
+        p.add_argument("--store", required=store_required, default=None,
                        help="snapshot store directory")
         p.add_argument("--metrics-out", default=None,
                        help="append serving records to this JSONL")
+
+    def client(p):
+        p.add_argument("--url", default=None,
+                       help="HTTP mode: talk to a running server/fleet "
+                            "router at this base URL instead of --store")
+        p.add_argument("--deadline-ms", type=int, default=None,
+                       help="total budget for the exchange; also sent as "
+                            "X-Deadline-Ms so the server sheds work the "
+                            "client stopped waiting for")
+        p.add_argument("--max-retries", type=int, default=4,
+                       help="extra attempts on 503/transport failure "
+                            "(decorrelated-jitter backoff, honoring the "
+                            "server's Retry-After)")
 
     p = sub.add_parser("info", help="print the current snapshot manifest")
     common(p)
     p.set_defaults(fn=cmd_info)
 
     p = sub.add_parser("query", help="one-shot queries against the store")
-    common(p)
+    common(p, store_required=False)
+    client(p)
     p.add_argument("--vertex", type=int, nargs="*", default=[],
                    help="vertex ids to resolve (batched gather)")
     p.add_argument("--neighbors", type=int, default=None,
@@ -188,7 +353,8 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_query)
 
     p = sub.add_parser("delta", help="apply one insert/delete batch")
-    common(p)
+    common(p, store_required=False)
+    client(p)
     p.add_argument("--insert", action="append", metavar="SRC,DST[,W]",
                    help="edge to insert (repeatable; the third field is "
                         "the edge weight for weighted snapshots)")
@@ -219,6 +385,16 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_serve)
 
     args = ap.parse_args(argv)
+    if getattr(args, "url", None) is None and args.store is None:
+        ap.error(f"{args.cmd}: one of --store or --url is required")
+    if getattr(args, "url", None) is not None and args.metrics_out:
+        # the serving records are emitted SERVER-side in HTTP mode
+        # (point obs_report at the server/router --metrics-out); saying
+        # nothing here would silently drop the observability trail
+        print(
+            "serve_cli: --metrics-out is ignored with --url (records are "
+            "written by the server's own --metrics-out)", file=sys.stderr,
+        )
     return args.fn(args)
 
 
