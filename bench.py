@@ -984,6 +984,106 @@ def _serve_replicated_read(tmp, arrays, fp, v):
     }
 
 
+def _serve_writer_failover(tmp, arrays, fp, v):
+    """The serve tier's writer-failover sub-record (r11): the three
+    durability numbers docs/SERVING.md "Replicated writers" promises —
+    (a) WAL-append overhead on the accepted-delta acknowledgement
+    (fsync p50/p99 of the 202 path), (b) steady-state replication lag
+    of the log-shipped standby, (c) time-to-writable: SIGKILL-shaped
+    writer loss with an acked-but-unapplied tail → promote → every
+    acknowledged delta queryable at the new writer, with the lost count
+    recorded (it must be 0 — the record carries the proof, not just the
+    timing)."""
+    from graphmine_tpu.serve.server import SnapshotServer
+    from graphmine_tpu.serve.snapshot import SnapshotStore
+    from graphmine_tpu.testing import faults as _faults
+
+    appends, tail = (12, 4) if _CPU_FALLBACK else (64, 16)
+    root = os.path.join(tmp, "failover")
+    store = SnapshotStore(root)
+    store.publish(arrays, fingerprint=fp)
+    primary = SnapshotServer(
+        store, wal=os.path.join(root, "wal-primary"),
+    )
+    host, port = primary.start()
+    standby = SnapshotServer(
+        store, wal=os.path.join(root, "wal-standby"),
+        standby_of=f"http://{host}:{port}",
+        primary_wal=os.path.join(root, "wal-primary"),
+        ship_interval_s=0.05,
+    )
+    standby.start()
+
+    # (a) WAL-durable acknowledgement latency: admission + fsync append,
+    # the full 202 path a client actually waits on.
+    rng = np.random.default_rng(23)
+    ack_lat = []
+    acked = []
+    for i in range(appends):
+        pair = [int(rng.integers(0, v)), int(rng.integers(0, v))]
+        t0 = time.perf_counter()
+        out = primary.apply_delta(
+            {"insert": [pair]}, delta_id=f"bench-{i}", ack="wal",
+        )
+        ack_lat.append(time.perf_counter() - t0)
+        acked.append((f"bench-{i}", tuple(pair)))
+    primary.wait_applied(120)
+
+    # (b) replication lag after the burst settles
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        ship = standby._shipper.snapshot()
+        if ship["lag_entries"] == 0 and ship["primary_last_seq"] > 0:
+            break
+        time.sleep(0.02)
+    ship = standby._shipper.snapshot()
+
+    # (c) kill with an acked-but-unapplied tail, then promote
+    tail_ids = []
+    for i in range(tail):
+        pair = [int(rng.integers(0, v)), int(rng.integers(0, v))]
+        primary.apply_delta(
+            {"insert": [pair]}, delta_id=f"tail-{i}", ack="wal",
+        )
+        acked.append((f"tail-{i}", tuple(pair)))
+        tail_ids.append(tuple(pair))
+    _faults.writer_kill_mid_apply(primary)
+    t0 = time.perf_counter()
+    promote = standby.promote()
+    t_writable = time.perf_counter() - t0
+    standby.wait_applied(300)
+    t_caught_up = time.perf_counter() - t0
+    eng = standby.engine
+    edges = {}
+    for s, d in zip(
+        np.asarray(eng.snapshot["src"]).tolist(),
+        np.asarray(eng.snapshot["dst"]).tolist(),
+    ):
+        edges[(s, d)] = edges.get((s, d), 0) + 1
+    lost = sum(1 for _, pair in acked if pair not in edges)
+    standby.stop()
+    try:
+        primary.stop()
+    except Exception:  # noqa: BLE001 — listener already killed
+        pass
+    lat = np.asarray(sorted(ack_lat))
+    p50, p99 = np.percentile(lat, [50, 99])
+    return {
+        "acked_deltas": len(acked),
+        "wal_ack_p50_ms": round(float(p50) * 1e3, 3),
+        "wal_ack_p99_ms": round(float(p99) * 1e3, 3),
+        "replication_lag_entries_settled": ship["lag_entries"],
+        "shipper_polls": ship["polls"],
+        "tail_at_kill": len(tail_ids),
+        "promote_replayed": promote["replayed"],
+        "promote_copied_tail": promote["copied_tail"],
+        "time_to_writable_s": round(t_writable, 3),
+        "time_to_caught_up_s": round(t_caught_up, 3),
+        "promoted_epoch": promote["epoch"],
+        "acked_deltas_lost": lost,  # the zero-loss proof
+    }
+
+
 def main_serve() -> None:
     """Serving tier (r7, docs/SERVING.md): the steady-state numbers the
     serve/ subsystem exists for — query resolve throughput (single-vertex
@@ -1146,6 +1246,13 @@ def main_serve() -> None:
         # alongside write_load (CPU-fallback: replicas share the GIL,
         # so this measures the routing tier, not replica scaling).
         replicated_read = _serve_replicated_read(tmp, arrays, fp, v)
+
+        # writer failover (r11): WAL-append overhead on the accepted-
+        # delta ack, log-shipped replication lag, and SIGKILL-shaped
+        # time-to-writable with the zero-acked-loss proof. Runs in the
+        # CPU-fallback order too — durability numbers are host-side and
+        # honest without silicon.
+        writer_failover = _serve_writer_failover(tmp, arrays, fp, v)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -1189,6 +1296,8 @@ def main_serve() -> None:
                     "write_load": write_load,
                     # fleet-router read path at 1 vs 3 replicas (r10)
                     "replicated_read": replicated_read,
+                    # WAL durability + fenced failover numbers (r11)
+                    "writer_failover": writer_failover,
                     "device": str(jax.devices()[0]),
                 },
             }

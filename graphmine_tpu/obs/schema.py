@@ -116,6 +116,22 @@ register("breaker_transition", "replica", "from_state", "to_state",
 register("fleet_route", "endpoint", "verdict", "attempts")
 register("fleet_degraded", "reason", "read_only")
 
+# ---- durable write path / replicated writers (docs/SERVING.md
+# "Replicated writers") --------------------------------------------------
+# wal_append: one per fsync'd write-ahead-log append (the durability
+# point every acknowledged delta passes through); wal_replay: one per
+# startup/promotion replay of the accepted-but-unapplied tail;
+# writer_promote: the standby-to-writer failover step (server- and
+# fleet-side both emit it, keyed by epoch); publish_fenced: a deposed
+# writer's publish refused at the snapshot store by the epoch fence —
+# THE split-brain-impossibility record; ship_lag: the standby's
+# replication lag while behind the primary's log (rate-limited).
+register("wal_append", "seq", "rows", "bytes", "seconds")
+register("wal_replay", "entries", "from_seq")
+register("writer_promote", "epoch")
+register("publish_fenced", "attempted_epoch", "store_epoch", "reason")
+register("ship_lag", "lag_entries", "lag_s")
+
 # ---- recovery / resilience records (docs/RESILIENCE.md) -------------------
 register("retry", "stage", "attempt", "backoff_s", "error")
 register("retries_exhausted", "stage", "attempts", "error")
@@ -135,7 +151,7 @@ RECOVERY_PHASES = frozenset((
     "watchdog_timeout", "resume", "checkpoint_rollback",
     "checkpoint_rollback_ok", "ivf_fallback", "quarantine",
     "repair_fallback", "delta_shed", "breaker_transition",
-    "fleet_degraded",
+    "fleet_degraded", "wal_replay", "writer_promote", "publish_fenced",
 ))
 
 

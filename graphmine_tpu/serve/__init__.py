@@ -27,7 +27,14 @@ forced a cold full recompute. This package is the steady-state side
   router with consistent-version routing over N replicas, per-replica
   circuit breakers, single-writer forwarding (writer loss = read-only,
   never split-brain) and zero-downtime rolling reload
-  (docs/SERVING.md "Fleet").
+  (docs/SERVING.md "Fleet");
+- :mod:`~graphmine_tpu.serve.wal` — the durable write-ahead delta log
+  + log shipping: accepted batches fsync before acknowledgement,
+  startup replay, idempotent retries (``X-Delta-Id``), a log-shipped
+  standby writer with bounded observable replication lag, and
+  writer-epoch fencing at the snapshot store so a deposed writer can
+  never clobber the promoted standby (docs/SERVING.md "Replicated
+  writers").
 """
 
 from graphmine_tpu.serve.admission import (
@@ -50,7 +57,12 @@ from graphmine_tpu.serve.fleet import (
     ReplicaSpec,
 )
 from graphmine_tpu.serve.query import QueryEngine
-from graphmine_tpu.serve.snapshot import Snapshot, SnapshotStore
+from graphmine_tpu.serve.snapshot import (
+    PublishFencedError,
+    Snapshot,
+    SnapshotStore,
+)
+from graphmine_tpu.serve.wal import LogShipper, WriteAheadLog
 
 __all__ = [
     "AdmissionBounds",
@@ -61,6 +73,8 @@ __all__ = [
     "EdgeDelta",
     "FleetConfig",
     "FleetRouter",
+    "LogShipper",
+    "PublishFencedError",
     "QueryEngine",
     "ReplicaSet",
     "ReplicaSpec",
@@ -68,5 +82,6 @@ __all__ = [
     "RepairResult",
     "Snapshot",
     "SnapshotStore",
+    "WriteAheadLog",
     "coalesce_deltas",
 ]

@@ -209,7 +209,7 @@ class AdmissionController:
 
     def resolve(
         self, rows: int, queue_depth: int, debt: dict,
-        applying: bool = False, emit: bool = True,
+        applying: bool = False, emit: bool = True, replay: bool = False,
     ) -> AdmissionDecision:
         """Resolve one incoming delta batch against the live debt state.
 
@@ -222,9 +222,15 @@ class AdmissionController:
         queue lock, and a sink's disk write must not serialize every
         handler, the worker and /healthz behind one fsync (counters and
         gauges are memory-only and stay here either way).
+
+        ``replay=True`` (WAL startup replay / promotion, serve/wal.py):
+        the batch was already accepted and durably acknowledged in a
+        previous life — shedding it now would un-accept acknowledged
+        work, so the shed rung is skipped and the verdict records why.
+        The LOF-defer rung still applies (replay pressure is pressure).
         """
         rows = int(rows)
-        shed = self._shed_reason(rows, queue_depth, debt)
+        shed = None if replay else self._shed_reason(rows, queue_depth, debt)
         if shed is not None:
             verdict, reason, lof_mode = "shed", shed, "refresh"
         else:
@@ -241,6 +247,11 @@ class AdmissionController:
             else:
                 verdict = "accept"
                 reason = "within bounds, queue idle"
+            if replay:
+                reason = (
+                    "WAL replay of an already-acknowledged batch "
+                    f"(shed rung skipped); {reason}"
+                )
             if defer_why:
                 reason += f"; {defer_why}"
         decision = AdmissionDecision(

@@ -864,11 +864,18 @@ class DeltaIngestor:
         num_shards: int = 1,
         snapshot: Snapshot | None = None,
         debt: RepairDebt | None = None,
+        epoch: int | None = None,
     ):
         self.store = store
         self.sink = sink
         self.check_samples = check_samples
         self.num_shards = num_shards
+        # Writer epoch every publish carries (replicated writers, r11):
+        # None = inherit the store's epoch (single-writer callers). A
+        # stale epoch makes the publish refuse with PublishFencedError —
+        # the deposed-writer fence lives at the store, this just says
+        # which epoch this ingestor believes it is.
+        self.epoch = epoch
         # Repair-debt ledger (docs/OBSERVABILITY.md "serving SLO"): the
         # front end owns one and shares it here so the pending side
         # survives ingestor rebasing on /reload; a bare ingestor gets a
@@ -1061,6 +1068,7 @@ class DeltaIngestor:
 
     def apply(
         self, delta: EdgeDelta, lof_mode: str = "refresh", batches: int = 1,
+        extra_meta: dict | None = None,
     ) -> Snapshot:
         """Validate, splice, repair, rescore and publish one delta batch.
 
@@ -1079,6 +1087,12 @@ class DeltaIngestor:
 
         ``batches``: how many submitted delta batches this apply settles
         in the debt ledger (a coalesced apply settles its whole group).
+
+        ``extra_meta``: extra manifest keys for the publish (the apply
+        worker stamps ``wal_applied_seq`` — the WAL cursor this snapshot
+        absorbs — so startup/promotion can reconcile the watermark
+        against the store instead of trusting a commit that may have
+        been lost to a crash between publish and commit).
         """
         if lof_mode not in ("refresh", "defer"):
             raise ValueError(
@@ -1141,8 +1155,12 @@ class DeltaIngestor:
                 ),
                 run_id=self.snapshot.meta.get("run_id", ""),
                 mesh_shape=[self.num_shards],
-                extra_meta={"lof_stale": True} if lof_stale else None,
+                extra_meta={
+                    **(extra_meta or {}),
+                    **({"lof_stale": True} if lof_stale else {}),
+                } or None,
                 sink=self.sink,
+                epoch=self.epoch,
             )
             self.snapshot = snap
             # Settle the debt ledger BEFORE emitting, so the record's

@@ -34,11 +34,24 @@ as an error or a mixed-version answer. This module is that tier
 - **Single-writer forwarding** — POST ``/delta`` and ``/reload``
   forward to the designated writer replica (one publisher per store is
   the r7 contract). Writer loss degrades the fleet to READ-ONLY with a
-  loud ``fleet_degraded`` record — never a second writer, never
-  split-brain; the same writer coming back (same identity, not an
+  loud ``fleet_degraded`` record — never a second *concurrent* writer,
+  never split-brain; the same writer coming back (same identity, not an
   election) restores writes with a matching record. Non-writer
   replicas catch up to the writer's publishes via the prober's
   ``/reload`` cadence.
+- **Fenced failover onto a log-shipped standby** (r11, docs/SERVING.md
+  "Replicated writers") — with a ``standby`` replica configured (one
+  running ``standby_of=<writer url>``, tailing the writer's WAL), the
+  read-only degradation is *transient*: the prober detects writer DOWN,
+  POSTs the standby's ``/promote`` (fence the store epoch → replay the
+  WAL tail → resume writes) and re-points write forwarding at it —
+  bounded time-to-writable with zero acknowledged-delta loss, every
+  step a ``writer_promote`` record. The deposed writer rejoining is
+  just a read replica (and the new standby candidate); its comeback
+  publish is refused AT THE STORE by the epoch fence
+  (``publish_fenced``) — split-brain is impossible, not merely refused
+  by convention. Without a standby, r10's loud read-only behavior is
+  unchanged.
 - **Zero-downtime rolling reload** — :meth:`FleetRouter.rolling_reload`
   drains one replica at a time (``draining`` replicas receive no
   reads), POSTs ``/reload``, re-probes until it is ready at the new
@@ -112,6 +125,7 @@ _ENV = {
     "breaker_open_rate": ("GRAPHMINE_FLEET_BREAKER_OPEN_RATE", float),
     "breaker_backoff_base_s": ("GRAPHMINE_FLEET_BREAKER_BACKOFF_BASE_S", float),
     "breaker_backoff_max_s": ("GRAPHMINE_FLEET_BREAKER_BACKOFF_MAX_S", float),
+    "promote_timeout_s": ("GRAPHMINE_FLEET_PROMOTE_TIMEOUT_S", float),
 }
 
 
@@ -143,6 +157,7 @@ class FleetConfig:
     breaker_open_rate: float = 0.5    # min failure rate in window to open
     breaker_backoff_base_s: float = 0.5
     breaker_backoff_max_s: float = 8.0
+    promote_timeout_s: float = 60.0   # one standby /promote exchange
 
     def __post_init__(self):
         if self.probe_interval_s <= 0 or self.probe_timeout_s <= 0:
@@ -363,6 +378,7 @@ class ReplicaSet:
         config: FleetConfig | None = None,
         sink=None,
         registry: Registry | None = None,
+        standby: str | None = None,
     ):
         specs = [
             r if isinstance(r, ReplicaSpec) else ReplicaSpec(*r)
@@ -381,6 +397,18 @@ class ReplicaSet:
             raise ValueError(
                 f"writer {self.writer_id!r} is not a replica ({ids})"
             )
+        # The log-shipped standby (r11): the replica the router promotes
+        # on writer loss. None = the r10 behavior (writer loss is a
+        # permanent read-only degradation until the same writer returns).
+        self.standby_id = standby
+        if standby is not None:
+            if standby not in ids:
+                raise ValueError(
+                    f"standby {standby!r} is not a replica ({ids})"
+                )
+            if standby == self.writer_id:
+                raise ValueError("the standby cannot be the writer")
+        self.writer_epoch: int | None = None
         self._lock = threading.RLock()
         bk = ResilienceConfig(
             backoff_base_s=self.config.breaker_backoff_base_s,
@@ -542,28 +570,82 @@ class ReplicaSet:
 
     def update_read_only(self) -> None:
         """The writer-liveness verdict: writer DOWN → read-only fleet
-        (loud ``fleet_degraded`` record) — never a second writer, never
-        split-brain. The SAME writer coming back restores writes (same
-        identity is not an election)."""
-        rep = self._replicas[self.writer_id]
+        (loud ``fleet_degraded`` record). With no standby that is where
+        it stays until the SAME writer returns (same identity is not an
+        election — r10); with a standby configured the router's prober
+        follows up with the fenced promotion, so read-only is the
+        bounded transient between loss and time-to-writable."""
         with self._lock:
-            lost = rep.state == DOWN
+            # writer_id must resolve under the lock: a concurrent
+            # promote_writer() re-points it, and judging the DEPOSED
+            # replica's state here would flip the just-promoted fleet
+            # back to read-only with a spurious fleet_degraded record
+            writer_id = self.writer_id
+            lost = self._replicas[writer_id].state == DOWN
             flip = lost != self._read_only
             if flip:
                 self._read_only = lost
+            standby = self.standby_id
         if flip:
             self._emit(
                 "fleet_degraded", read_only=lost,
                 reason=(
-                    f"writer {self.writer_id} is down: fleet is read-only "
-                    "(writes 503 until the writer returns; no failover — "
-                    "a second writer on one store is split-brain)"
+                    (
+                        f"writer {writer_id} is down: fleet is "
+                        "read-only "
+                        + (
+                            f"(standby {standby} promotion pending — "
+                            "writes resume at the new epoch)"
+                            if standby is not None else
+                            "(writes 503 until the writer returns; no "
+                            "failover without a standby — a second "
+                            "unfenced writer on one store is split-brain)"
+                        )
+                    )
                     if lost else
-                    f"writer {self.writer_id} recovered: writes restored"
+                    f"writer {writer_id} recovered: writes restored"
                 ),
-                writer=self.writer_id,
+                writer=writer_id,
             )
             self._export()
+
+    def promote_writer(self, new_writer: str, epoch: int | None,
+                       reason: str = "") -> None:
+        """Re-point the fleet at the promoted standby: it becomes THE
+        writer, the deposed writer becomes the standby candidate for
+        the next failover, and writes reopen. The epoch fence at the
+        store is what makes this safe — the deposed writer's comeback
+        publish refuses regardless of what this router believes."""
+        with self._lock:
+            deposed = self.writer_id
+            self.writer_id = new_writer
+            self.standby_id = deposed
+            self.writer_epoch = epoch
+            self._read_only = False
+        self._emit(
+            "writer_promote",
+            epoch=epoch,
+            replica=new_writer,
+            deposed=deposed,
+            reason=reason or (
+                f"standby {new_writer} promoted to writer at epoch "
+                f"{epoch}; deposed {deposed} is fenced at the store and "
+                "rejoins as the standby CANDIDATE — it is NOT "
+                "log-shipping from the new writer until relaunched "
+                f"with standby_of={new_writer}; until then a second "
+                "failover's loss bound is the unapplied tail at the "
+                "new writer's death, not the shipped lag (RUNBOOKS §10)"
+            ),
+        )
+        self._emit(
+            "fleet_degraded", read_only=False,
+            reason=(
+                f"writes restored on promoted writer {new_writer} "
+                f"(epoch {epoch}); deposed {deposed} fenced"
+            ),
+            writer=new_writer,
+        )
+        self._export()
 
     # -- routing -----------------------------------------------------------
     def pick(self, version: int, exclude=()) -> _Replica | None:
@@ -623,6 +705,8 @@ class ReplicaSet:
             "committed_version": committed,
             "quorum": self.quorum,
             "writer": self.writer_id,
+            "standby": self.standby_id,
+            "writer_epoch": self.writer_epoch,
             "read_only": read_only,
             "replicas": [
                 {
@@ -632,6 +716,11 @@ class ReplicaSet:
                     "state": r.state,
                     "version": r.version,
                     "writer": r.spec.id == self.writer_id,
+                    "standby": r.spec.id == self.standby_id,
+                    "writer_epoch": r.last_health.get("writer_epoch"),
+                    "replication_lag_s": r.last_health.get(
+                        "replication_lag_s"
+                    ),
                     "breaker": r.breaker.snapshot(),
                     "state_age_s": round(
                         time.monotonic() - r.state_since, 3
@@ -660,6 +749,7 @@ _POST_ROUTES = {
     "/delta": "_ep_write",
     "/reload": "_ep_write",
     "/roll": "_ep_roll",
+    "/promote": "_ep_promote",
 }
 
 
@@ -678,6 +768,7 @@ class FleetRouter:
         sink=None,
         config: FleetConfig | None = None,
         registry: Registry | None = None,
+        standby: str | None = None,
     ):
         self.config = config if config is not None else FleetConfig.from_env()
         self.sink = sink
@@ -686,7 +777,7 @@ class FleetRouter:
         )
         self.replica_set = ReplicaSet(
             replicas, writer=writer, config=self.config, sink=sink,
-            registry=self.registry,
+            registry=self.registry, standby=standby,
         )
         self._host, self._port = host, port
         self._httpd: ThreadingHTTPServer | None = None
@@ -694,6 +785,7 @@ class FleetRouter:
         self._prober: threading.Thread | None = None
         self._stop = threading.Event()
         self._roll_lock = threading.Lock()
+        self._promote_lock = threading.Lock()
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> tuple[str, int]:
@@ -851,6 +943,21 @@ class FleetRouter:
                         daemon=True,
                     ).start()
         rs.update_read_only()
+        # Fenced failover (r11): a read-only fleet with a live standby
+        # promotes it instead of staying degraded. Fire-and-forget like
+        # the reload cadence — a slow /promote (WAL-tail replay) must
+        # not stall DOWN detection; promote_standby's own lock keeps it
+        # single-flight, and the next pass retries a failed attempt.
+        if (
+            rs.read_only
+            and rs.standby_id is not None
+            and rs.replica(rs.standby_id).state not in (DOWN,)
+            and not self._stop.is_set()
+        ):
+            threading.Thread(
+                target=self.promote_standby,
+                name="graphmine-fleet-promote", daemon=True,
+            ).start()
 
     def _post_reload(self, rep: _Replica) -> None:
         try:
@@ -1009,6 +1116,61 @@ class FleetRouter:
                 attempts=attempts, version=version, **kv,
             )
 
+    # -- fenced failover ---------------------------------------------------
+    def promote_standby(self) -> dict:
+        """Promote the configured standby to writer (single-flight; the
+        prober fires it on writer loss, ``POST /promote`` and
+        ``fleet_cli promote`` fire it manually): one ``/promote``
+        exchange with the standby — it fences the store epoch, replays
+        its WAL tail and resumes writes — then the fleet re-points write
+        forwarding at it. On failure the fleet stays read-only and the
+        next prober pass retries."""
+        if not self._promote_lock.acquire(blocking=False):
+            return {"ok": False, "reason": "a promotion is already in flight"}
+        try:
+            rs = self.replica_set
+            if rs.standby_id is None:
+                return {"ok": False, "reason": "no standby configured"}
+            standby = rs.replica(rs.standby_id)
+            if standby.state == DOWN:
+                return {
+                    "ok": False,
+                    "reason": f"standby {standby.spec.id} is down",
+                }
+            try:
+                status, body, _ = self._replica_call(
+                    standby, "POST", "/promote", body=b"{}",
+                    timeout=self.config.promote_timeout_s,
+                )
+            except Exception as e:  # noqa: BLE001 — retried next pass
+                self._emit_route(
+                    "promote", "promote_failed", 1, rs.committed_version(),
+                    reason=repr(e),
+                )
+                return {"ok": False, "reason": repr(e)}
+            if status != 200:
+                self._emit_route(
+                    "promote", "promote_failed", 1, rs.committed_version(),
+                    reason=f"HTTP {status}",
+                )
+                return {"ok": False, "reason": f"/promote answered {status}"}
+            out = json.loads(body.decode())
+            epoch = out.get("epoch")
+            rs.promote_writer(standby.spec.id, epoch)
+            self.registry.counter(
+                "graphmine_fleet_promotions_total",
+                "standby-to-writer promotions",
+            ).inc()
+            return {
+                "ok": True,
+                "writer": standby.spec.id,
+                "epoch": epoch,
+                "replayed": out.get("replayed"),
+                "copied_tail": out.get("copied_tail"),
+            }
+        finally:
+            self._promote_lock.release()
+
     # -- write forwarding --------------------------------------------------
     def forward_write(
         self, path_qs: str, body: bytes | None, headers,
@@ -1026,7 +1188,11 @@ class FleetRouter:
             )
         writer = rs.replica(rs.writer_id)
         fwd_headers = {}
-        for name in ("X-Deadline-Ms", "X-Request-Id"):
+        # X-Delta-Id / X-Delta-Ack ride through: the idempotency key and
+        # the WAL-durable 202 contract are writer semantics the router
+        # must not strip (r11, docs/SERVING.md "Replicated writers").
+        for name in ("X-Deadline-Ms", "X-Request-Id", "X-Delta-Id",
+                     "X-Delta-Ack"):
             if headers.get(name):
                 fwd_headers[name] = headers[name]
         try:
@@ -1151,6 +1317,9 @@ class FleetRouter:
             "committed_version": committed,
             "replicas_serving": healthy,
             "replicas_total": len(rs.replicas()),
+            "writer": rs.writer_id,
+            "standby": rs.standby_id,
+            "writer_epoch": rs.writer_epoch,
             "read_only": rs.read_only,
             "ready": committed is not None
             and healthy >= max(1, self.config.min_healthy),
@@ -1265,4 +1434,8 @@ class _FleetHandler(BaseHTTPRequestHandler):
 
     def _ep_roll(self, url) -> None:
         out = self.rtr.rolling_reload()
+        self._reply_json(200 if out.get("ok") else 409, out)
+
+    def _ep_promote(self, url) -> None:
+        out = self.rtr.promote_standby()
         self._reply_json(200 if out.get("ok") else 409, out)

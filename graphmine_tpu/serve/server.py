@@ -28,7 +28,16 @@ Endpoints (JSON unless noted):
 ``GET  /topk?community=&k=``  top-k LOF outliers of one community
 ``POST /query``        ``{"vertices": [...]}`` — the batched gather path
 ``POST /delta``        ``{"insert": [[s,d],...], "delete": [[s,d],...]}``
-                       (``X-Deadline-Ms`` narrows the queued deadline)
+                       (``X-Deadline-Ms`` narrows the queued deadline;
+                       ``X-Delta-Id`` is the idempotency key the WAL
+                       dedupes retries on; ``X-Delta-Ack: wal`` answers
+                       **202** once the batch is WAL-durable instead of
+                       blocking to the publish)
+``GET  /wal``          ``?from=SEQ&limit=N`` — WAL entries for log
+                       shipping (the standby's tail; serve/wal.py)
+``POST /promote``      standby → writer: fence the store epoch, adopt
+                       the newest snapshot, replay the WAL tail, resume
+                       writes (the fleet failover ladder's last rung)
 ``POST /reload``       reload the store's newest snapshot and swap
 ``POST /drain``        flip readiness off (``ready: false``) — take the
                        replica out of rotation without killing it
@@ -66,6 +75,22 @@ listening); shed verdicts answer **503 + Retry-After** with a structured
 body, and ``/healthz`` carries an ``overloaded`` field driven by the
 same bounds so a balancer drains a saturated replica without duplicating
 thresholds.
+
+**Write durability + replicated writers** (r11, docs/SERVING.md
+"Replicated writers"): with a :class:`~graphmine_tpu.serve.wal
+.WriteAheadLog` attached, every admission-accepted batch is
+append-fsync'd *before* it is acknowledged or queued — a writer kill
+loses nothing acknowledged: startup replays the accepted-but-unapplied
+tail through the admission path (deduped by ``X-Delta-Id``), and a
+clean :meth:`stop` resolves WAL-durable queued batches as **202
+accepted** (they replay on restart) instead of shedding acknowledged
+work as 503s. Publishes carry this server's ``writer_epoch``; a
+deposed writer's comeback publish is refused at the store
+(``publish_fenced``). A server started with ``standby_of=<primary
+url>`` refuses client writes and tails the primary's WAL instead
+(bounded, observable replication lag on ``/healthz``); ``/promote``
+turns it into the writer: fence the epoch, adopt the newest snapshot,
+replay the WAL tail, resume writes.
 """
 
 from __future__ import annotations
@@ -78,6 +103,7 @@ import re
 import secrets
 import threading
 import time
+import warnings
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
@@ -96,7 +122,8 @@ from graphmine_tpu.serve.delta import (
     validate_delta,
 )
 from graphmine_tpu.serve.query import QueryEngine
-from graphmine_tpu.serve.snapshot import SnapshotStore
+from graphmine_tpu.serve.snapshot import PublishFencedError, SnapshotStore
+from graphmine_tpu.serve.wal import LogShipper, WriteAheadLog
 
 # Client-supplied request ids are echoed into headers, records and logs:
 # constrain them so a hostile header can't smuggle newlines/quotes.
@@ -116,6 +143,7 @@ _GET_ROUTES = {
     "/vertex": "_ep_vertex",
     "/neighbors": "_ep_neighbors",
     "/topk": "_ep_topk",
+    "/wal": "_ep_wal",
 }
 _POST_ROUTES = {
     "/query": "_ep_query",
@@ -123,6 +151,7 @@ _POST_ROUTES = {
     "/reload": "_ep_reload",
     "/drain": "_ep_drain",
     "/undrain": "_ep_undrain",
+    "/promote": "_ep_promote",
 }
 
 
@@ -148,7 +177,8 @@ class _PendingDelta:
     terminal transition."""
 
     __slots__ = ("delta", "rows", "deadline", "deadline_s", "status",
-                 "result", "error", "event", "shed_reason")
+                 "result", "error", "event", "shed_reason", "seq",
+                 "delta_id", "async_ack")
 
     def __init__(
         self, delta: EdgeDelta, rows: int, deadline: float,
@@ -163,6 +193,15 @@ class _PendingDelta:
         self.error: BaseException | None = None
         self.event = threading.Event()
         self.shed_reason = ""
+        # WAL identity (serve/wal.py): seq is the batch's durable log
+        # position (None = no WAL on this server), delta_id the client's
+        # idempotency key. async_ack batches were answered 202 at append
+        # time — nobody waits on the event, and the deadline is inf (a
+        # durable acknowledgement is never deadline-shed: the client
+        # already stopped waiting, by design).
+        self.seq: int | None = None
+        self.delta_id = ""
+        self.async_ack = False
 
 
 class SnapshotServer:
@@ -179,6 +218,11 @@ class SnapshotServer:
         slow_request_s: float = 1.0,
         admission: AdmissionController | None = None,
         ready_max_age_s: float | None = None,
+        wal=None,
+        writer_epoch: int | None = None,
+        standby_of: str | None = None,
+        primary_wal: str | None = None,
+        ship_interval_s: float = 0.2,
     ):
         self.store = store
         self.sink = sink
@@ -224,6 +268,45 @@ class SnapshotServer:
             self.admission.sink = sink
         if self.admission.registry is None:
             self.admission.registry = self.registry
+        # The durable write-ahead log (serve/wal.py). ``wal`` may be a
+        # WriteAheadLog, a directory path, or True (= <store>/wal). None
+        # keeps the pre-r11 in-memory-only write path.
+        if wal is True:
+            wal = os.path.join(store.root, "wal")
+        if isinstance(wal, str):
+            wal = WriteAheadLog(wal, sink=sink, registry=self.registry)
+        self.wal: WriteAheadLog | None = wal
+        if self.wal is not None:
+            if self.wal.sink is None:
+                self.wal.sink = sink
+            if self.wal.registry is None:
+                self.wal.registry = self.registry
+        # The epoch this writer stamps on publishes: adopt the store's
+        # unless told otherwise (a promotion bumps it via promote()).
+        self.writer_epoch = (
+            store.current_epoch() if writer_epoch is None
+            else int(writer_epoch)
+        )
+        self.standby_of = standby_of.rstrip("/") if standby_of else None
+        self.primary_wal = primary_wal
+        self._shipper: LogShipper | None = None
+        if self.standby_of is not None:
+            if self.wal is None:
+                raise ValueError(
+                    "a standby needs its own WAL directory to ship the "
+                    "primary's log into (pass wal=...)"
+                )
+            self._shipper = LogShipper(
+                self.wal, self.standby_of,
+                poll_interval_s=ship_interval_s, sink=sink,
+                registry=self.registry,
+            )
+            # Compaction guard: the shipped watermark describes the
+            # PRIMARY's store — this standby's own store (a bootstrap
+            # copy, possibly old) pins what its WAL may prune, or a
+            # separate-store promotion would rewind into pruned
+            # entries (acked loss past the shipped lag).
+            self.wal.protect_version = None  # set after the store loads
         snap = store.load(sink=sink)
         if snap is None:
             raise ValueError(
@@ -233,20 +316,41 @@ class SnapshotServer:
         # The double buffer: _engine is replaced atomically (one reference
         # assignment); handlers bind it to a local once per request.
         self._engine = QueryEngine(snap)
+        if self._shipper is not None:
+            self.wal.protect_version = snap.version
         self._ingestor: DeltaIngestor | None = None
         # One publisher at a time — the store's generation rotation (and
         # the ingestor's host state) assume it. Held by the apply worker
         # around each apply+swap, and by /reload.
         self._delta_lock = threading.Lock()
         # The bounded apply queue (admission gates its depth) + the one
-        # background worker that drains/coalesces it.
+        # background worker that drains/coalesces it. _reserved counts
+        # slots promised to batches that are mid-WAL-append (between the
+        # admission verdict and the enqueue) so concurrent submitters
+        # can't overshoot max_queue_depth through that window.
         self._queue: deque = deque()
+        self._reserved = 0
         self._queue_cv = threading.Condition()
         self._applying = False
         self._worker: threading.Thread | None = None
         self._worker_stop = False
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
+        # Serializes promote(): a router retry racing a slow promotion
+        # (or two operators) must not fence twice and re-enqueue the
+        # same pending entries (deltas are not idempotent). _promoted
+        # marks a COMPLETED promotion so the retry short-circuits.
+        self._promote_lock = threading.Lock()
+        self._promoted = False
+        # Set when a publish came back fenced (the store's epoch moved
+        # past ours — a standby was promoted while we were partitioned):
+        # this process is a DEPOSED writer. It must stop answering 202
+        # "accepted, durable" for new deltas — its publishes refuse
+        # forever, so the acknowledgements would be black holes (the
+        # promoted writer does not tail a zombie's WAL). Reads keep
+        # serving; writes refuse 503 until a later /promote re-legitimizes
+        # this process.
+        self._fenced: str | None = None
         self._host, self._port = host, port
         self._t0_wall = time.time()
         self._t0_mono = time.perf_counter()
@@ -254,6 +358,26 @@ class SnapshotServer:
         self._req_lock = threading.Lock()
         self._endpoint_errors: dict = {}
         self._export_metrics()
+        # Startup replay: accepted-but-unapplied WAL entries re-enqueue
+        # through the admission path (replay never sheds — the work was
+        # already acknowledged) so a killed writer's restart publishes
+        # everything it ever 202'd. Standbys skip it: the primary owns
+        # applies until /promote.
+        if self.wal is not None and self.standby_of is None:
+            # A fresh primary WAL records its store's current version as
+            # the (0, version) baseline pair — the voucher that lets a
+            # standby bootstrapped from a copy of THIS version replay
+            # from seq 0 exactly at promotion. Standbys never write it:
+            # their store is a copy, and copies are vouched for by the
+            # primary's shipped history, not local guesses.
+            self.wal.note_baseline(snap.version)
+            # Reconcile before replaying: a crash between publish and
+            # wal.commit leaves the watermark behind the store (replay
+            # would double-apply the absorbed entries); a store rollback
+            # to .prev leaves it ahead (replay would skip acknowledged
+            # work the rollback evicted).
+            self._reconcile_wal_cursor(snap, "startup")
+            self._replay_wal(source="startup")
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> tuple[str, int]:
@@ -269,9 +393,13 @@ class SnapshotServer:
             daemon=True,
         )
         self._thread.start()
+        if self._shipper is not None:
+            self._shipper.start()
         return self._httpd.server_address[:2]
 
     def stop(self) -> None:
+        if self._shipper is not None:
+            self._shipper.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -279,29 +407,41 @@ class SnapshotServer:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
-        # Drain the apply worker: anything still queued is shed with a
-        # shutdown verdict (its submitter gets the structured 503 rather
-        # than a hung socket), then the worker exits its loop.
+        # Drain the apply worker. WAL-durable queued batches are NOT
+        # shed: their acceptance is on disk and they replay on restart,
+        # so a clean stop resolves them as **accepted** (202) — a 503
+        # here would tell the client to resubmit work the server still
+        # owns (the r11 shutdown contract, tests/test_wal.py). Only
+        # never-durable entries (no WAL) shed with the shutdown verdict.
         with self._queue_cv:
             self._worker_stop = True
             leftovers = list(self._queue)
             self._queue.clear()
             for p in leftovers:
-                p.status = "shed"
-                p.shed_reason = "server shutting down"
+                if p.seq is not None:
+                    p.status = "accepted"
+                    p.result = self._accepted_payload(
+                        p, note="server stopping; replays on restart",
+                    )
+                else:
+                    p.status = "shed"
+                    p.shed_reason = "server shutting down"
             self._queue_cv.notify_all()
         for p in leftovers:
             self.debt.abandoned()
-            self.debt.shed(p.rows)
-            self.admission.record_shed(
-                p.shed_reason, p.rows, 0, self.debt.snapshot(),
-                stage="shutdown",
-            )
+            if p.status == "shed":
+                self.debt.shed(p.rows)
+                self.admission.record_shed(
+                    p.shed_reason, p.rows, 0, self.debt.snapshot(),
+                    stage="shutdown",
+                )
             p.event.set()
         if self._worker is not None:
             self._worker.join(timeout=30)
             self._worker = None
         self._worker_stop = False
+        if self.wal is not None:
+            self.wal.close()
 
     def _ensure_worker(self) -> None:
         """Start the apply worker lazily (first delta) so in-process
@@ -330,6 +470,10 @@ class SnapshotServer:
 
     def _swap(self, engine: QueryEngine) -> None:
         self._engine = engine  # atomic ref swap: the double-buffer flip
+        if self.standby_of is not None and self.wal is not None:
+            # a standby that reload-followed to a newer store version
+            # may release its WAL retention up to that version's floor
+            self.wal.protect_version = engine.version
         self._export_metrics()
 
     def _run_labels(self) -> dict | None:
@@ -374,7 +518,10 @@ class SnapshotServer:
                 self._ingestor = None
             return {"version": self._engine.version, "swapped": swapped}
 
-    def apply_delta(self, payload: dict, deadline_s: float | None = None) -> dict:
+    def apply_delta(
+        self, payload: dict, deadline_s: float | None = None,
+        delta_id: str | None = None, ack: str | None = None,
+    ) -> dict:
         """Ingest one delta batch (the POST /delta body) through
         admission control. Returns the publish result — or, on a shed,
         a structured refusal dict (``verdict: "shed"``) the HTTP layer
@@ -389,11 +536,57 @@ class SnapshotServer:
         propagated end-to-end by the fleet router and serve_cli) narrows
         the queued-batch deadline below the admission default — a
         client's budget can tighten the envelope, never widen it.
+
+        **Durability** (r11, serve/wal.py): with a WAL attached, an
+        accepted batch is append-fsync'd BEFORE it can queue or be
+        acknowledged. ``delta_id`` (the ``X-Delta-Id`` header) is the
+        idempotency key — a retry of a logged id returns ``verdict:
+        "duplicate"`` instead of a second apply. ``ack="wal"`` (the
+        ``X-Delta-Ack: wal`` header) returns ``verdict: "accepted"``
+        (HTTP **202**) right after the fsync: the batch applies in the
+        background, and survives a writer kill via startup replay —
+        durable acknowledgements are never deadline-shed.
         """
+        if self.standby_of is not None:
+            # A standby is not a writer: it tails the primary's WAL and
+            # waits for /promote. Accepting a delta here would be the
+            # split-brain the epoch fence exists to prevent.
+            return self._shed_payload(
+                f"standby of {self.standby_of}: writes go to the primary "
+                "(or POST /promote to make this replica the writer)",
+                self.admission.bounds.retry_after_s,
+            )
+        if self._fenced is not None:
+            # Deposed writer: a publish already refused with
+            # publish_fenced, so every future apply here would too.
+            # Accepting (and WAL-fsyncing) more deltas would acknowledge
+            # work that can never publish on this store and is never
+            # shipped to the promoted writer — the acknowledgement would
+            # lie. Refuse until a /promote re-fences in our favor.
+            return self._shed_payload(
+                f"writer fenced ({self._fenced}): a newer writer owns "
+                "the store; send writes to the promoted writer or POST "
+                "/promote here to take ownership back",
+                self.admission.bounds.retry_after_s,
+            )
+        if ack not in (None, "wal"):
+            raise ValueError(f"unknown ack mode {ack!r} (use 'wal')")
+        if ack == "wal" and self.wal is None:
+            raise ValueError(
+                "X-Delta-Ack: wal needs a server running with a "
+                "write-ahead log (serve --wal)"
+            )
         bound = self.admission.bounds.deadline_s
         deadline_s = bound if deadline_s is None else max(
             0.001, min(float(deadline_s), bound)
         )
+        # Fast-path dedupe: a retry of an id this WAL already holds maps
+        # onto the original accept — applied or still pending, never a
+        # second apply (the duplicate-submit parity pin).
+        if delta_id and self.wal is not None:
+            seq = self.wal.lookup(delta_id)
+            if seq is not None:
+                return self._duplicate_payload(delta_id, seq)
         delta = EdgeDelta.from_pairs(
             insert=payload.get("insert", ()), delete=payload.get("delete", ())
         )
@@ -414,7 +607,10 @@ class SnapshotServer:
         # Only memory-cheap work happens under the queue lock (the
         # worker, /healthz and every other handler contend on it); the
         # sink's record writes — potentially a disk fsync each — happen
-        # after release.
+        # after release. _reserved holds this batch's queue slot across
+        # the out-of-lock WAL fsync below, so concurrent submitters
+        # can't resolve their way past max_queue_depth through that
+        # window.
         with self._queue_cv:
             if self._worker_stop:
                 # stop() already drained the queue; parking here would
@@ -425,29 +621,79 @@ class SnapshotServer:
                 )
             debt_at_resolve = self.debt.snapshot()
             decision = self.admission.resolve(
-                rows=rows, queue_depth=len(self._queue),
+                rows=rows, queue_depth=len(self._queue) + self._reserved,
                 debt=debt_at_resolve, applying=self._applying, emit=False,
             )
             if decision.verdict != "shed":
-                # Debt accrues at ACCEPTANCE: batches parked on the
-                # apply queue are pending work the ledger (and
-                # /healthz) must already see — it is exactly what the
-                # shed bound reads.
-                self.debt.submitted(rows)
-                pending = _PendingDelta(
-                    delta, rows, time.monotonic() + deadline_s, deadline_s,
-                )
-                self._queue.append(pending)
-                self._queue_cv.notify_all()
-        self.admission.emit_admission(decision, debt_at_resolve)
+                self._reserved += 1
         if decision.verdict == "shed":
+            self.admission.emit_admission(decision, debt_at_resolve)
             self.debt.shed(rows)
             self.admission.record_shed(
                 decision.reason, rows, decision.queue_depth,
                 self.debt.snapshot(),
             )
             return self._shed_payload(decision.reason, decision.retry_after_s)
+        # Durability point: the fsync'd append happens BEFORE the batch
+        # can queue — from here on, a kill replays it on restart, so the
+        # acknowledgement below never lies.
+        pending = _PendingDelta(delta, rows, 0.0, deadline_s)
+        pending.delta_id = delta_id or ""
+        pending.async_ack = ack == "wal"
+        try:
+            if self.wal is not None:
+                seq, dup = self.wal.append(
+                    payload, delta_id=delta_id or "", deadline_s=deadline_s,
+                )
+                if dup:
+                    # the resolve still happened — one admission record
+                    # per resolve, duplicate outcome or not (the finally
+                    # below releases this batch's reserved queue slot)
+                    self.admission.emit_admission(decision, debt_at_resolve)
+                    return self._duplicate_payload(delta_id or "", seq)
+                pending.seq = seq
+        finally:
+            enqueued = False
+            with self._queue_cv:
+                self._reserved = max(0, self._reserved - 1)
+                if not self._worker_stop and (
+                    pending.seq is not None or self.wal is None
+                ):
+                    if pending.status == "queued":
+                        # durable acknowledgements never deadline-shed;
+                        # sync callers keep the client's budget
+                        pending.deadline = (
+                            math.inf if pending.async_ack
+                            else time.monotonic() + deadline_s
+                        )
+                        # Debt accrues at ACCEPTANCE: batches parked on
+                        # the apply queue are pending work the ledger
+                        # (and /healthz) must already see — it is
+                        # exactly what the shed bound reads.
+                        self.debt.submitted(rows)
+                        self._queue.append(pending)
+                        self._queue_cv.notify_all()
+                        enqueued = True
+                elif self._worker_stop and pending.seq is not None:
+                    # stop() won the race after the append: the batch is
+                    # durable and replays on restart — acknowledged, not
+                    # shed
+                    pending.status = "accepted"
+                    pending.result = self._accepted_payload(
+                        pending,
+                        note="server stopping; replays on restart",
+                    )
+        self.admission.emit_admission(decision, debt_at_resolve)
+        if not enqueued:
+            if pending.status == "accepted":
+                return pending.result
+            return self._shed_payload(
+                "server shutting down", self.admission.bounds.retry_after_s
+            )
         self._ensure_worker()
+        if pending.async_ack:
+            # the 202 path: WAL-durable IS the acknowledgement
+            return self._accepted_payload(pending)
 
         # Wait for a terminal state. First leg: bounded by the deadline —
         # a batch STILL QUEUED past it is shed here (deadline-aware
@@ -471,6 +717,7 @@ class SnapshotServer:
                     )
                     shed_now = True
         if shed_now:
+            self._skip_walled(pending)
             self.debt.abandoned()
             self.debt.shed(pending.rows)
             self.admission.record_shed(
@@ -482,7 +729,7 @@ class SnapshotServer:
         # finishes (its runtime is bounded by the repair budget) and the
         # client gets the real outcome, never a 503 for published work.
         pending.event.wait()
-        if pending.status == "done":
+        if pending.status in ("done", "accepted"):
             return pending.result
         if pending.status == "shed":
             return self._shed_payload(
@@ -496,6 +743,309 @@ class SnapshotServer:
             "error": "overloaded: delta shed by admission control",
             "reason": reason,
             "retry_after_s": float(retry_after_s),
+        }
+
+    def _accepted_payload(self, pending: _PendingDelta, note: str = "") -> dict:
+        """The 202 body: WAL-durable, not yet in a published snapshot."""
+        out = {
+            "verdict": "accepted",
+            "applied": False,
+            "durable": pending.seq is not None,
+            "seq": pending.seq,
+            "delta_id": pending.delta_id,
+        }
+        if note:
+            out["note"] = note
+        return out
+
+    def _duplicate_payload(self, delta_id: str, seq: int) -> dict:
+        """A retried idempotency key maps onto its original accept."""
+        applied = self.wal.seq_applied(seq)
+        out = {
+            "verdict": "duplicate",
+            "delta_id": delta_id,
+            "seq": int(seq),
+            "applied": applied,
+        }
+        if applied:
+            out["version"] = self._engine.version
+            out["applied_version"] = self.wal.applied_version
+        return out
+
+    def _skip_walled(self, pending: _PendingDelta) -> None:
+        """Tombstone a WAL-durable batch that was shed off the queue so
+        a later replay can't resurrect work the client was told is NOT
+        applied (its retry still dedupes-by-id into a fresh accept)."""
+        if pending.seq is None or self.wal is None:
+            return
+        try:
+            self.wal.skip(pending.seq)
+        except OSError:
+            pass  # tombstone is best-effort; dedupe bounds the damage
+
+    # -- WAL replay / log shipping / promotion ----------------------------
+    def _replay_wal(self, source: str = "startup") -> int:
+        """Re-enqueue every accepted-but-unapplied WAL entry through the
+        admission path (``replay=True`` — acknowledged work is never
+        shed), as async batches nobody waits on. Returns the count."""
+        entries = self.wal.pending()
+        if not entries:
+            return 0
+        n = 0
+        for e in entries:
+            payload = e.get("payload") or {}
+            try:
+                delta = EdgeDelta.from_pairs(
+                    insert=payload.get("insert", ()),
+                    delete=payload.get("delete", ()),
+                )
+            except ValueError:
+                continue  # the accept path parsed it once; be defensive
+            rows = delta.num_inserts + delta.num_deletes
+            with self._queue_cv:
+                if self._worker_stop:
+                    break
+                debt_at = self.debt.snapshot()
+                decision = self.admission.resolve(
+                    rows=rows,
+                    queue_depth=len(self._queue) + self._reserved,
+                    debt=debt_at, applying=self._applying, emit=False,
+                    replay=True,
+                )
+                self.debt.submitted(rows)
+                p = _PendingDelta(delta, rows, math.inf, float(
+                    e.get("deadline_s") or self.admission.bounds.deadline_s
+                ))
+                p.seq = int(e["seq"])
+                p.delta_id = e.get("id", "")
+                p.async_ack = True
+                self._queue.append(p)
+                self._queue_cv.notify_all()
+            self.admission.emit_admission(decision, debt_at)
+            n += 1
+        if self.sink is not None:
+            self.sink.emit(
+                "wal_replay", entries=n, from_seq=int(entries[0]["seq"]),
+                to_seq=int(entries[-1]["seq"]), source=source,
+            )
+        if n:
+            self._ensure_worker()
+        return n
+
+    def wal_entries(self, from_seq: int, limit: int = 512) -> dict:
+        """The ``GET /wal`` body — the log-shipping feed the standby's
+        :class:`~graphmine_tpu.serve.wal.LogShipper` tails."""
+        if self.wal is None:
+            raise ValueError(
+                "this server runs without a write-ahead log (serve --wal)"
+            )
+        return {
+            "entries": self.wal.entries(max(0, int(from_seq)),
+                                        limit=max(1, int(limit))),
+            "last_seq": self.wal.last_seq,
+            "applied_seq": self.wal.applied_seq,
+            "applied_version": self.wal.applied_version,
+            "history": self.wal.commit_history(),
+            "epoch": self.writer_epoch,
+        }
+
+    def wait_applied(self, timeout: float = 60.0) -> bool:
+        """Block until the apply queue is drained and nothing is
+        applying — the promotion path's (and tests') 'is every durable
+        acknowledgement published' barrier."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._queue_cv:
+                idle = not self._queue and not self._applying
+            if idle:
+                return True
+            time.sleep(0.02)
+        return False
+
+    def _warn(self, message: str) -> None:
+        """Loud in both channels: a ``warnings.warn`` (the ann.py /
+        checkpoint.py idiom) AND a schema-registered ``warning`` record
+        when a sink is attached — promotion anomalies must not depend on
+        the operator having wired telemetry."""
+        warnings.warn(message)
+        if self.sink is not None:
+            self.sink.emit("warning", message=message)
+
+    def _rewind_wal(self, floor: int, snap, context: str) -> None:
+        oldest = self.wal.oldest_retained_seq()
+        if oldest is not None and floor + 1 < oldest:
+            self._warn(
+                f"{context}: rewind to seq {floor} reaches below the "
+                f"compaction horizon (oldest retained seq {oldest}): "
+                f"entries {floor + 1}..{oldest - 1} were pruned here "
+                "and cannot replay — acknowledged-delta loss past the "
+                "shipped lag"
+            )
+        self._warn(
+            f"{context}: adopted snapshot v{snap.version} is behind the "
+            f"WAL watermark (seq {self.wal.applied_seq}, "
+            f"v{self.wal.applied_version}): rewinding the replay cursor "
+            f"to seq {floor} so durable-but-unapplied entries replay"
+        )
+        self.wal.rewind(floor, snap.version)
+
+    def _reconcile_wal_cursor(self, snap, context: str) -> None:
+        """Place the WAL replay cursor to match the store state actually
+        adopted — the watermark is a claim about THIS store, and three
+        windows can break it: a crash between publish and commit (store
+        ahead), a store rollback to ``.prev`` (store behind), and a
+        separate-store standby whose mirrored watermark describes the
+        primary's store. Voucher priority: the manifest's own
+        ``wal_applied_seq`` (stamped at publish — exact) > the watermark
+        history pair recorded AT the adopted version > a loud refusal to
+        guess (deltas are not idempotent; an off-by-one replays one
+        twice or drops an acknowledged one). An entry-less WAL skips:
+        there is nothing to replay, and adopting a foreign lineage's
+        cursor would park fresh appends below the watermark."""
+        if self.wal.last_seq == 0:
+            return
+        voucher = snap.meta.get("wal_applied_seq")
+        if voucher is not None:
+            voucher = int(voucher)
+            if voucher > self.wal.applied_seq:
+                # publish landed, its wal.commit was lost to the crash:
+                # move the cursor forward so replay can't double-apply
+                self.wal.commit(voucher, snap.version)
+            elif voucher < self.wal.applied_seq:
+                self._rewind_wal(voucher, snap, context)
+            above = snap.meta.get("wal_applied_above") or ()
+            if above:
+                # entries this snapshot absorbed above the contiguous
+                # floor (published over a then-unresolved gap): exclude
+                # them from replay the same crash-safe way
+                self.wal.commit_applied(above, snap.version)
+            return
+        if self.wal.applied_version > snap.version:
+            floor = self.wal.replay_floor(snap.version)
+            if floor is not None:
+                self._rewind_wal(floor, snap, context)
+            else:
+                self._warn(
+                    f"{context}: adopted snapshot v{snap.version} is "
+                    "behind the WAL watermark "
+                    f"(v{self.wal.applied_version}) and no retained "
+                    "watermark pair vouches for it — the replay cursor "
+                    "cannot be placed exactly; continuing from the "
+                    "watermark. Loss bound exceeds the shipped lag: "
+                    "re-bootstrap this standby from a fresher copy (or "
+                    "run the shared-store deployment)"
+                )
+
+    def promote(self) -> dict:
+        """Standby → writer, the failover ladder's last rung: (1) final
+        ship pass — catch up from the primary's ``/wal`` if it still
+        answers, then copy the un-shipped tail straight from its WAL
+        directory when reachable (the shared-store deployment: a
+        same-filesystem writer kill loses nothing; without shared
+        storage the loss bound is the shipped lag, which is why the lag
+        is a first-class observable); (2) **fence the epoch** durably at
+        the store — from this instant the deposed writer's publishes
+        refuse with ``publish_fenced``; (3) adopt the newest published
+        snapshot; (4) replay the WAL tail through admission; (5) resume
+        writes. Emits one ``writer_promote`` record.
+
+        Serialized and idempotent: concurrent calls queue on the lock,
+        and a call landing after the promotion completed (a router that
+        timed out mid-replay and retried next prober pass) answers
+        ``promoted: false, already_writer: true`` with the live epoch
+        instead of fencing again and re-enqueuing the same pending
+        entries."""
+        if self.wal is None:
+            raise ValueError(
+                "promote needs a write-ahead log (serve --wal)"
+            )
+        with self._promote_lock:
+            return self._promote_locked()
+
+    def _promote_locked(self) -> dict:
+        if self._promoted:
+            # THIS process already completed a promotion: the caller is
+            # a retry of it (router timed out mid-replay). A plain
+            # writer that never promoted does NOT short-circuit — an
+            # explicit /promote on it is a fence request (epoch bump
+            # cuts off a suspected zombie co-writer) and proceeds.
+            return {
+                "promoted": False,
+                "already_writer": True,
+                "epoch": self.writer_epoch,
+                "version": self._engine.version,
+                "replayed": 0,
+                "copied_tail": 0,
+            }
+        t0 = time.perf_counter()
+        if self._shipper is not None:
+            try:
+                self._shipper.poll_once()  # final catch-up, best effort
+            except Exception:  # noqa: BLE001 — primary usually dead here
+                pass
+            self._shipper.stop()
+        copied = 0
+        if self.primary_wal and os.path.isdir(self.primary_wal):
+            try:
+                # read_only: the primary may be a partitioned-but-alive
+                # zombie sharing this storage — a writable open's scan
+                # would "repair" (truncate) its in-flight append as a
+                # torn tail, destroying a frame it is about to fsync
+                # and acknowledge.
+                foreign = WriteAheadLog(self.primary_wal, read_only=True)
+                copied = self.wal.copy_from(
+                    foreign.entries(self.wal.last_seq + 1)
+                )
+                self.wal.merge_history(foreign.commit_history())
+                foreign.close()
+            except Exception as e:  # noqa: BLE001 — promote must proceed
+                self._warn(
+                    "promotion could not read the deposed "
+                    f"primary's WAL at {self.primary_wal!r}: {e!r}"
+                    " — continuing from the shipped copy (loss "
+                    "bound = replication lag)"
+                )
+        # Mint-and-fence atomically: composing current_epoch() + 1 with
+        # fence_epoch would let two concurrent promotions (prober
+        # auto-promote racing an operator's /promote on another server)
+        # fence the SAME epoch and both pass the store's fence.
+        new_epoch = self.store.advance_epoch(
+            sink=None,
+            reason=f"standby promotion (was standby of {self.standby_of})",
+        )
+        was = self.standby_of or ""
+        self.standby_of = None
+        self.writer_epoch = new_epoch
+        # The fence is now in OUR favor: a previously-deposed writer
+        # taking ownership back resumes accepting writes.
+        self._fenced = None
+        with self._delta_lock:
+            fresh = self.store.load(sink=self.sink)
+            if fresh is not None and fresh.version != self._engine.version:
+                self._swap(QueryEngine(fresh))
+            self._ingestor = None
+        if fresh is not None:
+            self._reconcile_wal_cursor(fresh, "promotion")
+        replayed = self._replay_wal(source="promotion")
+        # Now the primary: local commits describe THIS store, so the
+        # standby-era compaction guard lifts.
+        self.wal.protect_version = None
+        self._promoted = True
+        seconds = round(time.perf_counter() - t0, 3)
+        if self.sink is not None:
+            self.sink.emit(
+                "writer_promote", epoch=new_epoch, replayed=replayed,
+                copied_tail=copied, version=self._engine.version,
+                was_standby_of=was, seconds=seconds,
+            )
+        return {
+            "promoted": True,
+            "epoch": new_epoch,
+            "replayed": replayed,
+            "copied_tail": copied,
+            "version": self._engine.version,
+            "was_standby_of": was,
+            "seconds": seconds,
         }
 
     # -- the apply worker --------------------------------------------------
@@ -540,6 +1090,7 @@ class SnapshotServer:
                     # disk killing the sink's JSONL write would strand
                     # every already-popped 'applying' batch on an event
                     # that nobody will ever set.
+                    self._skip_walled(p)
                     self.debt.abandoned()
                     self.debt.shed(p.rows)
                     self.admission.record_shed(
@@ -557,6 +1108,16 @@ class SnapshotServer:
                 for p in group:
                     p.status, p.result = "done", result
             except BaseException as e:  # resolve, then keep serving
+                if isinstance(e, PublishFencedError) and self._fenced is None:
+                    # Deposed: flip the write path closed (reads keep
+                    # serving). Latched until a /promote re-fences the
+                    # epoch in this process's favor.
+                    self._fenced = str(e)
+                    self._warn(
+                        "publish fenced by a newer writer epoch — this "
+                        "process is deposed and now refuses new deltas "
+                        f"(503): {e}"
+                    )
                 for p in group:
                     p.status, p.error = "error", e
             finally:
@@ -604,6 +1165,7 @@ class SnapshotServer:
                         self.store, sink=self.sink,
                         num_shards=self.num_shards,
                         snapshot=self._engine.snapshot, debt=self.debt,
+                        epoch=self.writer_epoch,
                     )
                 ing = self._ingestor
                 if len(group) > 1:
@@ -632,8 +1194,29 @@ class SnapshotServer:
                 else:
                     merged = group[0].delta
                 lof_mode = self.admission.lof_mode(self.debt.snapshot())
+                # The manifest voucher must survive a crash between
+                # this publish and the wal.commit below (restart replay
+                # of absorbed entries = double apply). It CANNOT be the
+                # group's max seq: appends fsync outside the queue
+                # lock, so an acked lower seq can still be racing
+                # toward the queue while this group publishes — a
+                # max-seq watermark would jump that gap and a kill in
+                # the window silently drops the acked entry on restart.
+                # Stamp the CONTIGUOUS floor the WAL would reach plus
+                # the resolved seqs parked above it (wal_applied_above);
+                # replay excludes exactly those.
+                seqs = [p.seq for p in group if p.seq is not None]
+                if seqs and self.wal is not None:
+                    floor, above = self.wal.preview_commit(seqs)
+                    extra = {
+                        "wal_applied_seq": floor,
+                        "wal_applied_above": above,
+                    }
+                else:
+                    extra = None
                 snap = ing.apply(
-                    merged, lof_mode=lof_mode, batches=len(group)
+                    merged, lof_mode=lof_mode, batches=len(group),
+                    extra_meta=extra,
                 )
             except BaseException:
                 if self.debt.applies_total == settled_before:
@@ -641,6 +1224,14 @@ class SnapshotServer:
                         self.debt.abandoned()
                 raise
             self._swap(QueryEngine(snap))
+            if self.wal is not None and seqs:
+                # Compaction keyed to the published snapshot version:
+                # the durable watermark says "everything up to this seq
+                # is in snapshot v" — replay keys off it, pruning
+                # follows it. commit_applied advances only over the
+                # contiguous resolved run (never past an acked entry
+                # still in flight toward the queue).
+                self.wal.commit_applied(seqs, snap.version)
         self.registry.counter(
             "graphmine_serve_deltas_total", "delta batches ingested"
         ).inc(len(group))
@@ -710,7 +1301,23 @@ class SnapshotServer:
             "overloaded": overloaded,
             "delta_queue_depth": depth,
             "lof_stale": eng.lof_stale,
+            "writer_epoch": self.writer_epoch,
         }
+        if self._fenced is not None:
+            # deposed writer: reads serve, writes refuse 503 — the
+            # balancer/operator signal that this process lost ownership
+            out["fenced"] = self._fenced
+        if self.standby_of is not None:
+            out["standby"] = True
+            out["standby_of"] = self.standby_of
+            if self._shipper is not None:
+                ship = self._shipper.snapshot()
+                # the replication-lag gauge pair (docs/SERVING.md
+                # "Replicated writers"): entries behind + seconds behind
+                out["replication_lag_entries"] = ship["lag_entries"]
+                out["replication_lag_s"] = ship["lag_s"]
+        if self.wal is not None:
+            out["wal"] = self.wal.snapshot()
         if not ready:
             out["not_ready_reason"] = not_ready_why
         if overloaded:
@@ -774,7 +1381,12 @@ class SnapshotServer:
                 "applying": applying,
                 "lof_stale": eng.lof_stale,
             },
+            "writer_epoch": self.writer_epoch,
         }
+        if self.wal is not None:
+            payload["wal"] = self.wal.snapshot()
+        if self._shipper is not None:
+            payload["replication"] = self._shipper.snapshot()
         if self.sink is not None:
             self.sink.emit(
                 "slo_rollup",
@@ -1088,8 +1700,41 @@ class _Handler(BaseHTTPRequestHandler):
                 deadline_s = max(1, int(raw_ms)) / 1000.0
             except ValueError:
                 deadline_s = None
-        out = self.srv.apply_delta(self._body(), deadline_s=deadline_s)
-        if out.get("verdict") == "shed":
+        # X-Delta-Id (r11, serve/wal.py): the client's idempotency key —
+        # same constrained alphabet as request ids (it lands in records
+        # and response bodies verbatim).
+        delta_id = self.headers.get("X-Delta-Id", "")
+        if delta_id and not _REQUEST_ID_RE.fullmatch(delta_id):
+            self._error(
+                400, "X-Delta-Id must match [A-Za-z0-9._:-]{1,64}"
+            )
+            return
+        raw_ack = self.headers.get("X-Delta-Ack", "").strip().lower()
+        if raw_ack and raw_ack != "wal":
+            # an unknown mode must not silently downgrade to the
+            # blocking path — the client believes it asked for a fast
+            # durable 202 and would block to the full deadline instead
+            self._error(
+                400, f"unknown X-Delta-Ack mode {raw_ack!r} (use 'wal')"
+            )
+            return
+        ack = raw_ack or None
+        try:
+            out = self.srv.apply_delta(
+                self._body(), deadline_s=deadline_s,
+                delta_id=delta_id or None, ack=ack,
+            )
+        except PublishFencedError as e:
+            # The FIRST fenced sync publish surfaces here (the worker
+            # latches the write path closed as it raises — every later
+            # write gets the front-door shed). Answer the same
+            # structured 503 instead of dying with a dropped socket.
+            out = self.srv._shed_payload(
+                f"writer fenced ({e}): a newer writer owns the store",
+                self.srv.admission.bounds.retry_after_s,
+            )
+        verdict = out.get("verdict")
+        if verdict == "shed":
             # the structured refusal: 503 + a Retry-After the client's
             # backoff can obey without parsing the body
             self._reply(503, out, headers={
@@ -1097,8 +1742,22 @@ class _Handler(BaseHTTPRequestHandler):
                     max(1, math.ceil(out.get("retry_after_s", 1.0)))
                 ),
             })
+        elif verdict == "accepted":
+            # WAL-durable, not yet published: the honest 202
+            self._reply(202, out)
+        elif verdict == "duplicate":
+            self._reply(200 if out.get("applied") else 202, out)
         else:
             self._reply(200, out)
+
+    def _ep_wal(self, url) -> None:
+        qs = parse_qs(url.query)
+        from_seq = int(qs.get("from", ["1"])[0])
+        limit = min(4096, int(qs.get("limit", ["512"])[0]))
+        self._reply(200, self.srv.wal_entries(from_seq, limit=limit))
+
+    def _ep_promote(self, url) -> None:
+        self._reply(200, self.srv.promote())
 
     def _ep_reload(self, url) -> None:
         self._reply(200, self.srv.reload())

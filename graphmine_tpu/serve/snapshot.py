@@ -21,15 +21,32 @@ rollback state machine is literally shared with the checkpoint formats
 Versioning: each publish increments a monotonic ``version`` counter and
 records its parent's ``snapshot_id`` — the provenance chain a delta
 repair extends (docs/SERVING.md "snapshot format").
+
+**Writer-epoch fencing** (docs/SERVING.md "Replicated writers"): every
+manifest carries a monotonic ``writer_epoch``, and a publish whose
+epoch is *below* the store's current epoch (max of the newest manifest
+and the durable ``EPOCH`` fence file a promotion writes) is refused
+loudly with :class:`PublishFencedError` plus a ``publish_fenced``
+record — a deposed writer returning from a partition can never clobber
+the promoted standby's publishes, because the refusal happens AT the
+store, not by router convention. Epoch-less publishes (``epoch=None``,
+every pre-r11 caller) inherit the current epoch unchanged, so
+single-writer deployments never trip the fence.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import re
 import time
 from dataclasses import dataclass
+
+try:
+    import fcntl
+except ImportError:  # non-POSIX: single-process stores only
+    fcntl = None
 
 import numpy as np
 
@@ -47,7 +64,15 @@ from graphmine_tpu.pipeline.checkpoint import (
 )
 
 MANIFEST_NAME = "manifest.json"
+EPOCH_NAME = "EPOCH"
 _FORMAT_VERSION = 1
+
+
+class PublishFencedError(RuntimeError):
+    """A publish carried a writer epoch below the store's current epoch:
+    the publisher was deposed (a standby was promoted past it) and its
+    work must not reach readers. Not a retryable condition — the honest
+    recovery is rejoining as a replica/standby of the new writer."""
 # Array names become file names; keep them boring so a hostile/typo'd
 # name can never escape the generation directory.
 _NAME_RE = re.compile(r"^[A-Za-z0-9_][A-Za-z0-9_.-]*$")
@@ -93,6 +118,10 @@ class Snapshot:
     def num_edges(self) -> int:
         return int(self.meta.get("num_edges", 0))
 
+    @property
+    def writer_epoch(self) -> int:
+        return int(self.meta.get("writer_epoch", 0))
+
     def __getitem__(self, name: str) -> np.ndarray:
         return self.arrays[name]
 
@@ -119,6 +148,130 @@ class SnapshotStore:
     def _prev(self) -> str:
         return self._gen() + ".prev"
 
+    # -- writer epoch ------------------------------------------------------
+    @contextlib.contextmanager
+    def _fence_lock(self):
+        """Inter-process exclusive lock serializing the fence write
+        against the publish commit boundary. Without it the re-check at
+        the commit rename is a TOCTOU: a promotion (fence bump + first
+        publish) can land between a deposed writer's epoch read and its
+        generation rotation, and the deposed writer then evicts the
+        promoted writer's snapshot — the exact clobber the fence
+        declares impossible. ``flock`` releases on process death, so a
+        killed holder can never wedge the store."""
+        os.makedirs(self.root, exist_ok=True)
+        if fcntl is None:
+            yield
+            return
+        fd = os.open(
+            os.path.join(self.root, ".fence.lock"),
+            os.O_CREAT | os.O_RDWR, 0o644,
+        )
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    def _fence_file_epoch(self) -> int:
+        try:
+            with open(os.path.join(self.root, EPOCH_NAME)) as f:
+                return int(json.load(f).get("epoch", 0))
+        except (OSError, ValueError):
+            return 0
+
+    def current_epoch(self) -> int:
+        """The store's writer epoch: max of the newest manifest's
+        ``writer_epoch`` and the durable fence file (a promotion bumps
+        the fence first, so the deposed writer is fenced before the new
+        writer's first publish exists)."""
+        peek = self._peek_manifest()
+        manifest_epoch = int(peek.get("writer_epoch", 0)) if peek else 0
+        return max(manifest_epoch, self._fence_file_epoch())
+
+    def fence_epoch(self, epoch: int, sink=None, reason: str = "") -> int:
+        """Durably raise the store's writer epoch (atomic write + fsync
+        of the ``EPOCH`` fence file). From the moment this returns, any
+        publish carrying a lower epoch refuses with
+        :class:`PublishFencedError` — the promotion's first act, before
+        the standby replays a single WAL entry. Lowering is refused
+        (an epoch that can move backwards fences nothing)."""
+        epoch = int(epoch)
+        with self._fence_lock():
+            cur = self.current_epoch()
+            if epoch < cur:
+                raise ValueError(
+                    f"fence_epoch({epoch}) below the store's current epoch "
+                    f"{cur}: epochs are monotonic"
+                )
+            self._write_fence_locked(epoch, reason)
+        if sink is not None:
+            sink.emit(
+                "writer_promote", epoch=epoch, store=self.root,
+                reason=reason or "epoch fence raised",
+            )
+        return epoch
+
+    def _write_fence_locked(self, epoch: int, reason: str) -> None:
+        tmp = os.path.join(self.root, EPOCH_NAME + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(
+                {"epoch": epoch, "t": time.time(), "reason": reason}, f
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.root, EPOCH_NAME))
+        _fsync_dir(self.root)
+
+    def advance_epoch(self, sink=None, reason: str = "") -> int:
+        """Atomically mint-and-fence the NEXT writer epoch: read the
+        current epoch and durably raise it by one under the fence lock,
+        returning the new epoch this caller now exclusively owns.
+        ``fence_epoch(current_epoch() + 1)`` composed by the caller is
+        NOT equivalent — two concurrent promotions would read the same
+        current epoch and both fence the same value (fence_epoch
+        accepts an equal epoch as an idempotent re-assert), leaving two
+        writers that both pass the fence: the split-brain the epoch
+        exists to make impossible. Every promotion allocates here."""
+        with self._fence_lock():
+            epoch = self.current_epoch() + 1
+            self._write_fence_locked(epoch, reason)
+        if sink is not None:
+            sink.emit(
+                "writer_promote", epoch=epoch, store=self.root,
+                reason=reason or "epoch fence advanced",
+            )
+        return epoch
+
+    def _check_fence(self, epoch: int | None, sink) -> int:
+        """Resolve the publish epoch against the fence; raises
+        :class:`PublishFencedError` (with its loud ``publish_fenced``
+        record) for a deposed writer. ``None`` inherits — legacy
+        single-writer callers never trip this."""
+        cur = self.current_epoch()
+        if epoch is None:
+            return cur
+        epoch = int(epoch)
+        if epoch < cur:
+            if sink is not None:
+                sink.emit(
+                    "publish_fenced", attempted_epoch=epoch,
+                    store_epoch=cur, store=self.root,
+                    reason=(
+                        f"publish at writer epoch {epoch} refused: the "
+                        f"store was fenced at epoch {cur} (a standby was "
+                        "promoted past this writer)"
+                    ),
+                )
+            raise PublishFencedError(
+                f"publish refused: writer epoch {epoch} is behind the "
+                f"store's epoch {cur} at {self.root!r} — this writer was "
+                "deposed; rejoin as a replica of the promoted writer "
+                "instead of republishing"
+            )
+        return epoch
+
     # -- publish ----------------------------------------------------------
     def publish(
         self,
@@ -128,8 +281,17 @@ class SnapshotStore:
         mesh_shape=None,
         extra_meta: dict | None = None,
         sink=None,
+        epoch: int | None = None,
     ) -> Snapshot:
         """Durably publish one snapshot generation; returns it as loaded.
+
+        ``epoch``: the publisher's writer epoch (replicated-writer
+        deployments). ``None`` (every single-writer caller) inherits the
+        store's current epoch; an epoch below the store's refuses with
+        :class:`PublishFencedError` + a ``publish_fenced`` record — the
+        fence is checked on entry (cheap refusal before any bytes are
+        written) and again at the commit rename (a promotion racing a
+        slow publish still fences it).
 
         ``fingerprint`` ties the snapshot to the exact edge arrays /
         id assignment (``checkpoint.graph_fingerprint``); loads under a
@@ -154,6 +316,7 @@ class SnapshotStore:
                     f"snapshot arrays must be host numpy (got "
                     f"{type(arr).__name__} for {name!r}); np.asarray() first"
                 )
+        epoch = self._check_fence(epoch, sink)
         parent_version, parent_id = 0, ""
         peek = self._peek_manifest()
         if peek is not None:
@@ -195,6 +358,7 @@ class SnapshotStore:
             "parent": parent_id,
             "run_id": run_id or "",
             "fingerprint": fingerprint or "",
+            "writer_epoch": int(epoch),
             "mesh_shape": list(mesh_shape) if mesh_shape else [1],
             "created": time.time(),
             "arrays": entries,
@@ -222,27 +386,55 @@ class SnapshotStore:
             "snapshot_publish_commit", version=version, tmp=tmp
         )
 
-        prev = self._prev()
-        if os.path.exists(gen):
-            if self._peek_dir(gen) is None:
-                # The current generation's manifest is unreadable:
-                # rotating it into .prev would EVICT the only intact
-                # snapshot and install garbage as the rollback target
-                # (a kill before the final rename would then lose every
-                # loadable generation). Condemn it aside instead — the
-                # same *.corrupt convention as the loader's rollback.
-                condemned = gen + ".corrupt"
-                n = 0
-                while os.path.exists(condemned):
-                    n += 1
-                    condemned = f"{gen}.corrupt.{n}"
-                os.replace(gen, condemned)
-            else:
-                if os.path.exists(prev):
-                    shutil.rmtree(prev)
-                os.replace(gen, prev)
-        os.replace(tmp, gen)
-        _fsync_dir(self.root)
+        # Re-check the fence at the commit boundary: a promotion that
+        # landed while this publish was writing its (possibly large)
+        # arrays must still fence it — the deposed writer's work dies in
+        # the tmp directory, never in the published slot. The check and
+        # the rotation+rename hold the fence lock together: a
+        # fence_epoch cannot slip between them, so a fenced writer can
+        # never evict the promoted writer's generation (atomic with the
+        # fence, not merely checked near it).
+        with self._fence_lock():
+            cur = self.current_epoch()
+            if int(epoch) < cur:
+                shutil.rmtree(tmp, ignore_errors=True)
+                if sink is not None:
+                    sink.emit(
+                        "publish_fenced", attempted_epoch=int(epoch),
+                        store_epoch=cur, store=self.root,
+                        reason=(
+                            f"publish at writer epoch {epoch} fenced at the "
+                            f"commit rename: the store moved to epoch {cur} "
+                            "mid-publish (standby promoted during the write)"
+                        ),
+                    )
+                raise PublishFencedError(
+                    f"publish refused at commit: writer epoch {epoch} is "
+                    f"behind the store's epoch {cur} at {self.root!r} — a "
+                    "standby was promoted while this publish was in flight"
+                )
+
+            prev = self._prev()
+            if os.path.exists(gen):
+                if self._peek_dir(gen) is None:
+                    # The current generation's manifest is unreadable:
+                    # rotating it into .prev would EVICT the only intact
+                    # snapshot and install garbage as the rollback target
+                    # (a kill before the final rename would then lose every
+                    # loadable generation). Condemn it aside instead — the
+                    # same *.corrupt convention as the loader's rollback.
+                    condemned = gen + ".corrupt"
+                    n = 0
+                    while os.path.exists(condemned):
+                        n += 1
+                        condemned = f"{gen}.corrupt.{n}"
+                    os.replace(gen, condemned)
+                else:
+                    if os.path.exists(prev):
+                        shutil.rmtree(prev)
+                    os.replace(gen, prev)
+            os.replace(tmp, gen)
+            _fsync_dir(self.root)
         if sink is not None:
             sink.emit(
                 "snapshot_publish",

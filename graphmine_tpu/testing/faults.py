@@ -39,6 +39,14 @@ replica of a serving fleet — a dead listener, a slow data plane behind a
 live health probe, a version-pinned stale replica — the three failure
 shapes the fleet router's state machine, circuit breakers and
 committed-version routing exist to absorb (tests/test_fleet.py).
+
+Durable-write-path faults (ISSUE 10): :func:`wal_torn_tail` tears the
+write-ahead log's last frame (a kill mid-append — the open must keep
+the intact prefix), :func:`writer_kill_mid_apply` is the SIGKILL-shaped
+writer loss whose zombie publish the epoch fence must refuse, and
+:func:`ship_lag` congests the standby's log shipping so the replication
+lag gauges — and the promotion's loss-bound story — are testable
+(tests/test_wal.py).
 """
 
 from __future__ import annotations
@@ -327,6 +335,58 @@ def replica_slow(server, seconds: float) -> None:
     must open the per-replica circuit breaker rather than mark the
     replica down. ``replica_slow(server, 0.0)`` heals it."""
     server.chaos_delay_s = float(seconds)
+
+
+def wal_torn_tail(wal_root: str, cut_bytes: int = 7) -> str:
+    """Tear the tail of the newest write-ahead-log segment in place —
+    the bytes a kill mid-append leaves behind (a frame whose payload
+    never finished). ``cut_bytes`` lands inside the final record's
+    payload, so the sha256 (or the length) can no longer verify; the
+    next :class:`~graphmine_tpu.serve.wal.WriteAheadLog` open must keep
+    every record BEFORE the tear, truncate it, and keep appending —
+    never refuse the whole log. Returns the damaged segment path."""
+    import glob as _glob
+
+    segs = sorted(_glob.glob(os.path.join(wal_root, "wal-*.seg")))
+    if not segs:
+        raise ValueError(f"no WAL segments under {wal_root!r} to tear")
+    path = segs[-1]
+    size = os.path.getsize(path)
+    keep = max(8, size - max(1, cut_bytes))  # never cut into the magic
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+    return path
+
+
+def writer_kill_mid_apply(server) -> None:
+    """SIGKILL-shaped writer loss for an in-process chaos test: the
+    HTTP listener dies instantly (every later connection refused — what
+    the fleet prober sees when the writer process is killed) while the
+    apply worker is left RUNNING with whatever it already popped — the
+    zombie half of a killed writer. That zombie's eventual publish is
+    exactly the deposed-writer comeback the store's epoch fence must
+    refuse once the standby is promoted (``publish_fenced``); its
+    WAL-durable queue survives on disk for the promotion replay. (A
+    real SIGKILL also stops the worker — in-process we cannot kill a
+    thread, and leaving it grinding makes the test STRICTER: the fence,
+    not process death, is what protects the store.)"""
+    replica_kill(server)
+
+
+def ship_lag(server_or_shipper, seconds: float) -> None:
+    """Slow ONE standby's log shipping: every poll of the primary's
+    /wal stalls ``seconds`` first — the deterministic stand-in for a
+    congested replication link. The standby stays healthy and serving
+    reads while its replication lag (the /healthz gauge pair) grows;
+    ``ship_lag(x, 0.0)`` heals. Accepts a SnapshotServer (standby) or a
+    LogShipper."""
+    shipper = getattr(server_or_shipper, "_shipper", server_or_shipper)
+    if shipper is None or not hasattr(shipper, "chaos_delay_s"):
+        raise ValueError(
+            "ship_lag needs a standby server (standby_of=...) or a "
+            "LogShipper"
+        )
+    shipper.chaos_delay_s = float(seconds)
 
 
 def replica_stale(server, hold: bool = True) -> None:

@@ -117,6 +117,17 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "wal: durable-write-path suite (tests/test_wal.py: write-ahead "
+        "log framing/torn-tail/rotation/compaction, writer-epoch "
+        "fencing, WAL-durable 202 acknowledgements + kill/restart "
+        "replay, duplicate-submit idempotency, log-shipped standby + "
+        "replication lag, fenced promotion, and the 2-writer/3-replica "
+        "writer-SIGKILL chaos acceptance test); runs in the default "
+        "CPU pass — select with -m wal or tools/run_tier1.sh "
+        "--wal-only",
+    )
+    config.addinivalue_line(
+        "markers",
         "slo: serving-SLO observability suite (tests/test_slo.py: "
         "bucket histograms + merge associativity, live /metrics and "
         "/statusz under the query hammer, quantile agreement vs the "

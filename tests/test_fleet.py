@@ -188,10 +188,22 @@ class _Fleet:
 
     def restart_replica(self, i):
         """'Restart the process': a fresh SnapshotServer on the same
-        port (the spec's address is the replica's identity)."""
+        port (the spec's address is the replica's identity). The bind
+        retries briefly — under a full-suite run another socket can
+        transiently hold the freed ephemeral port (an outgoing
+        connection's tuple in TIME_WAIT), exactly like a real restart
+        racing the OS."""
         host, port = self.addrs[i]
         self.servers[i] = SnapshotServer(self.store, host=host, port=port)
-        self.servers[i].start()
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                self.servers[i].start()
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
         return self.servers[i]
 
     def stop(self):

@@ -1,0 +1,1196 @@
+"""Durable write path suite (marker ``wal``):
+tools/run_tier1.sh --wal-only.
+
+The acceptance pins (ISSUE 10):
+
+- write-ahead log: checksummed framed records fsync'd before the
+  acknowledgement, torn-tail tolerant recovery (wal_torn_tail), segment
+  rotation + compaction keyed to the published snapshot version;
+- writer-epoch fencing at the snapshot store: a stale-epoch publish
+  refuses loudly with ``PublishFencedError`` + a ``publish_fenced``
+  record — split-brain impossibility at the store, not by convention;
+- WAL-durable 202 acknowledgements + kill/restart: every 202-acked
+  delta reaches the final snapshot via startup replay; a clean stop
+  resolves WAL-durable queued batches as accepted (202), never a
+  shutdown 503;
+- duplicate-submit parity: a retried ``X-Delta-Id`` (serve_cli reuses
+  one key across retries) never double-applies;
+- the log-shipped standby: verbatim WAL copy within a bounded,
+  observable replication lag (``ship_lag`` injector + records,
+  /healthz gauges), fenced promotion replaying the tail;
+- THE chaos test: a hammered 2-writer/3-replica fleet, writer
+  SIGKILL'd mid-burst → standby promoted within the bound, ZERO
+  acknowledged-delta loss, ZERO mixed-version reads, and the deposed
+  writer's comeback publish fenced with a loud record.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from graphmine_tpu.graph.container import build_graph
+from graphmine_tpu.obs.schema import validate_records
+from graphmine_tpu.obs.spans import Tracer
+from graphmine_tpu.pipeline.checkpoint import graph_fingerprint
+from graphmine_tpu.pipeline.metrics import MetricsSink
+from graphmine_tpu.serve import (
+    PublishFencedError,
+    SnapshotStore,
+    WriteAheadLog,
+)
+from graphmine_tpu.serve.delta import DeltaIngestor, EdgeDelta, cold_recompute
+from graphmine_tpu.serve.fleet import FleetConfig, FleetRouter, ReplicaSpec
+from graphmine_tpu.serve.server import SnapshotServer
+from graphmine_tpu.testing import faults
+
+pytestmark = pytest.mark.wal
+
+
+# ---- fixtures -------------------------------------------------------------
+
+
+def _clique(lo, hi):
+    ids = np.arange(lo, hi)
+    s, d = np.meshgrid(ids, ids)
+    m = s.ravel() < d.ravel()
+    return s.ravel()[m], d.ravel()[m]
+
+
+def _community_graph():
+    parts = [_clique(0, 12), _clique(12, 26), _clique(26, 40)]
+    src = np.concatenate([p[0] for p in parts]).astype(np.int32)
+    dst = np.concatenate([p[1] for p in parts]).astype(np.int32)
+    return src, dst, 40
+
+
+def _sink():
+    return MetricsSink(tracer=Tracer())
+
+
+def _publish_base(tmp_path, sink=None):
+    src, dst, v = _community_graph()
+    g = build_graph(src, dst, num_vertices=v)
+    labels, cc, _ = cold_recompute(g)
+    store = SnapshotStore(str(tmp_path / "snap"))
+    store.publish(
+        {
+            "src": src, "dst": dst, "labels": labels, "cc_labels": cc,
+            "lof": np.zeros(v, np.float32),
+        },
+        fingerprint=graph_fingerprint(src, dst),
+        sink=sink,
+    )
+    return store, src, dst, v
+
+
+def _edges(engine):
+    return set(
+        zip(np.asarray(engine.snapshot["src"]).tolist(),
+            np.asarray(engine.snapshot["dst"]).tolist())
+    )
+
+
+def _post(host, port, path, payload, timeout=60, headers=None):
+    req = urllib.request.Request(
+        f"http://{host}:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _get(host, port, path, timeout=30):
+    with urllib.request.urlopen(
+        f"http://{host}:{port}{path}", timeout=timeout
+    ) as r:
+        return json.loads(r.read())
+
+
+# ---- WAL unit: framing / recovery / rotation / compaction -----------------
+
+
+def test_wal_append_entries_pending_roundtrip(tmp_path):
+    sink = _sink()
+    w = WriteAheadLog(str(tmp_path / "wal"), sink=sink)
+    for i in range(6):
+        seq, dup = w.append(
+            {"insert": [[i, i + 1]]}, delta_id=f"d{i}", deadline_s=5.0,
+        )
+        assert seq == i + 1 and not dup
+    assert w.last_seq == 6 and w.applied_seq == 0
+    got = w.entries(1)
+    assert [e["seq"] for e in got] == [1, 2, 3, 4, 5, 6]
+    assert got[2]["payload"] == {"insert": [[2, 3]]}
+    assert got[2]["id"] == "d2" and got[2]["deadline_s"] == 5.0
+    # a duplicate id maps onto the original accept, writing nothing
+    assert w.append({"insert": [[9, 9]]}, delta_id="d3") == (4, True)
+    assert w.last_seq == 6
+    # watermark: entries at/below it leave pending
+    w.commit(4, snapshot_version=5)
+    assert w.applied_seq == 4 and w.applied_version == 5
+    assert [e["seq"] for e in w.pending()] == [5, 6]
+    # tombstone: a durable-but-shed entry is excluded from replay
+    w.skip(5)
+    assert [e["seq"] for e in w.pending()] == [6]
+    w.close()
+    # a fresh open rebuilds the same state from disk alone
+    w2 = WriteAheadLog(str(tmp_path / "wal"))
+    assert w2.applied_seq == 4
+    assert [e["seq"] for e in w2.pending()] == [6]
+    assert w2.lookup("d5") == 6 and w2.lookup("nope") is None
+    w2.close()
+    appends = [r for r in sink.records if r["phase"] == "wal_append"]
+    assert len(appends) == 6
+    assert all(r["bytes"] > 0 and r["seconds"] >= 0 for r in appends)
+    assert validate_records(sink.records) == []
+
+
+def test_wal_torn_tail_keeps_prefix_and_appends_past(tmp_path):
+    root = str(tmp_path / "wal")
+    w = WriteAheadLog(root)
+    for i in range(5):
+        w.append({"insert": [[i, i + 1]]}, delta_id=f"d{i}")
+    w.close()
+    torn = faults.wal_torn_tail(root)
+    assert torn.endswith(".seg")
+    w2 = WriteAheadLog(root)
+    # every record before the tear is intact; the torn one is gone
+    assert w2.last_seq == 4
+    assert [e["seq"] for e in w2.pending()] == [1, 2, 3, 4]
+    # the log keeps accepting: the tear was truncated, not fatal
+    seq, dup = w2.append({"insert": [[7, 8]]}, delta_id="after")
+    assert seq == 5 and not dup
+    assert [e["seq"] for e in w2.pending()] == [1, 2, 3, 4, 5]
+    w2.close()
+    # and the repaired log reopens cleanly
+    w3 = WriteAheadLog(root)
+    assert w3.last_seq == 5
+    w3.close()
+
+
+def test_wal_rotation_and_compaction_keyed_to_version(tmp_path):
+    root = str(tmp_path / "wal")
+    w = WriteAheadLog(root, segment_max_bytes=256, retain_segments=1)
+    for i in range(12):
+        w.append({"insert": [[i, i + 1]]}, delta_id=f"d{i}")
+    n_before = w.snapshot()["segments"]
+    assert n_before >= 3  # the size bound rotated
+    # compaction follows the published-version watermark
+    w.commit(10, snapshot_version=11)
+    snap = w.snapshot()
+    assert snap["segments"] < n_before
+    # pending survives compaction; the retention tail keeps dedupe for
+    # recently applied ids
+    assert [e["seq"] for e in w.pending()] == [11, 12]
+    retained_ids = [
+        e["id"] for e in w.entries(0) if e.get("op") == "delta"
+    ]
+    assert "d11" in retained_ids
+    # in-memory dedupe still covers everything this process saw
+    assert w.append({"x": 1}, delta_id="d0")[1] is True
+    w.close()
+
+
+def test_wal_watermark_history_floor_and_rewind(tmp_path):
+    root = str(tmp_path / "wal")
+    w = WriteAheadLog(root)
+    w.note_baseline(1)          # fresh log next to a v1 store
+    assert w.commit_history() == [(0, 1)]
+    w.note_baseline(9)          # only the FIRST baseline sticks
+    assert w.commit_history() == [(0, 1)]
+    for i in range(3):
+        w.append({"insert": [[i, i + 1]]}, delta_id=f"d{i}")
+        w.commit(i + 1, snapshot_version=i + 2)
+    assert w.commit_history() == [(0, 1), (1, 2), (2, 3), (3, 4)]
+    # the floor answers only for versions a retained pair vouches for
+    assert w.replay_floor(1) == 0 and w.replay_floor(3) == 2
+    assert w.replay_floor(7) is None
+    # rewind moves the durable cursor back and drops foreign-lineage
+    # pairs above it; forward "rewinds" are refused
+    w.rewind(2, 3)
+    assert w.applied_seq == 2 and w.applied_version == 3
+    assert [e["seq"] for e in w.pending()] == [3]
+    w.rewind(5, 9)
+    assert w.applied_seq == 2
+    w.close()
+    # everything above survives a fresh open from disk alone
+    w2 = WriteAheadLog(root)
+    assert w2.applied_seq == 2 and w2.applied_version == 3
+    assert w2.commit_history() == [(0, 1), (1, 2), (2, 3)]
+    assert [e["seq"] for e in w2.pending()] == [3]
+    # merge_history: new seqs fill in, an existing seq keeps the local
+    # pair, the watermark advances to the merged max
+    w2.merge_history([(2, 99), (3, 4), (4, 5)])
+    assert w2.commit_history() == [(0, 1), (1, 2), (2, 3), (3, 4), (4, 5)]
+    assert w2.applied_seq == 4 and w2.applied_version == 5
+    w2.close()
+
+
+# ---- writer-epoch fencing at the store ------------------------------------
+
+
+def test_publish_epoch_fencing(tmp_path):
+    sink = _sink()
+    store, src, dst, v = _publish_base(tmp_path, sink=sink)
+    arrays = {
+        "src": src, "dst": dst,
+        "labels": np.zeros(v, np.int32), "cc_labels": np.zeros(v, np.int32),
+        "lof": np.zeros(v, np.float32),
+    }
+    assert store.current_epoch() == 0
+    # epoch-less publishes inherit (the single-writer compatibility rule)
+    s2 = store.publish(arrays, sink=sink)
+    assert s2.writer_epoch == 0
+    # the promotion's first act: durably raise the fence
+    store.fence_epoch(2, sink=sink, reason="test promotion")
+    assert store.current_epoch() == 2
+    # the deposed writer's comeback publish refuses LOUDLY
+    with pytest.raises(PublishFencedError, match="behind the store's epoch"):
+        store.publish(arrays, epoch=1, sink=sink)
+    fenced = [r for r in sink.records if r["phase"] == "publish_fenced"]
+    assert len(fenced) == 1
+    assert fenced[0]["attempted_epoch"] == 1 and fenced[0]["store_epoch"] == 2
+    # the promoted writer publishes at the fence
+    s3 = store.publish(arrays, epoch=2, sink=sink)
+    assert s3.writer_epoch == 2 and s3.version == 3
+    # the manifest chain carries the epoch; loads see it
+    assert store.load().writer_epoch == 2
+    # epochs never lower
+    with pytest.raises(ValueError, match="monotonic"):
+        store.fence_epoch(1)
+    assert validate_records(sink.records) == []
+
+
+def test_advance_epoch_concurrent_promotions_mint_distinct_epochs(tmp_path):
+    """The equal-epoch promotion race pin: ``fence_epoch(current_epoch()
+    + 1)`` composed by racing promoters (the prober's auto-promote vs an
+    operator's /promote on another server) reads the same current epoch
+    and fences the SAME value on both sides — fence_epoch accepts an
+    equal epoch as an idempotent re-assert, so both writers would pass
+    the fence and the split-brain the epoch exists to forbid is back.
+    ``advance_epoch`` mints read+increment under the inter-process fence
+    lock: every concurrent promotion gets a DISTINCT epoch, so exactly
+    one owns the highest and every other is immediately fenced."""
+    sink = _sink()
+    store, *_ = _publish_base(tmp_path, sink=sink)
+    # separate store handles = separate promoting servers on one root
+    handles = [store] + [SnapshotStore(store.root) for _ in range(3)]
+    minted, barrier = [], threading.Barrier(len(handles))
+    lock = threading.Lock()
+
+    def promote(s):
+        barrier.wait()
+        e = s.advance_epoch(sink=sink, reason="racing promotion")
+        with lock:
+            minted.append(e)
+
+    threads = [threading.Thread(target=promote, args=(s,)) for s in handles]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # no two promotions own the same epoch; the store ends at the max
+    assert sorted(minted) == [1, 2, 3, 4]
+    assert store.current_epoch() == 4
+    # every mint announced itself (one writer_promote per promotion)
+    promotes = [r for r in sink.records if r["phase"] == "writer_promote"]
+    assert len(promotes) == 4
+    # equal-epoch re-assert via fence_epoch stays an idempotent no-raise
+    # (the standby's startup path re-asserts its own fence), while every
+    # loser of the race is fenced at the store
+    assert store.fence_epoch(4) == 4
+    assert validate_records(sink.records) == []
+
+
+def test_fenced_ingestor_publish(tmp_path):
+    """The deposed-writer shape end-to-end: an ingestor created at the
+    old epoch keeps working until the store is fenced past it, then its
+    next publish refuses — acknowledged state is safe from the zombie."""
+    sink = _sink()
+    store, *_ = _publish_base(tmp_path, sink=sink)
+    deposed = DeltaIngestor(
+        store, sink=sink, lof_k=4, check_samples=8, epoch=0,
+    )
+    deposed.apply(EdgeDelta.from_pairs(insert=[(0, 13)]))  # fine at epoch 0
+    store.fence_epoch(1, reason="standby promoted")
+    with pytest.raises(PublishFencedError):
+        deposed.apply(EdgeDelta.from_pairs(insert=[(0, 14)]))
+    assert any(r["phase"] == "publish_fenced" for r in sink.records)
+    # the promoted side continues the version chain unharmed
+    promoted = DeltaIngestor(
+        store, sink=sink, lof_k=4, check_samples=8, epoch=1,
+    )
+    snap = promoted.apply(EdgeDelta.from_pairs(insert=[(0, 15)]))
+    assert snap.version == 3 and snap.writer_epoch == 1
+    assert validate_records(sink.records) == []
+
+
+# ---- WAL-durable acknowledgements: 202, kill/restart, shutdown ------------
+
+
+def test_wal_202_ack_and_kill_restart_replays_everything(tmp_path):
+    """THE durability pin (satellite 1): every 202-acknowledged delta
+    reaches the final served snapshot across a writer kill — the WAL
+    replays the accepted-but-unapplied tail through admission on
+    restart."""
+    sink = _sink()
+    store, src, dst, v = _publish_base(tmp_path, sink=sink)
+    wal_dir = str(tmp_path / "wal")
+    server = SnapshotServer(store, sink=sink, wal=wal_dir)
+    acked = []
+    out = server.apply_delta(
+        {"insert": [[0, 13]]}, delta_id="live-0", ack="wal",
+    )
+    assert out["verdict"] == "accepted" and out["durable"]
+    acked.append((0, 13))
+    server.wait_applied(60)
+    # kill the listener; the 'process' stops cleanly but MORE durable
+    # acknowledgements exist only in the WAL (appended after the last
+    # apply — the crash window)
+    faults.writer_kill_mid_apply(server)
+    w = WriteAheadLog(wal_dir)
+    for i, pair in enumerate([(1, 14), (2, 15), (3, 16)]):
+        seq, dup = w.append(
+            {"insert": [list(pair)]}, delta_id=f"crash-{i}",
+        )
+        assert not dup
+        acked.append(pair)
+    w.close()
+    # 'restart the writer': a fresh server on the same store + WAL
+    sink2 = _sink()
+    server2 = SnapshotServer(store, sink=sink2, wal=wal_dir)
+    assert server2.wait_applied(120)
+    edges = _edges(server2.engine)
+    for pair in acked:
+        assert pair in edges, f"202-acked delta {pair} lost across restart"
+    replays = [r for r in sink2.records if r["phase"] == "wal_replay"]
+    assert replays and replays[0]["entries"] == 3
+    assert replays[0]["source"] == "startup"
+    # replayed applies settled the watermark: a second restart is a no-op
+    assert server2.wal.applied_seq == server2.wal.last_seq
+    server2.stop()
+    assert validate_records(sink2.records) == []
+
+
+def test_clean_stop_resolves_durable_batches_as_accepted_not_shed(tmp_path):
+    """Satellite 1's shutdown half: a clean stop() must NOT drain
+    WAL-durable accepted batches as 503 sheds — they resolve as 202
+    accepted and replay on restart. (Pre-r11, stop() shed them with
+    'server shutting down' — un-accepting acknowledged work.)"""
+    sink = _sink()
+    store, *_ = _publish_base(tmp_path, sink=sink)
+    wal_dir = str(tmp_path / "wal")
+    server = SnapshotServer(store, sink=sink, wal=wal_dir)
+    inj = faults.FaultInjector()
+    inj.add("delta_repair", faults.slow_repair(1.0), at=1, repeat=1)
+    results = []
+
+    def fire(payload, delta_id):
+        results.append(
+            server.apply_delta(payload, delta_id=delta_id)
+        )
+
+    with inj.installed():
+        t0 = threading.Thread(
+            target=fire, args=({"insert": [[0, 13]]}, "held"),
+        )
+        t0.start()
+        time.sleep(0.3)  # batch A mid-apply, holding the worker
+        t1 = threading.Thread(
+            target=fire, args=({"insert": [[0, 14]]}, "parked"),
+        )
+        t1.start()
+        time.sleep(0.2)  # batch B parked on the queue, WAL-durable
+        stopper = threading.Thread(target=server.stop)
+        stopper.start()
+        t0.join(timeout=60)
+        t1.join(timeout=60)
+        stopper.join(timeout=60)
+    by_id = {r.get("delta_id", ""): r for r in results if "verdict" in r}
+    parked = by_id.get("parked") or next(
+        r for r in results if r.get("verdict") == "accepted"
+    )
+    assert parked["verdict"] == "accepted", results
+    assert parked["durable"] and "replays on restart" in parked["note"]
+    # NO shutdown shed was recorded for the durable batch
+    sheds = [
+        r for r in sink.records
+        if r["phase"] == "delta_shed" and r["stage"] == "shutdown"
+    ]
+    assert sheds == []
+    # restart: the accepted batch reaches the snapshot
+    server2 = SnapshotServer(store, sink=sink, wal=wal_dir)
+    assert server2.wait_applied(120)
+    assert (0, 14) in _edges(server2.engine)
+    server2.stop()
+    assert validate_records(sink.records) == []
+
+
+def test_duplicate_delta_id_never_double_applies(tmp_path):
+    """Duplicate-submit parity (satellite 2): the same X-Delta-Id
+    resubmitted — racing while pending AND retried after the apply —
+    produces exactly one application of the batch."""
+    sink = _sink()
+    store, *_ = _publish_base(tmp_path, sink=sink)
+    server = SnapshotServer(store, sink=sink, wal=str(tmp_path / "wal"))
+    host, port = server.start()
+    try:
+        code, out, _ = _post(
+            host, port, "/delta", {"insert": [[0, 13]]},
+            headers={"X-Delta-Id": "once", "X-Delta-Ack": "wal"},
+        )
+        assert code == 202 and out["verdict"] == "accepted"
+        # a racing duplicate while (possibly) still pending
+        code2, out2, _ = _post(
+            host, port, "/delta", {"insert": [[0, 13]]},
+            headers={"X-Delta-Id": "once", "X-Delta-Ack": "wal"},
+        )
+        assert out2["verdict"] == "duplicate" and out2["seq"] == out["seq"]
+        server.wait_applied(60)
+        # a retry after the lost 202: deduped, applied, NOT re-spliced
+        code3, out3, _ = _post(
+            host, port, "/delta", {"insert": [[0, 13]]},
+            headers={"X-Delta-Id": "once"},
+        )
+        assert code3 == 200
+        assert out3["verdict"] == "duplicate" and out3["applied"]
+        src = np.asarray(server.engine.snapshot["src"])
+        dst = np.asarray(server.engine.snapshot["dst"])
+        n = int(((src == 0) & (dst == 13)).sum())
+        assert n == 1, f"duplicate submit applied {n} times"
+        # a malformed id is refused before it can pollute records
+        code4, out4, _ = _post(
+            host, port, "/delta", {"insert": [[0, 14]]},
+            headers={"X-Delta-Id": "bad id! definitely not in the alphabet"},
+        )
+        assert code4 == 400
+    finally:
+        server.stop()
+    assert validate_records(sink.records) == []
+
+
+# ---- serve_cli: idempotency key rides every retry (satellite 2) -----------
+
+
+class _ShedThenOkHandler(BaseHTTPRequestHandler):
+    sheds_left = 2
+    seen_ids: list = []
+
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+    def do_POST(self):  # noqa: N802
+        self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        type(self).seen_ids.append(self.headers.get("X-Delta-Id"))
+        if type(self).sheds_left > 0:
+            type(self).sheds_left -= 1
+            body = json.dumps({"verdict": "shed", "reason": "test"}).encode()
+            self.send_response(503)
+            self.send_header("Retry-After", "1")
+        else:
+            body = json.dumps({"version": 2}).encode()
+            self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def test_serve_cli_delta_sends_one_idempotency_key_across_retries(capsys):
+    import sys
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "tools")
+    )
+    import serve_cli
+
+    class H(_ShedThenOkHandler):
+        sheds_left = 2
+        seen_ids = []
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    host, port = httpd.server_address[:2]
+    try:
+        rc = serve_cli.main([
+            "delta", "--url", f"http://{host}:{port}",
+            "--insert", "1,2", "--max-retries", "4",
+        ])
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["status"] == 200 and out["attempts"] == 3
+    # ONE generated key, identical on every attempt — the server-side
+    # dedupe contract for retries after a lost acknowledgement
+    assert len(H.seen_ids) == 3
+    assert len(set(H.seen_ids)) == 1 and H.seen_ids[0]
+    assert H.seen_ids[0] == out["delta_id"]
+
+
+# ---- log shipping: standby copy + observable lag --------------------------
+
+
+def test_standby_ships_wal_and_lag_is_observable(tmp_path):
+    sink = _sink()
+    store, *_ = _publish_base(tmp_path, sink=sink)
+    primary = SnapshotServer(
+        store, sink=sink, wal=str(tmp_path / "wal-p"),
+    )
+    host, port = primary.start()
+    standby = SnapshotServer(
+        store, sink=sink, wal=str(tmp_path / "wal-s"),
+        standby_of=f"http://{host}:{port}",
+        primary_wal=str(tmp_path / "wal-p"),
+    )
+    try:
+        # a standby refuses client writes (503 through the shed path)
+        refused = standby.apply_delta({"insert": [[0, 13]]})
+        assert refused["verdict"] == "shed"
+        assert "standby" in refused["reason"]
+        for i in range(3):
+            primary.apply_delta(
+                {"insert": [[0, 13 + i]]}, delta_id=f"p{i}", ack="wal",
+            )
+        primary.wait_applied(60)
+        # deterministic catch-up: one poll ships the verbatim copy
+        standby._shipper.poll_once()
+        assert standby.wal.last_seq == primary.wal.last_seq
+        assert standby.wal.applied_seq == primary.wal.applied_seq
+        ship = standby._shipper.snapshot()
+        assert ship["lag_entries"] == 0
+        h = standby.healthz()
+        assert h["standby"] and h["replication_lag_entries"] == 0
+        assert "wal" in h and h["wal"]["last_seq"] == primary.wal.last_seq
+        # congest the link: lag becomes visible, then heals
+        faults.ship_lag(standby, 30.0)
+        primary.apply_delta(
+            {"insert": [[1, 20]]}, delta_id="behind", ack="wal",
+        )
+        primary.wait_applied(60)
+        # the standby has NOT polled (chaos delay): manufacture the lag
+        # verdict deterministically by asking the primary where it is
+        faults.ship_lag(standby, 0.0)
+        standby._shipper.poll_once()
+        assert standby.wal.lookup("behind") is not None
+        # ship_lag records appear only while genuinely behind; the
+        # snapshot surface always answers
+        assert standby._shipper.snapshot()["polls"] >= 2
+    finally:
+        standby.stop()
+        primary.stop()
+    assert validate_records(sink.records) == []
+
+
+def test_ship_lag_injector_delays_polls_and_emits_records(tmp_path):
+    sink = _sink()
+    store, *_ = _publish_base(tmp_path, sink=sink)
+    primary = SnapshotServer(store, sink=sink, wal=str(tmp_path / "wal-p"))
+    host, port = primary.start()
+    standby = SnapshotServer(
+        store, sink=sink, wal=str(tmp_path / "wal-s"),
+        standby_of=f"http://{host}:{port}", ship_interval_s=0.05,
+    )
+    standby.start()
+    try:
+        faults.ship_lag(standby, 0.4)
+        for i in range(2):
+            primary.apply_delta(
+                {"insert": [[0, 13 + i]]}, delta_id=f"lag{i}", ack="wal",
+            )
+        # while the link crawls, the primary is ahead; the loop's
+        # first delayed poll lands within ~0.5s and reports the gap it
+        # closed — wait for catch-up and assert lag was observed
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if standby.wal.lookup("lag1") is not None:
+                break
+            time.sleep(0.05)
+        assert standby.wal.lookup("lag1") is not None
+    finally:
+        standby.stop()
+        primary.stop()
+    assert validate_records(sink.records) == []
+
+
+# ---- promotion: fence, replay, resume -------------------------------------
+
+
+def test_promote_replays_tail_and_fences_deposed_writer(tmp_path):
+    sink = _sink()
+    store, *_ = _publish_base(tmp_path, sink=sink)
+    wal_p = str(tmp_path / "wal-p")
+    primary = SnapshotServer(store, sink=sink, wal=wal_p)
+    host, port = primary.start()
+    standby = SnapshotServer(
+        store, sink=sink, wal=str(tmp_path / "wal-s"),
+        standby_of=f"http://{host}:{port}", primary_wal=wal_p,
+    )
+    try:
+        primary.apply_delta(
+            {"insert": [[0, 13]]}, delta_id="shipped", ack="wal",
+        )
+        primary.wait_applied(60)
+        standby._shipper.poll_once()
+        # the writer dies with an acked-but-unshipped, unapplied tail
+        faults.writer_kill_mid_apply(primary)
+        w = WriteAheadLog(wal_p)
+        w.append({"insert": [[1, 14]]}, delta_id="tail")
+        w.close()
+        out = standby.promote()
+        assert out["promoted"] and out["epoch"] == 1
+        assert out["copied_tail"] >= 1 and out["replayed"] >= 1
+        assert standby.wait_applied(120)
+        edges = _edges(standby.engine)
+        assert (0, 13) in edges and (1, 14) in edges
+        # the promoted writer accepts writes at the new epoch
+        res = standby.apply_delta({"insert": [[2, 15]]}, delta_id="new")
+        assert res["version"] == standby.engine.version
+        assert standby.healthz()["writer_epoch"] == 1
+        assert "standby" not in standby.healthz()
+        # the deposed writer's zombie apply publishes → fenced AT the
+        # store, loudly — split-brain is impossible, not refused by
+        # convention
+        with pytest.raises(PublishFencedError):
+            primary.apply_delta({"insert": [[3, 16]]}, delta_id="zombie")
+        fenced = [r for r in sink.records if r["phase"] == "publish_fenced"]
+        assert fenced and fenced[-1]["store_epoch"] == 1
+        promotes = [r for r in sink.records if r["phase"] == "writer_promote"]
+        assert promotes and promotes[-1]["epoch"] == 1
+    finally:
+        standby.stop()
+        try:
+            primary.stop()
+        except Exception:  # noqa: BLE001 — listener already killed
+            pass
+    assert validate_records(sink.records) == []
+
+    # the offline report renders the failover timeline from the JSONL
+    import sys
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "tools")
+    )
+    import obs_report
+
+    report = obs_report.build_report(sink.records)
+    assert "-- writer failover (WAL / promotion / fencing) --" in report
+    assert "WRITER PROMOTE" in report
+    assert "PUBLISH FENCED" in report
+    assert "wal appends:" in report
+
+
+def test_promote_separate_store_standby_rewinds_and_loses_nothing(tmp_path):
+    """A standby running its OWN bootstrap copy of the store (no shared
+    filesystem): the shipper mirrors the primary's watermark, which
+    describes a store this replica does not have — promotion must place
+    the replay cursor from the shipped watermark HISTORY at the adopted
+    snapshot's version, so shipped-but-locally-unapplied acked deltas
+    replay instead of being masked as applied (the documented loss
+    bound is the shipped lag — here zero — not the bootstrap age)."""
+    import shutil
+
+    sink = _sink()
+    store, *_ = _publish_base(tmp_path, sink=sink)
+    primary = SnapshotServer(store, sink=sink, wal=str(tmp_path / "wal-p"))
+    host, port = primary.start()
+    # bootstrap the standby's store as a copy at v1, BEFORE any deltas
+    shutil.copytree(str(tmp_path / "snap"), str(tmp_path / "snap-b"))
+    store_b = SnapshotStore(str(tmp_path / "snap-b"))
+    standby = SnapshotServer(
+        store_b, sink=sink, wal=str(tmp_path / "wal-s"),
+        standby_of=f"http://{host}:{port}",
+    )
+    try:
+        for i in range(2):
+            primary.apply_delta(
+                {"insert": [[i, 13 + i]]}, delta_id=f"acked{i}", ack="wal",
+            )
+        assert primary.wait_applied(60)
+        standby._shipper.poll_once()
+        # fully shipped: lag 0, watermark mirrored past the local store
+        assert standby.wal.last_seq == primary.wal.last_seq
+        assert standby.wal.applied_version > store_b.peek_version()
+        primary.stop()
+        with pytest.warns(UserWarning, match="rewinding the replay"):
+            out = standby.promote()
+        assert out["promoted"] and out["replayed"] == 2
+        assert standby.wait_applied(120)
+        edges = _edges(standby.engine)
+        assert (0, 13) in edges and (1, 14) in edges  # zero acked loss
+        warns = [r for r in sink.records if r["phase"] == "warning"]
+        assert any("rewinding the replay cursor" in r["message"]
+                   for r in warns)
+    finally:
+        standby.stop()
+        try:
+            primary.stop()
+        except Exception:  # noqa: BLE001 — already stopped
+            pass
+    assert validate_records(sink.records) == []
+
+
+# ---- THE acceptance chaos test --------------------------------------------
+
+
+def _fast_config(**overrides):
+    kv = dict(
+        probe_interval_s=0.08,
+        probe_timeout_s=4.0,
+        read_timeout_s=0.4,
+        down_after_probes=2,
+        reload_cadence_s=0.1,
+        rejoin_timeout_s=15.0,
+        breaker_backoff_base_s=0.3,
+        breaker_backoff_max_s=1.0,
+        retry_after_s=1.0,
+        default_deadline_ms=5000,
+        promote_timeout_s=120.0,
+    )
+    kv.update(overrides)
+    return FleetConfig(**kv)
+
+
+def test_writer_failover_chaos_acceptance(tmp_path):
+    """ISSUE 10 acceptance: a 2-writer/3-replica fleet under a live
+    read + write hammer. SIGKILL the primary mid-burst → the standby is
+    promoted within the bound, EVERY 202-acknowledged delta is present
+    in the final served snapshot (zero acknowledged loss), readers see
+    ZERO mixed-version responses throughout, and the deposed writer's
+    comeback publish is fenced with a loud ``publish_fenced`` record."""
+    sink = _sink()
+    store, src, dst, v = _publish_base(tmp_path)
+    wal_p = str(tmp_path / "wal-r0")
+    w0 = SnapshotServer(store, sink=sink, wal=wal_p)
+    h0, p0 = w0.start()
+    w1 = SnapshotServer(
+        store, sink=sink, wal=str(tmp_path / "wal-r1"),
+        standby_of=f"http://{h0}:{p0}", primary_wal=wal_p,
+        ship_interval_s=0.05,
+    )
+    h1, p1 = w1.start()
+    w2 = SnapshotServer(store)
+    h2, p2 = w2.start()
+    router = FleetRouter(
+        [ReplicaSpec("r0", h0, p0), ReplicaSpec("r1", h1, p1),
+         ReplicaSpec("r2", h2, p2)],
+        writer="r0", standby="r1", sink=sink, config=_fast_config(),
+    )
+    rh, rp = router.start()
+
+    hammer_errors: list = []
+    acked: dict = {}           # delta_id -> (src, dst)
+    acked_lock = threading.Lock()
+    stop_writes = threading.Event()
+    stop_reads = threading.Event()
+    rng = np.random.default_rng(29)
+    write_pairs = [
+        (int(rng.integers(0, v)), int(rng.integers(0, v)))
+        for _ in range(200)
+    ]
+
+    ok_reads = [0]
+
+    def read_hammer(tid):
+        seen = []
+        while not stop_reads.is_set():
+            try:
+                code, body, headers = _post(
+                    rh, rp, "/query", {"vertices": [0, 13, 27]},
+                    timeout=30,
+                )
+                if code == 503:
+                    # unavailable-CONSISTENT, by design: under the write
+                    # burst the committed version churns faster than the
+                    # prober converges, and the router refuses rather
+                    # than mixing versions. A real client obeys
+                    # Retry-After; a WRONG answer is what fails the test.
+                    time.sleep(0.05)
+                    continue
+                if code != 200:
+                    raise AssertionError(f"read failed: HTTP {code} {body}")
+                if body["version"] != int(headers["X-Pinned-Version"]):
+                    raise AssertionError(
+                        f"MIXED VERSION: body v{body['version']} != pin "
+                        f"{headers['X-Pinned-Version']}"
+                    )
+                seen.append(body["version"])
+            except Exception as e:  # noqa: BLE001 — collect, assert later
+                hammer_errors.append(e)
+                return
+            time.sleep(0.01)
+        if seen != sorted(seen):
+            hammer_errors.append(
+                AssertionError(f"reader {tid} saw versions go backwards")
+            )
+        ok_reads[0] += len(seen)
+
+    def write_hammer(tid):
+        i = 0
+        while not stop_writes.is_set():
+            delta_id = f"wh{tid}-{i}"
+            pair = write_pairs[(tid * 97 + i) % len(write_pairs)]
+            i += 1
+            try:
+                code, body, _ = _post(
+                    rh, rp, "/delta", {"insert": [list(pair)]},
+                    headers={
+                        "X-Delta-Id": delta_id, "X-Delta-Ack": "wal",
+                    },
+                    timeout=30,
+                )
+            except Exception:  # noqa: BLE001 — router mid-failover
+                continue
+            if code in (200, 202):
+                # acknowledged: MUST survive everything below
+                with acked_lock:
+                    acked[delta_id] = pair
+            time.sleep(0.005)
+
+    readers = [
+        threading.Thread(target=read_hammer, args=(i,)) for i in range(2)
+    ]
+    writers = [
+        threading.Thread(target=write_hammer, args=(i,)) for i in range(2)
+    ]
+    try:
+        deadline = time.monotonic() + 10
+        while (
+            router.replica_set.committed_version() is None
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        for t in readers + writers:
+            t.start()
+        time.sleep(0.8)  # a real burst is in flight, some applied
+
+        # SIGKILL the primary MID-BURST
+        t_kill = time.monotonic()
+        faults.writer_kill_mid_apply(w0)
+
+        # the fleet promotes the standby within the bound
+        bound_s = 20.0
+        while time.monotonic() - t_kill < bound_s:
+            rs = router.replica_set
+            if rs.writer_id == "r1" and not rs.read_only:
+                break
+            time.sleep(0.05)
+        time_to_writable = time.monotonic() - t_kill
+        assert router.replica_set.writer_id == "r1", (
+            f"standby not promoted within {bound_s}s: "
+            f"{router.replica_set.snapshot()}"
+        )
+        assert time_to_writable < bound_s
+
+        # keep hammering the promoted writer, then settle
+        time.sleep(0.8)
+        stop_writes.set()
+        for t in writers:
+            t.join(timeout=30)
+        assert w1.wait_applied(300)
+        stop_reads.set()
+        for t in readers:
+            t.join(timeout=30)
+
+        # ZERO read failures / mixed versions (503s were retried — the
+        # consistency choice, not a failure; served answers must exist)
+        assert hammer_errors == [], hammer_errors[:3]
+        assert ok_reads[0] > 20
+
+        # ZERO acknowledged-delta loss: every 202'd batch is in the
+        # final snapshot (count multiplicity so duplicates would show)
+        eng = w1.engine
+        counts: dict = {}
+        for s, d in zip(
+            np.asarray(eng.snapshot["src"]).tolist(),
+            np.asarray(eng.snapshot["dst"]).tolist(),
+        ):
+            counts[(s, d)] = counts.get((s, d), 0) + 1
+        with acked_lock:
+            assert acked, "the burst never acknowledged anything"
+            lost = [
+                (did, pair) for did, pair in acked.items()
+                if counts.get(pair, 0) < 1
+            ]
+        assert lost == [], f"{len(lost)} acknowledged deltas lost: {lost[:5]}"
+
+        # the deposed writer's comeback publish is fenced, loudly —
+        # either this very apply hits the store fence (first fenced
+        # attempt raises), or a prior background apply already did and
+        # the writer latched deposed, refusing at the front door (503)
+        # before it can acknowledge into a black hole
+        try:
+            out = w0.apply_delta(
+                {"insert": [[0, 13]]}, delta_id="deposed-comeback",
+            )
+        except PublishFencedError:
+            pass
+        else:
+            assert out["verdict"] == "shed" and "fenced" in out["reason"], out
+        fenced = [r for r in sink.records if r["phase"] == "publish_fenced"]
+        assert fenced, "no publish_fenced record from the deposed writer"
+
+        # the promotion trail is complete and loud
+        promotes = [
+            r for r in sink.records if r["phase"] == "writer_promote"
+        ]
+        assert any(r.get("replica") == "r1" for r in promotes)
+        flips = [r for r in sink.records if r["phase"] == "fleet_degraded"]
+        assert any(r["read_only"] for r in flips)          # loss was loud
+        assert flips[-1]["read_only"] is False             # and bounded
+        # post-promotion, writes flow through the router to r1
+        code, body, headers = _post(rh, rp, "/delta", {"insert": [[0, 20]]})
+        assert code == 200 and headers["X-Fleet-Replica"] == "r1"
+    finally:
+        stop_writes.set()
+        stop_reads.set()
+        router.stop()
+        for s in (w0, w1, w2):
+            try:
+                s.stop()
+            except Exception:  # noqa: BLE001 — killed replicas
+                pass
+    assert validate_records(sink.records) == []
+
+
+# ---- review hardening: contiguous floor / compaction guard / fence lock ---
+
+
+def test_wal_contiguous_floor_never_jumps_an_unresolved_gap(tmp_path):
+    """The commit watermark is a CONTIGUOUS floor: publishing seq 2
+    while acked seq 1 is still unapplied (the append-vs-enqueue race
+    window) must not advance the floor past 1 — a crash in that window
+    would make restart replay skip the acknowledged entry (silent
+    loss). The published-over-a-gap seq persists in ``applied_above``
+    so the crash can't double-apply it either."""
+    root = str(tmp_path / "wal")
+    w = WriteAheadLog(root)
+    for i in range(3):
+        w.append({"insert": [[i, i + 1]]}, delta_id=f"d{i}")
+    w.commit_applied([2], snapshot_version=5)
+    assert w.applied_seq == 0                      # floor held below the gap
+    assert w.seq_applied(2) and not w.seq_applied(1)
+    assert [e["seq"] for e in w.pending()] == [1, 3]
+    w.close()
+    # the parked seq survives a crash: replay still excludes it
+    w2 = WriteAheadLog(root)
+    assert w2.applied_seq == 0 and w2.seq_applied(2)
+    assert [e["seq"] for e in w2.pending()] == [1, 3]
+    # resolving the gap lets the floor sweep through the parked seq
+    w2.commit_applied([1], snapshot_version=6)
+    assert w2.applied_seq == 2 and w2.applied_version == 6
+    assert [e["seq"] for e in w2.pending()] == [3]
+    assert w2.commit_history()[-1] == (2, 6)
+    w2.commit_applied([3], snapshot_version=7)
+    assert w2.applied_seq == 3
+    # tombstones are non-work: the floor passes the shed target AND the
+    # tombstone record itself
+    w2.append({"insert": [[7, 8]]}, delta_id="shed-me")       # seq 4
+    w2.append({"insert": [[8, 9]]}, delta_id="applies")       # seq 5
+    w2.skip(4)                                                # seq 6
+    w2.commit_applied([5], snapshot_version=8)
+    assert w2.applied_seq == 6 and w2.pending() == []
+    w2.close()
+
+
+def test_publish_over_inflight_gap_replays_exactly_once(tmp_path):
+    """Server-level pin for the race: an acked WAL entry that never
+    reached the apply queue (writer died post-fsync, pre-enqueue) must
+    replay on restart even though a LATER seq already published — and
+    the published one must not replay (the manifest's
+    ``wal_applied_above`` voucher)."""
+    sink = _sink()
+    store, src, dst, v = _publish_base(tmp_path, sink=sink)
+    wal_dir = str(tmp_path / "wal")
+    server = SnapshotServer(store, sink=sink, wal=wal_dir)
+    base_edges = len(np.asarray(server.engine.snapshot["src"]))
+    # seq 1: acked (fsync'd) but never enqueued — the crash window
+    seq, dup = server.wal.append({"insert": [[0, 13]]}, delta_id="inflight")
+    assert seq == 1 and not dup
+    # seq 2: a normal delta that applies and publishes over the gap
+    out = server.apply_delta({"insert": [[0, 14]]}, delta_id="applies")
+    assert out["version"] > 0
+    assert server.wal.applied_seq == 0          # floor held below seq 1
+    assert server.wal.seq_applied(2)
+    faults.writer_kill_mid_apply(server)
+    # restart: replay applies ONLY seq 1 — seq 2 is vouched applied
+    sink2 = _sink()
+    server2 = SnapshotServer(store, sink=sink2, wal=wal_dir)
+    assert server2.wait_applied(120)
+    edges = _edges(server2.engine)
+    assert (0, 13) in edges and (0, 14) in edges
+    assert len(np.asarray(server2.engine.snapshot["src"])) == base_edges + 2
+    replays = [r for r in sink2.records if r["phase"] == "wal_replay"]
+    assert replays and replays[0]["entries"] == 1
+    assert server2.wal.applied_seq == server2.wal.last_seq
+    server2.stop()
+    assert validate_records(sink2.records) == []
+
+
+def test_standby_compaction_protects_its_own_store_version(tmp_path):
+    """A standby's WAL mirrors the PRIMARY's watermark — compacting
+    against it would prune entries this replica's own (possibly old)
+    bootstrap store has not absorbed, which a separate-store promotion
+    must replay. ``protect_version`` pins the prune floor to the seq
+    vouched for the LOCAL store version; no vouching pair = protect
+    everything."""
+    root = str(tmp_path / "wal")
+    w = WriteAheadLog(root, segment_max_bytes=64, retain_segments=1)
+    w.note_baseline(7)                      # local bootstrap store is v7
+    n = 12
+    for i in range(n):
+        w.append({"insert": [[i, i + 1]]}, delta_id=f"d{i}")
+    assert len(w.entries(1)) == n
+    # mirrored primary watermark says all shipped+applied...
+    w.protect_version = 7                   # ...but OUR store is still v7
+    w.merge_history([(n, 40)])
+    assert w.applied_seq == n
+    assert len(w.entries(1)) == n, "standby pruned entries its store lacks"
+    # an unvouched local version also protects everything
+    w.protect_version = 99
+    w.append({"insert": [[n, n + 1]]}, delta_id="more")
+    w.commit(n + 1, snapshot_version=41)
+    assert w.entries(1)[0]["seq"] == 1
+    # promotion clears the guard: normal retention applies again
+    w.protect_version = None
+    w.append({"insert": [[n + 1, n + 2]]}, delta_id="post")
+    w.commit(n + 2, snapshot_version=42)
+    assert w.entries(1)[0]["seq"] > 1, "cleared guard should allow pruning"
+    w.close()
+
+
+def test_fence_epoch_mid_publish_cannot_evict_promoted_generation(tmp_path):
+    """The fence re-check and the generation rotation hold the fence
+    lock together: a promotion landing while a deposed writer's publish
+    is between its array writes and its commit rename still fences it,
+    and the promoted writer's generation is never rotated away."""
+    store, src, dst, v = _publish_base(tmp_path)
+    arrays = {
+        "src": src, "dst": dst,
+        "labels": np.zeros(v, np.int32), "cc_labels": np.zeros(v, np.int32),
+        "lof": np.zeros(v, np.float32),
+    }
+    fenced_during_publish = threading.Event()
+
+    def promote_mid_publish():
+        store.fence_epoch(5, reason="test promotion")
+        store.publish(arrays, epoch=5)
+        fenced_during_publish.set()
+        return None                       # side-effect hook, no raise
+
+    inj = faults.FaultInjector()
+    inj.add("snapshot_publish_commit", promote_mid_publish, at=1, repeat=1)
+    with inj.installed():
+        with pytest.raises(PublishFencedError):
+            store.publish(arrays, epoch=0)
+    assert fenced_during_publish.is_set()
+    # the promoted writer's generation survived the deposed commit
+    snap = store.load()
+    assert snap.writer_epoch == 5
+    assert store.current_epoch() == 5
+
+
+def test_unknown_delta_ack_mode_is_refused(tmp_path):
+    """An unknown ``X-Delta-Ack`` must 400, not silently downgrade to
+    the blocking path (the client believes it asked for the fast
+    durable 202 and would block to its full deadline instead)."""
+    store, *_ = _publish_base(tmp_path)
+    server = SnapshotServer(store, wal=str(tmp_path / "wal"))
+    host, port = server.start()
+    try:
+        code, body, _ = _post(
+            host, port, "/delta", {"insert": [[0, 13]]},
+            headers={"X-Delta-Ack": "fsync"},
+        )
+        assert code == 400
+        assert "X-Delta-Ack" in body["error"]
+        # the canonical mode still answers 202 at the durability point
+        code, body, _ = _post(
+            host, port, "/delta", {"insert": [[0, 13]]},
+            headers={"X-Delta-Ack": "wal", "X-Delta-Id": "ok-1"},
+        )
+        assert code == 202 and body["verdict"] == "accepted"
+    finally:
+        server.stop()
+
+
+def test_wal_pending_gauge_counts_only_above_floor(tmp_path):
+    """The pending-entries gauge must count acked-but-unpublished work
+    exactly: once the contiguous floor advances past a tombstoned pair,
+    those seqs may not keep subtracting (the all-time skipped set would
+    make the gauge read 0 while a durable acknowledged delta still
+    awaits apply — the exact backlog signal /healthz promises)."""
+    w = WriteAheadLog(str(tmp_path / "wal"))
+    s1, _ = w.append({"insert": [[0, 1]]}, delta_id="a")
+    w.commit_applied([s1], 2)
+    s2, _ = w.append({"insert": [[0, 2]]}, delta_id="b")
+    w.skip(s2)  # shed off the queue: tombstone record takes seq 3
+    s4, _ = w.append({"insert": [[0, 3]]}, delta_id="c")
+    w.commit_applied([s4], 3)  # floor walks over the tombstoned pair
+    assert w.applied_seq == s4
+    assert w.snapshot()["pending_entries"] == 0
+    s5, _ = w.append({"insert": [[0, 4]]}, delta_id="d")
+    snap = w.snapshot()
+    assert snap["pending_entries"] == 1, snap
+    assert [e["seq"] for e in w.pending()] == [s5]
+    # a tombstoned-but-not-yet-passed seq DOES subtract: shed seq 6 via
+    # tombstone seq 7 while s5 still blocks the floor below them
+    s6, _ = w.append({"insert": [[0, 5]]}, delta_id="e")
+    w.skip(s6)
+    assert w.snapshot()["pending_entries"] == 1
+    w.close()
+
+
+def test_fenced_writer_refuses_new_writes(tmp_path):
+    """A deposed-but-alive writer whose publish came back fenced must
+    stop answering 202 for NEW deltas: its publishes refuse forever and
+    the promoted writer never tails a zombie's WAL, so each further
+    acceptance would acknowledge work into a black hole. Reads keep
+    serving; /healthz says why."""
+    sink = _sink()
+    store, *_ = _publish_base(tmp_path, sink=sink)
+    server = SnapshotServer(store, sink=sink, wal=str(tmp_path / "wal"))
+    try:
+        # a rival promotion fences the store's epoch past this writer
+        SnapshotStore(store.root).advance_epoch(reason="rival promotion")
+        out = server.apply_delta(
+            {"insert": [[0, 13]]}, delta_id="doomed", ack="wal",
+        )
+        # accepted before the fence is discovered (the WAL entry stays
+        # durable; a later re-promotion of this process replays it)
+        assert out["verdict"] == "accepted"
+        server.wait_applied(60)  # the background publish hits the fence
+        deadline = time.monotonic() + 30
+        while server._fenced is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert server._fenced is not None
+        refused = server.apply_delta(
+            {"insert": [[0, 14]]}, delta_id="late", ack="wal",
+        )
+        assert refused["verdict"] == "shed"
+        assert "fenced" in refused["reason"]
+        hz = server.healthz()
+        assert hz["ok"] and "fenced" in hz
+        assert any(r["phase"] == "publish_fenced" for r in sink.records)
+        # reads still serve from the last good snapshot
+        assert server.engine.version >= 1
+        # /promote re-fences in OUR favor and reopens the write path
+        res = server.promote()
+        assert res["promoted"] and server._fenced is None
+        ok = server.apply_delta({"insert": [[0, 15]]}, delta_id="after")
+        assert ok.get("verdict") != "shed" and "version" in ok, ok
+        assert "fenced" not in server.healthz()
+    finally:
+        server.stop()
+    assert validate_records(sink.records) == []

@@ -9,18 +9,26 @@ tree::
     python tools/fleet_cli.py up --store /data/snap --replicas 3 \
         --port 8400 --metrics-out /data/fleet_metrics.jsonl
 
-    python tools/fleet_cli.py status --url http://127.0.0.1:8400
-    python tools/fleet_cli.py roll   --url http://127.0.0.1:8400
+    python tools/fleet_cli.py status  --url http://127.0.0.1:8400
+    python tools/fleet_cli.py roll    --url http://127.0.0.1:8400
+    python tools/fleet_cli.py promote --url http://127.0.0.1:8400
 
 ``up`` spawns N replica *processes* (``serve_cli.py serve``, each its
 own port off ``--replica-base-port``) over ONE shared snapshot store,
 waits for each to answer ``/healthz``, and runs the router in the
 foreground until interrupted — replica 0 is the designated writer
-(single-publisher contract; writer loss = read-only fleet, never
-split-brain). ``status`` prints the router's ``/fleetz`` (per-replica
-state/version/breaker, committed version, read-only verdict); ``roll``
-triggers the zero-downtime rolling reload (drain → /reload → re-probe →
-rejoin, one replica at a time, writer last) after an external publish.
+(single-publisher contract). With ``--standby`` (needs >= 2 replicas)
+the writer runs WAL-durable (``serve --wal``) and replica 1 runs as its
+log-shipped standby (``--standby-of`` + ``--primary-wal``); writer loss
+then auto-promotes the standby behind the store's epoch fence instead
+of leaving the fleet read-only (docs/SERVING.md "Replicated writers").
+Without ``--standby``, writer loss = read-only fleet, never
+split-brain, as before. ``status`` prints the router's ``/fleetz``
+(per-replica state/version/breaker, committed version, writer/standby/
+epoch, read-only verdict); ``roll`` triggers the zero-downtime rolling
+reload (drain → /reload → re-probe → rejoin, one replica at a time,
+writer last) after an external publish; ``promote`` forces the
+standby-to-writer failover manually (RUNBOOKS §10).
 
 Clients talk to the router exactly like a single server —
 ``serve_cli.py query/delta --url http://host:PORT`` gets the
@@ -73,9 +81,18 @@ def cmd_up(args) -> int:
     # processes leak past the router's death.
     signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
 
+    if args.standby and args.replicas < 2:
+        print("fleet_cli: --standby needs at least 2 replicas",
+              file=sys.stderr)
+        return 2
+
     procs: list = []
     router = None
     serve_cli = f"{_REPO}/tools/serve_cli.py"
+
+    def wal_dir(i: int) -> str:
+        return f"{args.store.rstrip('/')}/wal-replica-{i}"
+
     try:
         for i in range(args.replicas):
             port = args.replica_base_port + i
@@ -84,6 +101,15 @@ def cmd_up(args) -> int:
                 "--store", args.store, "--host", args.host,
                 "--port", str(port),
             ]
+            if args.standby and i == 0:
+                cmd += ["--wal", wal_dir(0)]
+            elif args.standby and i == 1:
+                cmd += [
+                    "--wal", wal_dir(1),
+                    "--standby-of",
+                    f"http://{args.host}:{args.replica_base_port}",
+                    "--primary-wal", wal_dir(0),
+                ]
             if args.metrics_out:
                 cmd += ["--metrics-out", f"{args.metrics_out}.replica{i}"]
             procs.append(subprocess.Popen(cmd))
@@ -107,11 +133,15 @@ def cmd_up(args) -> int:
         router = FleetRouter(
             specs, writer="replica-0", host=args.host, port=args.port,
             sink=sink,
+            standby="replica-1" if args.standby else None,
         )
         host, port = router.start()
         print(
             f"fleet: {args.replicas} replica(s) behind http://{host}:{port} "
-            f"(writer replica-0 on port {args.replica_base_port})",
+            f"(writer replica-0 on port {args.replica_base_port}"
+            + (", standby replica-1 log-shipping its WAL"
+               if args.standby else "")
+            + ")",
             file=sys.stderr,
         )
         while True:
@@ -142,9 +172,9 @@ def cmd_status(args) -> int:
     return 0
 
 
-def cmd_roll(args) -> int:
+def _post_router(args, path: str) -> int:
     req = urllib.request.Request(
-        f"{args.url.rstrip('/')}/roll", data=b"{}", method="POST",
+        f"{args.url.rstrip('/')}{path}", data=b"{}", method="POST",
         headers={"Content-Type": "application/json"},
     )
     try:
@@ -158,6 +188,14 @@ def cmd_roll(args) -> int:
         return 2
     print(json.dumps(out, indent=1))
     return 0 if out.get("ok") else 1
+
+
+def cmd_roll(args) -> int:
+    return _post_router(args, "/roll")
+
+
+def cmd_promote(args) -> int:
+    return _post_router(args, "/promote")
 
 
 def main(argv=None) -> int:
@@ -176,6 +214,11 @@ def main(argv=None) -> int:
                    help="router records here; replica i appends to "
                         "PATH.replicaI")
     p.add_argument("--startup-timeout-s", type=float, default=60.0)
+    p.add_argument("--standby", action="store_true",
+                   help="run replica-0 WAL-durable and replica-1 as its "
+                        "log-shipped standby; writer loss auto-promotes "
+                        "behind the store's epoch fence instead of going "
+                        "read-only")
     p.set_defaults(fn=cmd_up)
 
     p = sub.add_parser("status", help="print the router's /fleetz")
@@ -186,6 +229,15 @@ def main(argv=None) -> int:
     p.add_argument("--url", required=True, help="router base URL")
     p.add_argument("--timeout-s", type=float, default=300.0)
     p.set_defaults(fn=cmd_roll)
+
+    p = sub.add_parser(
+        "promote",
+        help="force the standby-to-writer failover (RUNBOOKS §10: read "
+             "the promotion timeline before forcing writes)",
+    )
+    p.add_argument("--url", required=True, help="router base URL")
+    p.add_argument("--timeout-s", type=float, default=300.0)
+    p.set_defaults(fn=cmd_promote)
 
     args = ap.parse_args(argv)
     return args.fn(args)

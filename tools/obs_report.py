@@ -31,7 +31,12 @@ dir):
   the circuit-breaker timeline, fleet-degraded (read-only) flips, and
   the route-verdict mix — which replica states and breaker episodes
   explain the 503s a reader saw (RUNBOOKS §9 keys its triage off this
-  view).
+  view);
+- the **writer failover** section (r11): the WAL append/replay
+  aggregate, ship-lag episodes, every ``writer_promote`` step and every
+  ``publish_fenced`` refusal, in causal order — the promotion timeline
+  RUNBOOKS §10 says to read before forcing writes on a read-only
+  fleet.
 
 Usage::
 
@@ -218,6 +223,9 @@ _DETAIL_KEYS = {
     "repair_fallback": ("stage", "reason"),
     "breaker_transition": ("replica", "from_state", "to_state"),
     "fleet_degraded": ("read_only", "writer"),
+    "wal_replay": ("entries", "from_seq", "source"),
+    "writer_promote": ("epoch", "replica", "replayed"),
+    "publish_fenced": ("attempted_epoch", "store_epoch"),
 }
 
 _SERVING_PHASES = ("snapshot_publish", "snapshot_load", "delta_apply",
@@ -456,6 +464,76 @@ def _fleet_section(records, t0):
     return out
 
 
+def _failover_section(records, t0):
+    """Writer-failover timeline (r11, docs/SERVING.md "Replicated
+    writers"): the WAL durability aggregate, ship-lag episodes, every
+    promotion step and every fenced publish — RUNBOOKS §10's "read the
+    promotion timeline before forcing writes" view. Empty list = no
+    durable-write-path records in the stream."""
+    appends = [r for r in records if r.get("phase") == "wal_append"]
+    replays = [r for r in records if r.get("phase") == "wal_replay"]
+    lags = [r for r in records if r.get("phase") == "ship_lag"]
+    promotes = [r for r in records if r.get("phase") == "writer_promote"]
+    fenced = [r for r in records if r.get("phase") == "publish_fenced"]
+    if not (appends or replays or lags or promotes or fenced):
+        return []
+    out = []
+    if appends:
+        secs = sorted(float(r.get("seconds", 0.0)) for r in appends)
+        rows = sum(int(r.get("rows", 0)) for r in appends)
+        total = sum(int(r.get("bytes", 0)) for r in appends)
+        out.append(
+            f"  wal appends: {len(appends)} entries, {rows} rows, "
+            f"{total:,} B; fsync p50 "
+            f"{_percentile(secs, 0.50) * 1e3:.2f}ms / p99 "
+            f"{_percentile(secs, 0.99) * 1e3:.2f}ms"
+        )
+    for r in replays:
+        if r.get("torn_tail"):
+            out.append(
+                f"  {_fmt_offset(r, t0)}  WAL TORN TAIL  truncated at "
+                f"{r.get('truncated_to', '?')} B  [{r['torn_tail']}]"
+            )
+            continue
+        out.append(
+            f"  {_fmt_offset(r, t0)}  wal_replay  "
+            f"{r.get('entries', '?')} entr(ies) "
+            f"seq {r.get('from_seq', '?')}..{r.get('to_seq', '?')}  "
+            f"source={r.get('source', '?')}"
+        )
+    if lags:
+        worst = max(lags, key=lambda r: float(r.get("lag_s", 0.0) or 0.0))
+        out.append(
+            f"  ship lag: {len(lags)} behind-sample(s); worst "
+            f"{worst.get('lag_entries', '?')} entries / "
+            f"{worst.get('lag_s', '?')}s behind "
+            f"(primary seq {worst.get('primary_last_seq', '?')}, "
+            f"shipped {worst.get('shipped_seq', '?')})"
+        )
+    for r in promotes:
+        bits = [f"epoch {r.get('epoch', '?')}"]
+        if r.get("replica"):
+            bits.append(f"writer={r['replica']}")
+        if r.get("deposed"):
+            bits.append(f"deposed={r['deposed']}")
+        if r.get("replayed") is not None:
+            bits.append(f"replayed={r['replayed']}")
+        if r.get("copied_tail") is not None:
+            bits.append(f"copied_tail={r['copied_tail']}")
+        if r.get("seconds") is not None:
+            bits.append(f"{r['seconds']}s")
+        out.append(
+            f"  {_fmt_offset(r, t0)}  WRITER PROMOTE  {'  '.join(bits)}"
+        )
+    for r in fenced:
+        out.append(
+            f"  {_fmt_offset(r, t0)}  PUBLISH FENCED  attempted epoch "
+            f"{r.get('attempted_epoch', '?')} < store epoch "
+            f"{r.get('store_epoch', '?')}  [{r.get('reason', '')}]"
+        )
+    return out
+
+
 def _recovery_timeline(records, t0):
     events = [r for r in records if r.get("phase") in RECOVERY_PHASES]
     if not events:
@@ -575,6 +653,11 @@ def build_report(records, source: str = "", bad_lines: int = 0) -> str:
         lines.append("")
         lines.append("-- fleet (replica health / breakers / routing) --")
         lines.extend(fleet)
+    failover = _failover_section(records, t0)
+    if failover:
+        lines.append("")
+        lines.append("-- writer failover (WAL / promotion / fencing) --")
+        lines.extend(failover)
     lines.append("")
     lines.append("-- recovery timeline --")
     lines.extend(_recovery_timeline(records, t0))

@@ -7,7 +7,7 @@
 # (-m faults: tests/test_resilience.py + the tripwire/reshard cases in
 # tests/test_sharded.py) is part of this default pass.
 #
-# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only|--slo-only|--blocking-only|--admission-only|--fleet-only] [extra pytest args...]
+# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only|--slo-only|--blocking-only|--admission-only|--fleet-only|--wal-only] [extra pytest args...]
 #   --faults-only  run just the `faults`-marked recovery suite — the fast
 #                  pre-commit loop when iterating on resilience paths
 #   --obs-only     run just the `obs`-marked tracing/telemetry suite
@@ -46,6 +46,13 @@
 #                  serve_cli client retries, and the 3-replica
 #                  kill+slow+roll chaos acceptance test) — the fast
 #                  slice when iterating on serve/fleet.py
+#   --wal-only     run just the `wal`-marked durable-write-path suite
+#                  (tests/test_wal.py: WAL framing/torn-tail/rotation/
+#                  compaction, epoch fencing, 202 + kill/restart replay,
+#                  duplicate-submit idempotency, log-shipped standby +
+#                  lag, fenced promotion, and the writer-SIGKILL chaos
+#                  acceptance test) — the fast slice when iterating on
+#                  serve/wal.py
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -74,6 +81,9 @@ elif [ "${1:-}" = "--admission-only" ]; then
 elif [ "${1:-}" = "--fleet-only" ]; then
     shift
     MARKER='fleet and not slow'
+elif [ "${1:-}" = "--wal-only" ]; then
+    shift
+    MARKER='wal and not slow'
 fi
 
 LOG="${TIER1_LOG:-/tmp/_t1.log}"

@@ -32,6 +32,15 @@ work the client has stopped waiting for::
     python tools/serve_cli.py delta --url http://127.0.0.1:8400 \
         --insert 10,11 --deadline-ms 5000 --max-retries 4
     python tools/serve_cli.py query --url http://127.0.0.1:8400 --vertex 12 44
+
+**Durable writes** (r11, docs/SERVING.md "Replicated writers"):
+``serve --wal DIR`` makes accepted deltas WAL-durable before the
+acknowledgement (replayed on restart); ``serve --wal DIR --standby-of
+URL [--primary-wal DIR]`` runs the log-shipped standby. ``delta --url``
+always sends ONE ``X-Delta-Id`` idempotency key reused across retries
+(a retry after a lost response dedupes server-side, never
+double-applies), and ``--ack-wal`` asks for the 202-at-durability
+acknowledgement instead of blocking to the publish.
 """
 
 from __future__ import annotations
@@ -58,6 +67,7 @@ def request_with_retries(
     timeout_s: float = 30.0,
     sleep=time.sleep,
     rng: random.Random | None = None,
+    headers: dict | None = None,
 ) -> dict:
     """One HTTP exchange (POST ``payload``, or GET when ``payload`` is
     None) with bounded client-side resilience.
@@ -74,6 +84,13 @@ def request_with_retries(
     end-to-end): the server sheds a batch still queued past the budget,
     and the client stops retrying when the budget is gone.
 
+    ``headers`` ride EVERY attempt verbatim — ``cmd_delta`` passes one
+    ``X-Delta-Id`` idempotency key generated once per logical request,
+    so a retry after a lost acknowledgement (the 202/200 never arrived)
+    dedupes server-side in the WAL instead of double-applying
+    (docs/SERVING.md "Replicated writers"; pinned by
+    tests/test_wal.py).
+
     Returns ``{"status", "body", "headers", "attempts"}``; transport
     failures with no retries left return ``status: 0`` with the error
     under ``body["error"]``.
@@ -89,9 +106,10 @@ def request_with_retries(
         if deadline_ms is not None else None
     )
     attempt = 0
+    base_headers = dict(headers or {})
     while True:
         attempt += 1
-        headers = {"Content-Type": "application/json"}
+        headers = {"Content-Type": "application/json", **base_headers}
         attempt_timeout = timeout_s
         if deadline is not None:
             remaining_ms = int((deadline - time.monotonic()) * 1000)
@@ -249,16 +267,25 @@ def cmd_delta(args) -> int:
             "delete": [list(p) for p in pairs(args.delete)],
         }
     if args.url:
+        # ONE idempotency key per logical request, riding every retry:
+        # a resend after a lost acknowledgement dedupes in the server's
+        # WAL instead of double-applying (a WAL-less server ignores it).
+        delta_id = args.delta_id or f"cli-{os.getpid()}-{os.urandom(6).hex()}"
+        headers = {"X-Delta-Id": delta_id}
+        if args.ack_wal:
+            headers["X-Delta-Ack"] = "wal"
         out = request_with_retries(
             f"{args.url.rstrip('/')}/delta", payload,
             deadline_ms=args.deadline_ms,
             max_retries=args.max_retries,
+            headers=headers,
         )
         print(json.dumps({
             "status": out["status"], "attempts": out["attempts"],
+            "delta_id": delta_id,
             **out["body"],
         }))
-        return 0 if out["status"] == 200 else 1
+        return 0 if out["status"] in (200, 202) else 1
     # in-process path: the ingest machinery (device repair code,
     # compiles) loads only here — --url mode stays HTTP + the host-side
     # retry policy
@@ -297,10 +324,19 @@ def cmd_serve(args) -> int:
         _store(args), host=args.host, port=args.port, sink=sink,
         prom_out=args.prom_out, num_shards=args.num_shards,
         slow_request_s=args.slow_request_s,
+        wal=args.wal, standby_of=args.standby_of,
+        primary_wal=args.primary_wal,
     )
     host, port = server.start()
-    print(f"serving snapshot v{server.engine.version} on http://{host}:{port}",
-          file=sys.stderr)
+    role = (
+        f"standby of {args.standby_of}" if args.standby_of
+        else ("writer (WAL-durable)" if args.wal else "writer")
+    )
+    print(
+        f"serving snapshot v{server.engine.version} on "
+        f"http://{host}:{port} [{role}, epoch {server.writer_epoch}]",
+        file=sys.stderr,
+    )
     try:
         while True:
             time.sleep(3600)
@@ -363,6 +399,13 @@ def main(argv=None) -> int:
     p.add_argument("--file", default=None,
                    help='JSON file {"insert": [[s,d],...], "delete": [...]}')
     p.add_argument("--num-shards", type=int, default=1)
+    p.add_argument("--delta-id", default=None,
+                   help="idempotency key (X-Delta-Id) for --url mode; "
+                        "default: one generated key reused across retries")
+    p.add_argument("--ack-wal", action="store_true",
+                   help="--url mode: ask for the WAL-durable 202 "
+                        "acknowledgement instead of blocking to publish "
+                        "(X-Delta-Ack: wal; needs a server with --wal)")
     p.set_defaults(fn=cmd_delta)
 
     p = sub.add_parser(
@@ -382,6 +425,22 @@ def main(argv=None) -> int:
     p.add_argument("--slow-request-s", type=float, default=1.0,
                    help="requests slower than this log their body digest "
                         "in the access_log record")
+    p.add_argument("--wal", default=None, metavar="DIR",
+                   help="write-ahead delta log directory: accepted "
+                        "batches fsync here before acknowledgement, "
+                        "replay on restart, and GET /wal serves the "
+                        "log-shipping feed (docs/SERVING.md 'Replicated "
+                        "writers')")
+    p.add_argument("--standby-of", default=None, metavar="URL",
+                   help="run as the log-shipped standby of the writer at "
+                        "URL: refuse client writes, tail its /wal into "
+                        "--wal, expose replication lag on /healthz, and "
+                        "take over on POST /promote")
+    p.add_argument("--primary-wal", default=None, metavar="DIR",
+                   help="the primary's WAL directory (shared-storage "
+                        "deployments): promotion copies the un-shipped "
+                        "tail straight from it, so a writer kill loses "
+                        "nothing")
     p.set_defaults(fn=cmd_serve)
 
     args = ap.parse_args(argv)
