@@ -41,6 +41,26 @@ if _CPU_FALLBACK:
     ITERS = 5
 
 
+def _bench_run_identity() -> tuple[str, str]:
+    """One (run_id, trace_id) pair per bench invocation, inherited by
+    measurement children via the environment (ISSUE 11 satellite): every
+    printed record — and the BENCH_*.json header built from the suite
+    summary — carries the same identity, so a bench run joins the
+    obs_report/trace_stitch timeline of any serving/pipeline JSONL
+    captured in the same window (the silicon-capture backlog's
+    log-correlation ask)."""
+    rid = os.environ.get("GRAPHMINE_BENCH_RUN_ID")
+    tid = os.environ.get("GRAPHMINE_BENCH_TRACE_ID")
+    if not rid or not tid:
+        from graphmine_tpu.obs.spans import _new_id, new_run_id
+
+        rid = rid or new_run_id()
+        tid = tid or _new_id(8)
+        os.environ["GRAPHMINE_BENCH_RUN_ID"] = rid
+        os.environ["GRAPHMINE_BENCH_TRACE_ID"] = tid
+    return rid, tid
+
+
 def powerlaw_edges(v: int, e: int, seed: int = 0):
     """Preferential-attachment-flavored endpoints: degree skew comparable to
     web graphs (the bundled data's hub pattern, BASELINE.md)."""
@@ -1187,7 +1207,11 @@ def main_serve() -> None:
         from graphmine_tpu.obs.spans import Tracer
         from graphmine_tpu.pipeline.metrics import MetricsSink
 
-        sink = MetricsSink(tracer=Tracer())
+        # the orchestrator's run identity (env) so this tier's records
+        # join the same obs timeline as the printed bench records
+        sink = MetricsSink(tracer=Tracer(
+            run_id=os.environ.get("GRAPHMINE_BENCH_RUN_ID")
+        ))
         ing = DeltaIngestor(store, sink=sink, lof_k=16, check_samples=64)
         ing.apply(EdgeDelta.from_pairs(insert=[(0, 1)]))  # LOF bootstrap
         ladder = []
@@ -2290,6 +2314,9 @@ def _run_backend_audit(timeout_s=300.0):
 
 
 def _print_record(record):
+    rid, tid = _bench_run_identity()
+    record.setdefault("run_id", rid)
+    record.setdefault("trace_id", tid)
     print(json.dumps(record), flush=True)
 
 
@@ -2370,12 +2397,17 @@ def _suite_summary(suite, platform, tpu_info, trace):
         probes["first"] = probe_digest(trace[0])
         if len(trace) > 1:
             probes["last"] = probe_digest(trace[-1])
+    rid, tid = _bench_run_identity()
     return {
         "metric": headline.get("metric"),
         "value": headline.get("value"),
         "unit": headline.get("unit"),
         "vs_baseline": headline.get("vs_baseline"),
         "suite": {
+            # the BENCH_*.json header identity: joins this capture to
+            # any obs JSONL recorded in the same window
+            "run_id": rid,
+            "trace_id": tid,
             "tiers": tiers,
             "platform": platform or "unreachable",
             "tpu_probe": (tpu_info or "")[:90] or None,
@@ -2390,6 +2422,11 @@ def orchestrate(tier):
     fallback on a dead tunnel. Returns 0 if at least one real measurement
     record was printed."""
     all_mode = tier == "all"
+    # Mint the run identity BEFORE any child spawns: children inherit
+    # GRAPHMINE_BENCH_RUN_ID/TRACE_ID through the environment, so the
+    # records a tier prints (and any MetricsSink a tier builds) carry
+    # the same ids this orchestrator stamps on the suite summary.
+    _bench_run_identity()
     if all_mode:
         # Healthy-TPU tiers are minutes each (persistent compile cache);
         # the budget covers the realistic sum, not the worst-case child

@@ -21,13 +21,21 @@ builds on it, not the other way around):
 
 from graphmine_tpu.obs.histogram import Histogram, HistogramFamily
 from graphmine_tpu.obs.registry import Registry
-from graphmine_tpu.obs.spans import Span, Tracer, new_run_id
+from graphmine_tpu.obs.spans import (
+    TRACE_HEADER,
+    Span,
+    TraceContext,
+    Tracer,
+    new_run_id,
+)
 
 __all__ = [
     "Histogram",
     "HistogramFamily",
     "Registry",
     "Span",
+    "TRACE_HEADER",
+    "TraceContext",
     "Tracer",
     "new_run_id",
 ]

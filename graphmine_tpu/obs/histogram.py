@@ -83,6 +83,17 @@ class HistogramSnapshot:
             out.append(acc)
         return out
 
+    def summary(self) -> dict:
+        """The ``{count, p50_s, p99_s}`` block the /statusz latency
+        sections serve — one formula, so the quantile set and rounding
+        can't drift between pages. Callers with extra fields (error
+        rates, p95) spread this and add theirs."""
+        return {
+            "count": self.count,
+            "p50_s": round(self.quantile(0.50), 6),
+            "p99_s": round(self.quantile(0.99), 6),
+        }
+
     def quantile(self, q: float) -> float:
         """``histogram_quantile``-style estimate: find the bucket the
         rank lands in, interpolate linearly inside it (uniform-within-

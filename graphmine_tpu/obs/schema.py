@@ -132,6 +132,18 @@ register("writer_promote", "epoch")
 register("publish_fenced", "attempted_epoch", "store_epoch", "reason")
 register("ship_lag", "lag_entries", "lag_s")
 
+# ---- cross-process tracing / time-to-visible SLO (docs/OBSERVABILITY.md
+# "Fleet tracing") ---------------------------------------------------------
+# delta_stages: one per accepted delta batch at publish time, emitted in
+# the BATCH's own trace (the propagated traceparent context) — the
+# writer-side causal chain: admission accept -> WAL fsync -> queued ->
+# apply -> snapshot publish, each stage in seconds; delta_visible: one
+# per (delta, replica) from the fleet router when a replica first serves
+# the version that absorbed the delta — the read-side tail of
+# time-to-visible, feeding the router's merged histogram.
+register("delta_stages", "version", "stages")
+register("delta_visible", "replica", "version", "seconds")
+
 # ---- recovery / resilience records (docs/RESILIENCE.md) -------------------
 register("retry", "stage", "attempt", "backoff_s", "error")
 register("retries_exhausted", "stage", "attempts", "error")

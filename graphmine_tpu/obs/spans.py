@@ -14,6 +14,16 @@ Timings are **monotonic** (``time.perf_counter``) — span durations never
 go negative under NTP steps; the wall-clock ``start_t`` exists only so
 offline reports can align spans with record ``t`` values.
 
+Cross-process propagation (ISSUE 11, docs/OBSERVABILITY.md "Fleet
+tracing"): a :class:`TraceContext` is the wire form of one span's
+identity — ``to_header()`` renders a ``traceparent``-style header, the
+receiving process parses it with :func:`TraceContext.from_header` and
+opens its spans with ``remote=ctx``, adopting the sender's ``trace_id``
+and parenting under the sender's span. Every record the receiver emits
+then lands in the SAME trace, so ``tools/trace_stitch.py`` can join the
+per-process JSONL shards of a fleet (router → replicas → writer →
+standby) into one causal timeline with no id-mapping table.
+
 Stdlib-only. :func:`xla_annotation` opportunistically enters a
 ``jax.profiler.TraceAnnotation`` named by the span path — but only when
 jax is *already imported*, so host-side tooling that never touches a
@@ -23,6 +33,7 @@ device pays nothing.
 from __future__ import annotations
 
 import contextlib
+import re
 import secrets
 import sys
 import threading
@@ -38,6 +49,69 @@ def new_run_id() -> str:
 
 def _new_id(nbytes: int = 4) -> str:
     return secrets.token_hex(nbytes)
+
+
+# The header every fleet hop carries (router -> replica, router ->
+# writer, probe). traceparent-STYLE: version-trace_id-span_id-flags,
+# with this repo's id widths (16-hex trace, 8-hex span) instead of
+# W3C's fixed 32/16 — zero-padding to W3C widths and stripping it back
+# is a round-trip hazard a single-format fleet doesn't need.
+TRACE_HEADER = "traceparent"
+
+# Parsed ids are echoed into response headers and stamped into records:
+# constrain them so a hostile header can't smuggle newlines/quotes
+# (the serve/server.py request-id discipline).
+_HEX_ID_RE = re.compile(r"[0-9a-f]{8,64}")
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """One span's identity on the wire: what a process needs to open a
+    child span of a span living in ANOTHER process."""
+
+    trace_id: str
+    span_id: str
+    sampled: bool = True
+
+    def to_header(self) -> str:
+        """``00-<trace_id>-<span_id>-<01|00>``."""
+        return (
+            f"00-{self.trace_id}-{self.span_id}-"
+            f"{'01' if self.sampled else '00'}"
+        )
+
+    @classmethod
+    def from_header(cls, value) -> "TraceContext | None":
+        """Parse a propagated header; ``None`` on anything malformed —
+        an unparseable traceparent must degrade to a fresh local trace,
+        never crash a request handler."""
+        if not isinstance(value, str) or not value:
+            return None
+        parts = value.strip().lower().split("-")
+        if len(parts) != 4:
+            return None
+        version, trace_id, span_id, flags = parts
+        if not re.fullmatch(r"[0-9a-f]{2}", version):
+            return None
+        if not _HEX_ID_RE.fullmatch(trace_id):
+            return None
+        if not _HEX_ID_RE.fullmatch(span_id):
+            return None
+        if len(flags) != 2:
+            return None
+        return cls(trace_id, span_id, sampled=flags[-1] == "1")
+
+
+def sink_trace_header(sink) -> str:
+    """The calling thread's current span of ``sink``'s tracer, rendered
+    as a propagatable ``traceparent`` header — "" when the sink has no
+    tracer (tracing off). The one place the sink→header formula lives;
+    every fleet process (router forwards, replica WAL stamps, probes)
+    propagates through here so the wire format can never fork."""
+    tracer = getattr(sink, "tracer", None)
+    if tracer is None:
+        return ""
+    return tracer.current().context().to_header()
 
 
 @dataclass
@@ -62,6 +136,11 @@ class Span:
         """Monotonic duration; an open span reports its age so far."""
         end = self.end_mono if self.end_mono is not None else time.perf_counter()
         return end - self.start_mono
+
+    def context(self) -> TraceContext:
+        """This span's wire identity — what :meth:`to_header` of the
+        result propagates to the next process."""
+        return TraceContext(self.trace_id, self.span_id)
 
 
 class Tracer:
@@ -107,14 +186,44 @@ class Tracer:
             return self._latest
 
     @contextlib.contextmanager
-    def span(self, name: str, **attrs):
+    def span(
+        self, name: str, remote: TraceContext | None = None,
+        new_trace: bool = False, **attrs,
+    ):
         """Open a child span of the current one for the ``with`` block.
         An escaping exception marks ``status="error"`` (and propagates);
-        the span always closes with a monotonic end time."""
+        the span always closes with a monotonic end time.
+
+        Cross-process identity (docs/OBSERVABILITY.md "Fleet tracing"):
+
+        - ``remote=ctx`` parents the span under a span living in
+          ANOTHER process — it adopts ``ctx.trace_id`` and sets
+          ``parent_id`` to the remote span's id, so every record emitted
+          inside lands in the propagating process's trace. The path
+          restarts at ``name`` (the local path chain belongs to the
+          local tree, not the remote one).
+        - ``new_trace=True`` mints a fresh ``trace_id`` for the span's
+          subtree — the fleet router's root-span-per-request, so each
+          request is its OWN trace instead of one run-wide trace.
+
+        Nested spans inherit their parent's ``trace_id`` (not the
+        tracer's), so a whole subtree opened under a remote/new-trace
+        span stays in that trace.
+        """
+        if remote is not None and new_trace:
+            raise ValueError("span(): remote= and new_trace= are exclusive")
         parent = self.current()
+        if remote is not None:
+            trace_id, parent_id, path = remote.trace_id, remote.span_id, name
+        elif new_trace:
+            trace_id, parent_id, path = _new_id(8), None, name
+        else:
+            trace_id = parent.trace_id
+            parent_id = parent.span_id
+            path = f"{parent.path}/{name}"
         sp = Span(
-            name=name, trace_id=self.trace_id, span_id=_new_id(),
-            parent_id=parent.span_id, path=f"{parent.path}/{name}",
+            name=name, trace_id=trace_id, span_id=_new_id(),
+            parent_id=parent_id, path=path,
             start_t=time.time(), start_mono=time.perf_counter(),
             attrs=dict(attrs),
         )

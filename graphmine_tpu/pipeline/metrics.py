@@ -90,7 +90,12 @@ class MetricsSink:
         if tr is not None:
             sp = _span if _span is not None else tr.current()
             rec["run_id"] = tr.run_id
-            rec["trace_id"] = tr.trace_id
+            # The SPAN's trace id, not the tracer's: a span opened with
+            # remote=/new_trace= (cross-process propagation, fleet
+            # requests) carries an adopted/minted trace, and records
+            # emitted inside it must land in THAT trace or the stitched
+            # fleet timeline falls apart at every process boundary.
+            rec["trace_id"] = sp.trace_id
             rec["span_id"] = sp.span_id
             rec["span_path"] = sp.path
             if _span is not None and sp.parent_id is not None:
@@ -164,20 +169,27 @@ class MetricsSink:
         self.emit(phase, seconds=round(time.perf_counter() - t0, 4), **kv)
 
     @contextlib.contextmanager
-    def span(self, name: str, emit: bool = True, annotate: bool = True, **attrs):
+    def span(self, name: str, emit: bool = True, annotate: bool = True,
+             remote=None, new_trace: bool = False, **attrs):
         """Open a tracer span for the block (no-op yielding None without
         a tracer). ``emit``: write a ``span`` record at close (the phase
         waterfall's raw material) — superstep spans pass False so a long
         run is not doubled by per-superstep span records (``lpa_iter``
         already carries the superstep span's identity). ``annotate``:
         also enter a ``jax.profiler.TraceAnnotation`` named by the span
-        path, so XLA profiler traces line up with the span tree."""
+        path, so XLA profiler traces line up with the span tree.
+        ``remote``/``new_trace`` pass through to
+        :meth:`~graphmine_tpu.obs.spans.Tracer.span` — adopt a
+        propagated :class:`~graphmine_tpu.obs.spans.TraceContext`, or
+        mint a per-request trace (the fleet router's root span)."""
         if self.tracer is None:
             yield None
             return
         sp = None
         try:
-            with self.tracer.span(name, **attrs) as sp:
+            with self.tracer.span(
+                name, remote=remote, new_trace=new_trace, **attrs
+            ) as sp:
                 if annotate:
                     with xla_annotation(sp.path):
                         yield sp
@@ -267,6 +279,34 @@ class MetricsSink:
             edges_per_sec=round(eps),
             edges_per_sec_per_chip=round(eps / max(chips, 1)),
         )
+
+
+def shard_sink(
+    obs_dir: str,
+    role: str,
+    run_id: str | None = None,
+    max_records: int | None = None,
+) -> MetricsSink:
+    """One process's slice of the federated metrics plane (ISSUE 11,
+    docs/OBSERVABILITY.md "Fleet tracing"): a streaming sink whose JSONL
+    lands at ``<obs_dir>/<role>-<pid>.jsonl``. Every fleet process
+    (router, replicas, writer, standby, chaos driver) pointed at one
+    ``--obs-dir`` leaves a shard there; ``tools/trace_stitch.py`` joins
+    the directory into per-trace cross-process timelines — no log
+    aggregator required, the filesystem is the collector."""
+    from graphmine_tpu.obs.spans import Tracer
+
+    os.makedirs(obs_dir, exist_ok=True)
+    safe_role = "".join(
+        c if c.isalnum() or c in "-_" else "-" for c in role
+    ) or "proc"
+    return MetricsSink(
+        stream_path=os.path.join(
+            obs_dir, f"{safe_role}-{os.getpid()}.jsonl"
+        ),
+        tracer=Tracer(run_id=run_id),
+        max_records=max_records,
+    )
 
 
 @contextlib.contextmanager

@@ -77,6 +77,7 @@ device.)
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import random
@@ -89,7 +90,13 @@ from urllib import error as urlerror
 from urllib import request as urlrequest
 from urllib.parse import urlparse
 
+from graphmine_tpu.obs.histogram import Histogram
 from graphmine_tpu.obs.registry import Registry
+from graphmine_tpu.obs.spans import (
+    TRACE_HEADER,
+    TraceContext,
+    sink_trace_header,
+)
 from graphmine_tpu.pipeline.resilience import ResilienceConfig, backoff_s
 
 # Replica states (the per-replica machine the prober drives).
@@ -246,6 +253,7 @@ class CircuitBreaker:
         self._state = BREAKER_CLOSED
         self._opens = 0            # consecutive open episodes (backoff attempt)
         self._open_until = 0.0
+        self._last_reason = ""     # why the last transition fired
         self._rng = random.Random(f"breaker:{replica_id}:{os.getpid()}")
 
     @property
@@ -332,7 +340,11 @@ class CircuitBreaker:
         self._fire(fired)
 
     def _fire(self, transition) -> None:
-        if transition is not None and self.on_transition is not None:
+        if transition is None:
+            return
+        with self._lock:
+            self._last_reason = transition[2]
+        if self.on_transition is not None:
             self.on_transition(*transition)
 
     def snapshot(self) -> dict:
@@ -343,6 +355,7 @@ class CircuitBreaker:
                 "window": len(self._outcomes),
                 "failures_in_window": failures,
                 "open_episodes": self._opens,
+                "last_transition_reason": self._last_reason,
                 "reopen_in_s": round(max(0.0, self._open_until - self._clock()), 3)
                 if self._state == BREAKER_OPEN else 0.0,
             }
@@ -356,6 +369,7 @@ class _Replica:
         self.breaker = breaker
         self.state = JOINING
         self.state_since = time.monotonic()
+        self.state_reason = ""         # why the last transition fired
         self.version: int | None = None
         self.last_health: dict = {}
         self.probe_failures = 0
@@ -478,6 +492,7 @@ class ReplicaSet:
                 return
             from_state, rep.state = rep.state, to_state
             rep.state_since = time.monotonic()
+            rep.state_reason = reason
         self._emit(
             "replica_health", replica=rep.spec.id, from_state=from_state,
             to_state=to_state, reason=reason, version=rep.version,
@@ -714,6 +729,7 @@ class ReplicaSet:
                     "host": r.spec.host,
                     "port": r.spec.port,
                     "state": r.state,
+                    "state_reason": r.state_reason,
                     "version": r.version,
                     "writer": r.spec.id == self.writer_id,
                     "standby": r.spec.id == self.standby_id,
@@ -741,6 +757,7 @@ _PROXY_GET = ("/vertex", "/neighbors", "/topk", "/snapshot")
 _GET_ROUTES = {
     "/healthz": "_ep_healthz",
     "/fleetz": "_ep_fleetz",
+    "/statusz": "_ep_statusz",
     "/metrics": "_ep_metrics",
     **{p: "_ep_read" for p in _PROXY_GET},
 }
@@ -786,6 +803,16 @@ class FleetRouter:
         self._stop = threading.Event()
         self._roll_lock = threading.Lock()
         self._promote_lock = threading.Lock()
+        # Per-delta time-to-visible tracking (ISSUE 11): version ->
+        # {t0, trace, seen replicas, wall-clock created}. A forwarded
+        # /delta that published version v starts an entry; the prober
+        # marks each replica visible the first time it reports >= v,
+        # observing graphmine_fleet_time_to_visible_seconds{replica=..}
+        # and emitting a delta_visible record in the DELTA's trace.
+        self._vis_lock = threading.Lock()
+        self._visibility: dict = {}
+        self._vis_max = 256            # bounded: old entries expire
+        self._vis_expire_s = 600.0
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> tuple[str, int]:
@@ -838,13 +865,29 @@ class FleetRouter:
         )
         if body is not None:
             req.add_header("Content-Type", "application/json")
-        for name, value in (headers or {}).items():
+        headers = dict(headers or {})
+        # Trace propagation on EVERY replica exchange — data-plane
+        # reads, writer forwards, probes, reloads, promotions: the
+        # replica adopts this header and its records land in the same
+        # trace (the per-request root span for client traffic, the
+        # router's run trace for prober housekeeping).
+        if TRACE_HEADER not in headers:
+            th = self._trace_header()
+            if th:
+                headers[TRACE_HEADER] = th
+        for name, value in headers.items():
             req.add_header(name, value)
         try:
             with urlrequest.urlopen(req, timeout=timeout) as resp:
                 return resp.status, resp.read(), dict(resp.headers)
         except urlerror.HTTPError as e:
             return e.code, e.read(), dict(e.headers)
+
+    def _trace_header(self) -> str:
+        """The calling thread's current span as a propagatable header
+        ("" without a sink/tracer) — inside the request middleware this
+        is the per-request root span."""
+        return sink_trace_header(self.sink)
 
     def _probe_replica(self, rep: _Replica, timeout: float) -> dict | None:
         try:
@@ -942,6 +985,7 @@ class FleetRouter:
                         name=f"graphmine-fleet-reload-{rep.spec.id}",
                         daemon=True,
                     ).start()
+        self._check_visibility()
         rs.update_read_only()
         # Fenced failover (r11): a read-only fleet with a live standby
         # promotes it instead of staying degraded. Fire-and-forget like
@@ -969,6 +1013,105 @@ class FleetRouter:
             pass
         finally:
             rep.reload_inflight = False
+
+    # -- per-delta time-to-visible (ISSUE 11 SLO) --------------------------
+    def _track_visibility(self, version: int, t0: float, trace: str) -> None:
+        """Start tracking a just-published version: each replica's
+        first probe at >= version closes its leg of the SLO."""
+        with self._vis_lock:
+            if version in self._visibility:
+                return
+            self._visibility[version] = {
+                "t0": t0,
+                "trace": trace,
+                "seen": set(),
+                "created": time.monotonic(),
+            }
+            if len(self._visibility) > self._vis_max:
+                for v in sorted(self._visibility)[: -self._vis_max]:
+                    self._visibility.pop(v, None)
+
+    def _mark_visible(
+        self, version: int, entry: dict, replica_id: str, now: float,
+    ) -> None:
+        seconds = max(0.0, now - entry["t0"])
+        self.registry.histogram(
+            "graphmine_fleet_time_to_visible_seconds",
+            "delta accept at the router to each replica serving the "
+            "version that absorbed it",
+            replica=replica_id,
+        ).observe(seconds)
+        if self.sink is None:
+            return
+        ctx = (
+            TraceContext.from_header(entry["trace"])
+            if entry["trace"] else None
+        )
+        span = (
+            self.sink.span(
+                "delta_visible", emit=False, annotate=False, remote=ctx,
+            )
+            if ctx is not None else contextlib.nullcontext()
+        )
+        with span:
+            self.sink.emit(
+                "delta_visible", replica=replica_id, version=int(version),
+                seconds=round(seconds, 6),
+            )
+
+    def _check_visibility(self) -> None:
+        """Prober-pass sweep: close the (delta, replica) legs whose
+        replica now serves the tracked version; expire stale entries
+        (a replica that died before catching up must not pin an entry
+        forever)."""
+        rs = self.replica_set
+        now = time.monotonic()
+        all_ids = {r.spec.id for r in rs.replicas()}
+        reps = [(r.spec.id, r.version, r.state) for r in rs.replicas()]
+        marks = []
+        # seen-set mutation stays under the lock (a test-driven
+        # probe_once racing the prober thread must not double-observe a
+        # leg); the sink emission happens after release — a record
+        # fsync must not serialize the sweep.
+        with self._vis_lock:
+            for version, entry in list(self._visibility.items()):
+                for rep_id, rep_version, rep_state in reps:
+                    if (
+                        rep_id in entry["seen"]
+                        or rep_version is None
+                        or rep_version < version
+                        or rep_state == DOWN
+                    ):
+                        continue
+                    entry["seen"].add(rep_id)
+                    marks.append((version, dict(entry), rep_id))
+                if (
+                    entry["seen"] >= all_ids
+                    or now - entry["created"] > self._vis_expire_s
+                ):
+                    self._visibility.pop(version, None)
+        for version, entry, rep_id in marks:
+            self._mark_visible(version, entry, rep_id, now)
+
+    def time_to_visible_merged(self) -> Histogram | None:
+        """All per-replica time-to-visible histograms folded counter-wise
+        (:meth:`~graphmine_tpu.obs.histogram.Histogram.merge` — the
+        mergeable-ladder rollup) into one fleet-level distribution; None
+        before the first observation."""
+        fam = self.registry.histogram_family(
+            "graphmine_fleet_time_to_visible_seconds"
+        )
+        if fam is None:
+            return None
+        merged = Histogram(
+            "graphmine_fleet_time_to_visible_merged_seconds",
+            "time-to-visible across all replicas (counter-wise merge of "
+            "the per-replica histograms)",
+            buckets=fam.bounds,
+        )
+        for child in fam.children():
+            merged.merge(child)
+        return merged
 
     # -- read routing ------------------------------------------------------
     def route_read(
@@ -1195,6 +1338,7 @@ class FleetRouter:
                      "X-Delta-Ack"):
             if headers.get(name):
                 fwd_headers[name] = headers[name]
+        t0 = time.monotonic()
         try:
             status, resp_body, resp_headers = self._replica_call(
                 writer, "POST", path_qs, body=body or b"{}",
@@ -1207,6 +1351,40 @@ class FleetRouter:
                 reason=repr(e),
             )
             return self._shed(f"writer {rs.writer_id} unreachable: {e!r}")
+        if endpoint == "delta" and status == 200:
+            # A synchronous apply published a version: start the
+            # time-to-visible clock. The writer serves it already (the
+            # 200 means the swap happened), so its leg closes here; the
+            # prober closes each remaining replica's leg as it catches
+            # up. (202 WAL-acks carry no version yet — their visibility
+            # is bounded by the same publish this tracking catches when
+            # the coalesced group lands via a later sync apply or the
+            # reload cadence.)
+            try:
+                version = json.loads(resp_body.decode()).get("version")
+            except (ValueError, UnicodeDecodeError):
+                version = None
+            if isinstance(version, int):
+                self._track_visibility(
+                    version, t0, self._trace_header()
+                )
+                with self._vis_lock:
+                    entry = self._visibility.get(version)
+                    # A prober sweep racing between _track_visibility
+                    # and here may have closed the writer leg already —
+                    # membership is the double-observe guard.
+                    if (
+                        entry is not None
+                        and writer.spec.id not in entry["seen"]
+                    ):
+                        entry["seen"].add(writer.spec.id)
+                        entry = dict(entry)
+                    else:
+                        entry = None
+                if entry is not None:
+                    self._mark_visible(
+                        version, entry, writer.spec.id, time.monotonic()
+                    )
         self._emit_route(
             endpoint, "forwarded", 1, rs.committed_version(),
             replica=writer.spec.id, status=status,
@@ -1329,10 +1507,70 @@ class FleetRouter:
         return {**self.replica_set.snapshot(),
                 "config": self.config.snapshot()}
 
+    def statusz(self) -> dict:
+        """The fleet SLO page, gap-filled in one place (ISSUE 11
+        satellite): WAL state + settled ship lag, the current writer
+        epoch, per-replica state/breaker with LAST TRANSITION REASONS,
+        and the time-to-visible quantiles (per replica + fleet-merged) —
+        previously split across the writer's /statusz and the router's
+        /fleetz snapshot."""
+        rs = self.replica_set
+        fleet = rs.snapshot()
+        writer = rs.replica(rs.writer_id)
+        epoch = rs.writer_epoch
+        if epoch is None:
+            epoch = writer.last_health.get("writer_epoch")
+        ttv: dict = {}
+        fam = self.registry.histogram_family(
+            "graphmine_fleet_time_to_visible_seconds"
+        )
+        if fam is not None:
+            for child in fam.children():
+                s = child.snapshot()
+                if not s.count:
+                    continue
+                ttv[child.labels.get("replica", "?")] = s.summary()
+        merged = self.time_to_visible_merged()
+        if merged is not None and merged.count:
+            ttv["merged"] = merged.snapshot().summary()
+        out = {
+            "role": "router",
+            "committed_version": fleet["committed_version"],
+            "writer": rs.writer_id,
+            "standby": rs.standby_id,
+            "writer_epoch": epoch,
+            "read_only": fleet["read_only"],
+            "replicas": fleet["replicas"],
+            "time_to_visible": ttv,
+            # The writer's durable-write state as last probed: the WAL
+            # snapshot (pending/applied seqs) is the "settled ship lag"
+            # numerator the standby's replication lag pairs with.
+            "wal": writer.last_health.get("wal"),
+        }
+        if rs.standby_id is not None:
+            sb = rs.replica(rs.standby_id).last_health
+            out["replication"] = {
+                "lag_entries": sb.get("replication_lag_entries"),
+                "lag_s": sb.get("replication_lag_s"),
+            }
+        return out
+
     def metrics_text(self) -> str:
         tracer = getattr(self.sink, "tracer", None)
         labels = {"run_id": tracer.run_id} if tracer is not None else None
-        return self.registry.render_textfile(labels=labels)
+        text = self.registry.render_textfile(labels=labels)
+        merged = self.time_to_visible_merged()
+        if merged is not None and merged.count:
+            # the fleet-merged rollup rides the same scrape: one
+            # counter-wise Histogram.merge of the per-replica children,
+            # exposed as its own metric name (one name, one meaning)
+            lines = [
+                f"# HELP {merged.name} {merged.help}",
+                f"# TYPE {merged.name} histogram",
+                *merged.render_lines(extra_labels=labels),
+            ]
+            text += "\n".join(lines) + "\n"
+        return text
 
     def observe(self, endpoint: str, seconds: float, status: int) -> None:
         reg = self.registry
@@ -1381,23 +1619,42 @@ class _FleetHandler(BaseHTTPRequestHandler):
         handler = routes.get(url.path)
         endpoint = url.path.lstrip("/") if handler else "unknown"
         self._status = 500
-        t0 = time.perf_counter()
-        try:
-            if handler is None:
-                self._reply_json(404, {"error": f"unknown path {url.path!r}"})
-            else:
-                getattr(self, handler)(url)
-        except OSError:
-            self._status = 499  # client closed; nothing more to send
-        except Exception as e:  # noqa: BLE001 — the router must answer
-            try:
-                self._reply_json(500, {"error": repr(e)})
-            except OSError:
-                self._status = 499
-        finally:
-            self.rtr.observe(
-                endpoint, time.perf_counter() - t0, self._status
+        # Root span per request (ISSUE 11 fleet tracing): each request
+        # through the router is its OWN trace — minted fresh, or adopted
+        # from a client that already propagates traceparent. Every
+        # replica call inside forwards the header (_replica_call), so
+        # the whole fleet's handling of this request stitches into one
+        # cross-process timeline.
+        sink = self.rtr.sink
+        span = contextlib.nullcontext()
+        if sink is not None and getattr(sink, "tracer", None) is not None:
+            ctx = TraceContext.from_header(
+                self.headers.get(TRACE_HEADER, "")
             )
+            span = sink.span(
+                f"fleet:{endpoint}", emit=False, annotate=False,
+                remote=ctx, new_trace=ctx is None,
+            )
+        t0 = time.perf_counter()
+        with span:
+            try:
+                if handler is None:
+                    self._reply_json(
+                        404, {"error": f"unknown path {url.path!r}"}
+                    )
+                else:
+                    getattr(self, handler)(url)
+            except OSError:
+                self._status = 499  # client closed; nothing more to send
+            except Exception as e:  # noqa: BLE001 — the router must answer
+                try:
+                    self._reply_json(500, {"error": repr(e)})
+                except OSError:
+                    self._status = 499
+            finally:
+                self.rtr.observe(
+                    endpoint, time.perf_counter() - t0, self._status
+                )
 
     def do_GET(self) -> None:  # noqa: N802
         self._serve("GET", _GET_ROUTES)
@@ -1411,6 +1668,9 @@ class _FleetHandler(BaseHTTPRequestHandler):
 
     def _ep_fleetz(self, url) -> None:
         self._reply_json(200, self.rtr.fleetz())
+
+    def _ep_statusz(self, url) -> None:
+        self._reply_json(200, self.rtr.statusz())
 
     def _ep_metrics(self, url) -> None:
         self._send(

@@ -42,6 +42,11 @@ Endpoints (JSON unless noted):
 ``POST /drain``        flip readiness off (``ready: false``) — take the
                        replica out of rotation without killing it
 ``POST /undrain``      restore readiness
+``POST /profilez``     guarded on-demand XLA profiler capture
+                       (``{"duration_ms": N}``): 403 unless the server
+                       was started with a capture dir, 501 when
+                       jax/profiler is unavailable; the trace dir is
+                       tagged with the requesting trace_id
 ====================  =====================================================
 
 **Fleet integration** (r10, serve/fleet.py): read endpoints honor an
@@ -95,6 +100,7 @@ replay the WAL tail, resume writes.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import math
@@ -111,6 +117,11 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from graphmine_tpu.obs.registry import Registry
+from graphmine_tpu.obs.spans import (
+    TRACE_HEADER,
+    TraceContext,
+    sink_trace_header,
+)
 from graphmine_tpu.serve.admission import (
     AdmissionController,
     coalesce_deltas,
@@ -152,6 +163,7 @@ _POST_ROUTES = {
     "/drain": "_ep_drain",
     "/undrain": "_ep_undrain",
     "/promote": "_ep_promote",
+    "/profilez": "_ep_profilez",
 }
 
 
@@ -178,7 +190,8 @@ class _PendingDelta:
 
     __slots__ = ("delta", "rows", "deadline", "deadline_s", "status",
                  "result", "error", "event", "shed_reason", "seq",
-                 "delta_id", "async_ack")
+                 "delta_id", "async_ack", "trace", "t_accept",
+                 "t_durable")
 
     def __init__(
         self, delta: EdgeDelta, rows: int, deadline: float,
@@ -193,6 +206,16 @@ class _PendingDelta:
         self.error: BaseException | None = None
         self.event = threading.Event()
         self.shed_reason = ""
+        # Trace identity + causal-chain stamps (ISSUE 11 time-to-visible
+        # SLO): `trace` is the accepting request's propagated traceparent
+        # header (WAL-durable, so it survives kill/replay and log
+        # shipping); t_accept/t_durable are monotonic marks of the
+        # admission verdict and the WAL fsync — the apply worker turns
+        # them into the per-stage breakdown (`delta_stages` record +
+        # graphmine_serve_delta_stage_seconds histograms).
+        self.trace = ""
+        self.t_accept = time.monotonic()
+        self.t_durable: float | None = None
         # WAL identity (serve/wal.py): seq is the batch's durable log
         # position (None = no WAL on this server), delta_id the client's
         # idempotency key. async_ack batches were answered 202 at append
@@ -223,6 +246,7 @@ class SnapshotServer:
         standby_of: str | None = None,
         primary_wal: str | None = None,
         ship_interval_s: float = 0.2,
+        profilez_dir: str | None = None,
     ):
         self.store = store
         self.sink = sink
@@ -357,6 +381,14 @@ class SnapshotServer:
         self._inflight = 0
         self._req_lock = threading.Lock()
         self._endpoint_errors: dict = {}
+        # On-demand device profiling (POST /profilez): disabled unless a
+        # capture directory is configured — an open profiler endpoint on
+        # a serving replica would let any client burn device time and
+        # disk. One capture at a time (the profiler is process-global).
+        self.profilez_dir = profilez_dir or os.environ.get(
+            "GRAPHMINE_PROFILEZ_DIR"
+        )
+        self._profilez_lock = threading.Lock()
         self._export_metrics()
         # Startup replay: accepted-but-unapplied WAL entries re-enqueue
         # through the admission path (replay never sheds — the work was
@@ -475,6 +507,14 @@ class SnapshotServer:
             # may release its WAL retention up to that version's floor
             self.wal.protect_version = engine.version
         self._export_metrics()
+
+    def _current_trace_header(self) -> str:
+        """The emitting thread's current span as a propagatable header
+        ("" without a tracer). Inside the request middleware this is the
+        ADOPTED span of an inherited traceparent, so a delta's WAL entry
+        and worker-side records stay in the originating request's
+        trace."""
+        return sink_trace_header(self.sink)
 
     def _run_labels(self) -> dict | None:
         """The run_id label BOTH exposition paths attach — the textfile
@@ -640,10 +680,12 @@ class SnapshotServer:
         pending = _PendingDelta(delta, rows, 0.0, deadline_s)
         pending.delta_id = delta_id or ""
         pending.async_ack = ack == "wal"
+        pending.trace = self._current_trace_header()
         try:
             if self.wal is not None:
                 seq, dup = self.wal.append(
                     payload, delta_id=delta_id or "", deadline_s=deadline_s,
+                    trace=pending.trace,
                 )
                 if dup:
                     # the resolve still happened — one admission record
@@ -652,6 +694,7 @@ class SnapshotServer:
                     self.admission.emit_admission(decision, debt_at_resolve)
                     return self._duplicate_payload(delta_id or "", seq)
                 pending.seq = seq
+                pending.t_durable = time.monotonic()
         finally:
             enqueued = False
             with self._queue_cv:
@@ -819,6 +862,11 @@ class SnapshotServer:
                 p.seq = int(e["seq"])
                 p.delta_id = e.get("id", "")
                 p.async_ack = True
+                # replayed entries keep their originating request's
+                # trace: the durable header re-adopts across the kill
+                # (or across a promotion, via the shipped copy)
+                p.trace = e.get("trace", "")
+                p.t_durable = p.t_accept
                 self._queue.append(p)
                 self._queue_cv.notify_all()
             self.admission.emit_admission(decision, debt_at)
@@ -1141,8 +1189,32 @@ class SnapshotServer:
         version number from the store's manifest while silently
         DISCARDING the external snapshot's edges. Reload-in-place first
         (swap + drop the stale ingestor), then apply on top: the delta
-        rebases instead of clobbering."""
-        with self._delta_lock:
+        rebases instead of clobbering.
+
+        TRACE ADOPTION (ISSUE 11): the worker thread has no request
+        span, so without help the `delta_apply`/`snapshot_publish`
+        records it emits would land in the server's run trace instead of
+        the delta's. The whole apply runs under a span adopted from the
+        group LEADER's propagated context (the first batch with one),
+        and each batch additionally gets its own `delta_stages` record
+        in its OWN trace — so a coalesced group's non-leader batches
+        still stitch end-to-end."""
+        t_apply_start = time.monotonic()
+        leader_ctx = None
+        if self.sink is not None:
+            for p in group:
+                leader_ctx = TraceContext.from_header(p.trace)
+                if leader_ctx is not None:
+                    break
+        span = (
+            self.sink.span(
+                "delta_publish", emit=False, annotate=False,
+                remote=leader_ctx,
+            )
+            if self.sink is not None and leader_ctx is not None
+            else contextlib.nullcontext()
+        )
+        with span, self._delta_lock:
             newest = self.store.peek_version()
             if newest is not None and newest != self._engine.version:
                 fresh = self.store.load(sink=self.sink)
@@ -1232,6 +1304,7 @@ class SnapshotServer:
                 # contiguous resolved run (never past an acked entry
                 # still in flight toward the queue).
                 self.wal.commit_applied(seqs, snap.version)
+        self._emit_delta_stages(group, snap, t_apply_start)
         self.registry.counter(
             "graphmine_serve_deltas_total", "delta batches ingested"
         ).inc(len(group))
@@ -1242,6 +1315,150 @@ class SnapshotServer:
             "num_edges": int(len(snap["src"])),
             "coalesced": len(group),
             "lof_stale": bool(snap.meta.get("lof_stale", False)),
+        }
+
+    # -- per-delta time-to-visible stages ---------------------------------
+    def _emit_delta_stages(self, group: list, snap, t_apply_start: float):
+        """The writer-side causal chain of every batch this publish
+        absorbed: admission accept → WAL fsync → queued → apply →
+        published, observed into per-stage histograms
+        (``graphmine_serve_delta_stage_seconds{stage=...}``, the
+        ``/statusz`` breakdown) and emitted as one ``delta_stages``
+        record per batch IN THAT BATCH's trace — telemetry only, so a
+        failure here must never fail a publish that already landed."""
+        t_done = time.monotonic()
+        try:
+            for p in group:
+                stages = {}
+                if p.t_durable is not None:
+                    stages["wal_fsync_s"] = round(
+                        max(0.0, p.t_durable - p.t_accept), 6
+                    )
+                stages["queued_s"] = round(
+                    max(0.0, t_apply_start - (p.t_durable or p.t_accept)), 6
+                )
+                stages["apply_s"] = round(
+                    max(0.0, t_done - t_apply_start), 6
+                )
+                stages["total_s"] = round(
+                    max(0.0, t_done - p.t_accept), 6
+                )
+                for stage, seconds in stages.items():
+                    self.registry.histogram(
+                        "graphmine_serve_delta_stage_seconds",
+                        "per-stage delta latency: accept to queryable "
+                        "on this writer",
+                        stage=stage[:-2],  # wal_fsync_s -> wal_fsync
+                    ).observe(seconds)
+                if self.sink is None:
+                    continue
+                ctx = TraceContext.from_header(p.trace) if p.trace else None
+                span = (
+                    self.sink.span(
+                        "delta_stages", emit=False, annotate=False,
+                        remote=ctx,
+                    )
+                    if ctx is not None else contextlib.nullcontext()
+                )
+                with span:
+                    self.sink.emit(
+                        "delta_stages",
+                        version=snap.version,
+                        seq=p.seq,
+                        delta_id=p.delta_id,
+                        rows=p.rows,
+                        coalesced=len(group),
+                        stages=stages,
+                    )
+        except Exception:  # noqa: BLE001 — bookkeeping only
+            pass
+
+    def delta_stage_latency(self) -> dict:
+        """Per-stage p50/p99 of the delta causal chain — the
+        ``/statusz`` time-to-visible breakdown (the router adds the
+        read-side tail: each replica's reload-to-queryable)."""
+        fam = self.registry.histogram_family(
+            "graphmine_serve_delta_stage_seconds"
+        )
+        out: dict = {}
+        if fam is None:
+            return out
+        for child in fam.children():
+            s = child.snapshot()
+            if not s.count:
+                continue
+            out[child.labels.get("stage", "?")] = s.summary()
+        return out
+
+    # -- on-demand device profiling (POST /profilez) ----------------------
+    def profilez(self, duration_ms: int = 1000) -> tuple[int, dict]:
+        """Capture an XLA profiler trace from this live replica, tagged
+        with the requesting trace. Returns ``(http_status, body)``:
+        403 when no capture directory is configured (the guard — an
+        open profiler endpoint burns device time and disk for anyone
+        who can reach the port), 501 when jax / the profiler is
+        unavailable (CPU-only or jax-less deployments degrade, never
+        crash), 409 when a capture is already running (the profiler is
+        process-global), 200 with the trace directory otherwise."""
+        if not self.profilez_dir:
+            return 403, {
+                "error": "profilez disabled: start the server with "
+                "profilez_dir= (serve_cli --profilez-dir) to allow "
+                "on-demand captures",
+            }
+        duration_ms = max(1, min(int(duration_ms), 30_000))
+        trace_header = self._current_trace_header()
+        ctx = TraceContext.from_header(trace_header)
+        tag = ctx.trace_id if ctx is not None else secrets.token_hex(4)
+        out_dir = os.path.join(
+            self.profilez_dir, f"profile-{int(time.time())}-{tag}"
+        )
+        if not self._profilez_lock.acquire(blocking=False):
+            return 409, {"error": "a profile capture is already running"}
+        try:
+            try:
+                import jax
+
+                jax.profiler.start_trace(out_dir)
+            except Exception as e:  # noqa: BLE001 — no jax / no profiler
+                if self.sink is not None:
+                    self.sink.emit(
+                        "profile_capture", dir=out_dir, ok=False,
+                        error=repr(e),
+                    )
+                return 501, {
+                    "error": "jax profiler unavailable on this replica",
+                    "detail": repr(e),
+                }
+            try:
+                time.sleep(duration_ms / 1000.0)
+            finally:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception as e:  # noqa: BLE001 — trace incomplete
+                    if self.sink is not None:
+                        self.sink.emit(
+                            "profile_capture", dir=out_dir, ok=False,
+                            error=repr(e),
+                        )
+                    return 500, {
+                        "error": "profiler stop_trace failed; the trace "
+                        "directory may be incomplete",
+                        "dir": out_dir,
+                        "detail": repr(e),
+                    }
+        finally:
+            self._profilez_lock.release()
+        if self.sink is not None:
+            self.sink.emit(
+                "profile_capture", dir=out_dir, ok=True,
+                duration_ms=duration_ms,
+            )
+        return 200, {
+            "ok": True,
+            "dir": out_dir,
+            "duration_ms": duration_ms,
+            "trace_id": ctx.trace_id if ctx is not None else "",
         }
 
     # -- liveness vs readiness --------------------------------------------
@@ -1347,13 +1564,11 @@ class SnapshotServer:
                 continue
             err = errors.get(ep, 0)
             out[ep] = {
-                "count": snap.count,
+                **snap.summary(),
                 "errors": err,
                 "error_rate": round(err / snap.count, 4),
                 "mean_s": round(snap.sum / snap.count, 6),
-                "p50_s": round(snap.quantile(0.50), 6),
                 "p95_s": round(snap.quantile(0.95), 6),
-                "p99_s": round(snap.quantile(0.99), 6),
             }
         return out
 
@@ -1382,6 +1597,7 @@ class SnapshotServer:
                 "lof_stale": eng.lof_stale,
             },
             "writer_epoch": self.writer_epoch,
+            "delta_stages": self.delta_stage_latency(),
         }
         if self.wal is not None:
             payload["wal"] = self.wal.snapshot()
@@ -1561,30 +1777,46 @@ class _Handler(BaseHTTPRequestHandler):
         chaos = self.srv.chaos_delay_s
         if chaos > 0:
             time.sleep(chaos)  # replica_slow injector (testing/faults.py)
-        t0 = time.perf_counter()
-        try:
-            if handler is None:
-                self._error(404, f"unknown path {url.path!r}")
-            else:
-                getattr(self, handler)(url)
-        except (KeyError, ValueError, IndexError) as e:
-            try:
-                # KeyError.__str__ repr-quotes its message; unwrap it
-                self._error(400, str(e.args[0]) if e.args else str(e))
-            except OSError:
-                self._status = 499  # socket died while sending the 400
-        except OSError:
-            # The connection died under us (client disconnect mid-write):
-            # nothing more can be sent, but the SLO surface must not
-            # count an unreceived reply as a served 2xx — record 499
-            # (client closed request), the signal a tail of impatient
-            # clients actually leaves.
-            self._status = 499
-        finally:
-            self.srv.request_finished(
-                method, endpoint, self._status,
-                time.perf_counter() - t0, rid, body=self._raw_body,
+        # Inherited trace identity (docs/OBSERVABILITY.md "Fleet
+        # tracing"): a propagated traceparent header makes this whole
+        # request — access_log, admission, wal_append, query_batch,
+        # everything emitted on this thread — land in the SENDER's
+        # trace (the fleet router's per-request root span). No header,
+        # or a malformed one: records stay in this server's run trace,
+        # exactly as before.
+        ctx = TraceContext.from_header(self.headers.get(TRACE_HEADER, ""))
+        span = (
+            self.srv.sink.span(
+                f"http:{endpoint}", emit=False, annotate=False, remote=ctx,
             )
+            if ctx is not None and self.srv.sink is not None
+            else contextlib.nullcontext()
+        )
+        t0 = time.perf_counter()
+        with span:
+            try:
+                if handler is None:
+                    self._error(404, f"unknown path {url.path!r}")
+                else:
+                    getattr(self, handler)(url)
+            except (KeyError, ValueError, IndexError) as e:
+                try:
+                    # KeyError.__str__ repr-quotes its message; unwrap it
+                    self._error(400, str(e.args[0]) if e.args else str(e))
+                except OSError:
+                    self._status = 499  # socket died while sending the 400
+            except OSError:
+                # The connection died under us (client disconnect
+                # mid-write): nothing more can be sent, but the SLO
+                # surface must not count an unreceived reply as a served
+                # 2xx — record 499 (client closed request), the signal a
+                # tail of impatient clients actually leaves.
+                self._status = 499
+            finally:
+                self.srv.request_finished(
+                    method, endpoint, self._status,
+                    time.perf_counter() - t0, rid, body=self._raw_body,
+                )
 
     def do_GET(self) -> None:  # noqa: N802
         self._serve("GET", _GET_ROUTES)
@@ -1758,6 +1990,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _ep_promote(self, url) -> None:
         self._reply(200, self.srv.promote())
+
+    def _ep_profilez(self, url) -> None:
+        body = self._body()
+        try:
+            duration_ms = int(body.get("duration_ms", 1000))
+        except TypeError as e:  # JSON null/list/object: bad input, not 500
+            raise ValueError(f"duration_ms must be an integer: {e}") from e
+        status, payload = self.srv.profilez(duration_ms=duration_ms)
+        self._reply(status, payload)
 
     def _ep_reload(self, url) -> None:
         self._reply(200, self.srv.reload())

@@ -391,6 +391,7 @@ class WriteAheadLog:
         deadline_s: float | None = None,
         seq: int | None = None,
         t: float | None = None,
+        trace: str = "",
     ) -> tuple[int, bool]:
         """Durably append one accepted delta batch; returns
         ``(seq, duplicate)``.
@@ -406,6 +407,12 @@ class WriteAheadLog:
         both logs speak one sequence space. Client appends leave it
         None and take the next local seq. Returns only after the
         record's bytes and the segment file are fsync'd.
+
+        ``trace``: the accepting request's propagated trace header
+        (``obs/spans.py`` :class:`TraceContext` wire form) — carried in
+        the durable entry so the trace survives fsync → ship → standby
+        replay, and a promoted writer's apply of a shipped entry still
+        lands in the ORIGINATING request's trace.
         """
         t0 = time.perf_counter()
         with self._lock:
@@ -422,6 +429,8 @@ class WriteAheadLog:
                 "deadline_s": deadline_s,
                 "t": time.time() if t is None else float(t),
             }
+            if trace:
+                entry["trace"] = trace
             written = self._write_locked(entry)
             self._index(entry)
             self._refresh_snap_locked()
@@ -857,6 +866,7 @@ class WriteAheadLog:
                 deadline_s=entry.get("deadline_s"),
                 seq=int(entry["seq"]),
                 t=entry.get("t"),
+                trace=entry.get("trace", ""),
             )
             if not dup:
                 copied += 1

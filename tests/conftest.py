@@ -128,6 +128,16 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "trace: cross-process observability suite (tests/test_trace.py: "
+        "traceparent propagation + span adoption, per-delta "
+        "time-to-visible stages, the merged router histogram, "
+        "trace_stitch/obs_report/schema_lint gates, POST /profilez, and "
+        "the chaos-run shard-stitch acceptance test); runs in the "
+        "default CPU pass — select with -m trace or tools/run_tier1.sh "
+        "--trace-only",
+    )
+    config.addinivalue_line(
+        "markers",
         "slo: serving-SLO observability suite (tests/test_slo.py: "
         "bucket histograms + merge associativity, live /metrics and "
         "/statusz under the query hammer, quantile agreement vs the "

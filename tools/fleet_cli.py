@@ -110,7 +110,9 @@ def cmd_up(args) -> int:
                     f"http://{args.host}:{args.replica_base_port}",
                     "--primary-wal", wal_dir(0),
                 ]
-            if args.metrics_out:
+            if args.obs_dir:
+                cmd += ["--obs-dir", args.obs_dir]
+            elif args.metrics_out:
                 cmd += ["--metrics-out", f"{args.metrics_out}.replica{i}"]
             procs.append(subprocess.Popen(cmd))
         for i in range(args.replicas):
@@ -123,7 +125,11 @@ def cmd_up(args) -> int:
                 )
                 return 2
         sink = None
-        if args.metrics_out:
+        if args.obs_dir:
+            from graphmine_tpu.pipeline.metrics import shard_sink
+
+            sink = shard_sink(args.obs_dir, "router", max_records=100_000)
+        elif args.metrics_out:
             sink = MetricsSink(stream_path=args.metrics_out, tracer=Tracer())
             sink.max_records = 100_000
         specs = [
@@ -210,6 +216,12 @@ def main(argv=None) -> int:
                    help="the router's port (clients talk here)")
     p.add_argument("--replica-base-port", type=int, default=8450,
                    help="replica i listens on base+i")
+    p.add_argument("--obs-dir", default=None,
+                   help="federated metrics plane: router + every replica "
+                        "stream their records to per-process shards "
+                        "(<role>-<pid>.jsonl) under this directory — "
+                        "point tools/trace_stitch.py at it for "
+                        "cross-process trace timelines")
     p.add_argument("--metrics-out", default=None,
                    help="router records here; replica i appends to "
                         "PATH.replicaI")

@@ -36,29 +36,53 @@ dir):
   aggregate, ship-lag episodes, every ``writer_promote`` step and every
   ``publish_fenced`` refusal, in causal order — the promotion timeline
   RUNBOOKS §10 says to read before forcing writes on a read-only
-  fleet.
+  fleet;
+- the **fleet traces** section (ISSUE 11): the ``trace_stitch``
+  cross-process join rendered inline — complete per-delta timelines
+  (admission → WAL fsync → apply → publish → each replica visible, each
+  line attributed to the emitting process) and the failover epoch-fence
+  sequence.
 
 Usage::
 
     python tools/obs_report.py METRICS.jsonl [--run-id ID] [--out PATH]
+    python tools/obs_report.py OBS_DIR           # a fleet --obs-dir
+
+A directory argument is treated as a fleet ``--obs-dir``: every
+``*.jsonl`` shard inside is merged into one report view (the fleet is
+one logical run, so ``--run-id`` selection is skipped).
 
 A reused metrics file holds several ``run_start``-delimited segments; the
 default is the most recent run (``--run-id`` selects another). Exit code
-0 on success, 2 when the file is missing/empty or the run id is unknown.
-Stdlib-only (usable on a machine with no jax at all).
+0 on success, 2 when the file is missing/empty or the run id is unknown,
+**3 when the reported run carries schema violations or half-stamped
+trace records** (the all-or-nothing identity rule in ``obs/schema.py``)
+— so CI can run this as a post-e2e gate; ``--lenient`` downgrades the
+violations to a report note. Stdlib-only (usable on a machine with no
+jax at all).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 _REPO = __file__.rsplit("/", 2)[0]
 if _REPO not in sys.path:  # allow `python tools/obs_report.py` from anywhere
     sys.path.insert(0, _REPO)
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:  # sibling import when loaded as a module
+    sys.path.insert(0, _TOOLS)
 
-from graphmine_tpu.obs.schema import RECOVERY_PHASES, validate_record  # noqa: E402
+from graphmine_tpu.obs.schema import (  # noqa: E402
+    RECOVERY_PHASES,
+    validate_record,
+    validate_records,
+)
+
+import trace_stitch  # noqa: E402  — the cross-process join (ISSUE 11)
 
 BAR = "█"
 BAR_WIDTH = 30
@@ -595,6 +619,43 @@ def _heartbeat_summary(records, t0):
     return [line]
 
 
+def _fleet_trace_section(records, max_traces: int = 4):
+    """Cross-process trace timelines (ISSUE 11): the ``trace_stitch``
+    join rendered inline — complete per-delta timelines first (each with
+    its COMPLETE/partial verdict), then the failover epoch-fence
+    sequence. Empty list when no record carries a delta or failover
+    trace; records from a single-process stream render with their one
+    shard name, a merged ``--obs-dir`` view attributes every line to the
+    emitting process."""
+    recs = [dict(r) for r in records if r.get("trace_id") is not None
+            or r.get("phase") in trace_stitch._FAILOVER_PHASES]
+    if not recs:
+        return []
+    for r in recs:
+        r.setdefault("_src", "this-process")
+    traces = trace_stitch.stitch(recs)
+    deltas = trace_stitch.delta_traces(traces)
+    lines: list = []
+    complete = sorted(
+        tid for tid, (_, st) in deltas.items() if all(st.values())
+    )
+    if deltas:
+        lines.append(
+            f"complete per-delta timelines: {len(complete)}/{len(deltas)}"
+        )
+        ordered = complete + [t for t in deltas if t not in set(complete)]
+        for tid in ordered[:max_traces]:
+            trecs, stages = deltas[tid]
+            lines.extend(trace_stitch.render_trace(tid, trecs, stages))
+        if len(deltas) > max_traces:
+            lines.append(
+                f"({len(deltas) - max_traces} more delta trace(s); "
+                "tools/trace_stitch.py renders them all)"
+            )
+    lines.extend(trace_stitch.failover_section(recs))
+    return lines
+
+
 def build_report(records, source: str = "", bad_lines: int = 0) -> str:
     """Render one run's records (already filtered to a single run_id)."""
     start = next((r for r in records if r.get("phase") == "run_start"), None)
@@ -653,6 +714,11 @@ def build_report(records, source: str = "", bad_lines: int = 0) -> str:
         lines.append("")
         lines.append("-- fleet (replica health / breakers / routing) --")
         lines.extend(fleet)
+    ftrace = _fleet_trace_section(records)
+    if ftrace:
+        lines.append("")
+        lines.append("-- fleet traces (cross-process timelines) --")
+        lines.extend(ftrace)
     failover = _failover_section(records, t0)
     if failover:
         lines.append("")
@@ -669,22 +735,51 @@ def build_report(records, source: str = "", bad_lines: int = 0) -> str:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("metrics", help="metrics JSONL (--metrics-out of a run)")
+    ap.add_argument("metrics", help="metrics JSONL (--metrics-out of a "
+                    "run) or a fleet --obs-dir directory of shards")
     ap.add_argument("--run-id", default=None,
                     help="report this run (default: the most recent)")
     ap.add_argument("--out", default=None, help="write the report here "
                     "instead of stdout")
+    ap.add_argument("--lenient", action="store_true",
+                    help="note schema/trace-stamping violations instead "
+                    "of failing with exit code 3")
     args = ap.parse_args(argv)
-    try:
-        records, bad = load_records(args.metrics)
-    except OSError as e:
-        print(f"obs_report: cannot read {args.metrics}: {e}", file=sys.stderr)
-        return 2
-    if not records:
-        print(f"obs_report: no records in {args.metrics}", file=sys.stderr)
-        return 2
-    runs, order = split_runs(records)
-    rid = args.run_id or order[-1]
+    if os.path.isdir(args.metrics):
+        # A fleet --obs-dir: merge every process shard into ONE report
+        # view (each record keeps its shard under _src, so the fleet-
+        # trace section attributes lines to the emitting process). The
+        # fleet is one logical run — per-process run_ids would each
+        # select a sliver, so run splitting is skipped.
+        records, bad, dir_problems = trace_stitch.load_shards(
+            [args.metrics]
+        )
+        if not records:
+            print(
+                f"obs_report: no records in {args.metrics}",
+                file=sys.stderr,
+            )
+            return 2
+        runs, order = {"fleet": records}, ["fleet"]
+        rid = "fleet"
+    else:
+        dir_problems = None
+        try:
+            records, bad = load_records(args.metrics)
+        except OSError as e:
+            print(
+                f"obs_report: cannot read {args.metrics}: {e}",
+                file=sys.stderr,
+            )
+            return 2
+        if not records:
+            print(
+                f"obs_report: no records in {args.metrics}",
+                file=sys.stderr,
+            )
+            return 2
+        runs, order = split_runs(records)
+        rid = args.run_id or order[-1]
     if rid not in runs:
         print(
             f"obs_report: run_id {rid!r} not in {args.metrics} "
@@ -700,6 +795,29 @@ def main(argv=None) -> int:
             f.write(report)
     else:
         sys.stdout.write(report)
+    # The post-e2e gate (ISSUE 11 satellite): a stream whose selected
+    # run carries unknown phases, records missing required keys, or
+    # HALF-STAMPED trace identity (some of run/trace/span ids, not all —
+    # those records silently fall out of every timeline join) fails
+    # loudly so schema rot can't accumulate between e2e runs.
+    # Directory mode reuses the violations load_shards already computed
+    # ("shard:line: problem" — _src-stripped there); a single file runs
+    # the shared schema sweep once here.
+    problems = (
+        dir_problems if dir_problems is not None
+        else validate_records(runs[rid])
+    )
+    if problems:
+        print(
+            f"obs_report: {len(problems)} schema/trace-stamping "
+            f"violation(s) in run {rid!r}:", file=sys.stderr,
+        )
+        for p in problems[:20]:
+            print(f"  {p}", file=sys.stderr)
+        if len(problems) > 20:
+            print(f"  ... and {len(problems) - 20} more", file=sys.stderr)
+        if not args.lenient:
+            return 3
     return 0
 
 

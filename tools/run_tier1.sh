@@ -84,6 +84,9 @@ elif [ "${1:-}" = "--fleet-only" ]; then
 elif [ "${1:-}" = "--wal-only" ]; then
     shift
     MARKER='wal and not slow'
+elif [ "${1:-}" = "--trace-only" ]; then
+    shift
+    MARKER='trace and not slow'
 fi
 
 LOG="${TIER1_LOG:-/tmp/_t1.log}"

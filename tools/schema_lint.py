@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Schema-rot lint: every phase literal emitted anywhere in
+``graphmine_tpu/`` must be registered in ``obs/schema.py``.
+
+Runtime validation (``validate_records`` over e2e streams) only covers
+phases that HAPPEN to fire in a test run — an emit call on a cold path
+(a rare failover branch, a fault-only record) can carry a typo'd or
+unregistered phase for months before an incident finally exercises it,
+and then the triage tooling drops exactly the record the operator
+needs. This lint closes that gap statically: it scans the package
+source for first-argument string literals of the record-emitting calls
+(``.emit("...")``, ``.timed("...")``, ``._emit("...")``) and fails on
+any phase missing from the schema registry.
+
+Limitations, by design: phases passed as variables are invisible here —
+they remain covered by the runtime validation path (``MetricsSink``
+consumers assert ``validate_records == []`` over e2e streams), so the
+two checks together cover both shapes.
+
+Usage::
+
+    python tools/schema_lint.py          # exit 1 on violations
+    python tools/schema_lint.py --list   # also print every found phase
+
+Wired into tier-1 via
+``tests/test_trace.py::test_schema_lint_package_is_clean``.
+Stdlib-only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+_REPO = __file__.rsplit("/", 2)[0]
+if _REPO not in sys.path:  # allow `python tools/schema_lint.py` anywhere
+    sys.path.insert(0, _REPO)
+
+from graphmine_tpu.obs.schema import SCHEMAS  # noqa: E402
+
+# First-arg string literal of a record-emitting call. `\s*` crosses
+# newlines, so multi-line call formatting is caught; `emit=False`-style
+# kwargs don't match (no `(` after the word); `emit_admission(...)`
+# doesn't match (the word boundary is inside the identifier).
+_EMIT_RE = re.compile(
+    r"\b(?:emit|timed|_emit)\(\s*[\"']([A-Za-z_][A-Za-z0-9_]*)[\"']"
+)
+
+PACKAGE_DIR = os.path.join(_REPO, "graphmine_tpu")
+
+
+def scan(root: str = PACKAGE_DIR) -> list:
+    """All (phase, file, line) triples of string-literal phase emits."""
+    found = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path) as f:
+                text = f.read()
+            for m in _EMIT_RE.finditer(text):
+                line = text.count("\n", 0, m.start()) + 1
+                found.append((
+                    m.group(1), os.path.relpath(path, _REPO), line,
+                ))
+    return found
+
+
+def violations(root: str = PACKAGE_DIR) -> list:
+    """Emitted-but-unregistered phases: list of human-readable strings
+    (empty = clean). The tier-1 test asserts on this."""
+    return [
+        f"{path}:{line}: phase {phase!r} is emitted but not registered "
+        "in graphmine_tpu/obs/schema.py"
+        for phase, path, line in scan(root)
+        if phase not in SCHEMAS
+    ]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--list", action="store_true",
+                    help="print every literal phase emit found")
+    args = ap.parse_args(argv)
+    found = scan()
+    if args.list:
+        for phase, path, line in found:
+            mark = " " if phase in SCHEMAS else "!"
+            print(f"{mark} {phase:<24} {path}:{line}")
+    bad = violations()
+    if bad:
+        print(f"schema_lint: {len(bad)} unregistered phase emit(s):",
+              file=sys.stderr)
+        for b in bad:
+            print(f"  {b}", file=sys.stderr)
+        return 1
+    print(
+        f"schema_lint: {len(found)} literal phase emit(s), all registered "
+        f"({len(SCHEMAS)} phases in the registry)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

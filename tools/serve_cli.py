@@ -158,8 +158,16 @@ def request_with_retries(
 
 def _sink(args):
     from graphmine_tpu.obs.spans import Tracer
-    from graphmine_tpu.pipeline.metrics import MetricsSink
+    from graphmine_tpu.pipeline.metrics import MetricsSink, shard_sink
 
+    obs_dir = getattr(args, "obs_dir", None)
+    if obs_dir:
+        # the federated metrics plane: this process's records land in
+        # its own shard under --obs-dir (trace_stitch joins the dir)
+        role = getattr(args, "cmd", None) or "serve"
+        if role == "serve" and getattr(args, "standby_of", None):
+            role = "standby"
+        return shard_sink(obs_dir, role)
     return MetricsSink(
         stream_path=getattr(args, "metrics_out", None), tracer=Tracer()
     )
@@ -241,8 +249,8 @@ def cmd_query(args) -> int:
             for v, s in eng.top_outliers(args.community, args.topk)
         ]
     print(json.dumps(_jsonable(out)))
-    if args.metrics_out:
-        sink.finalize(args.metrics_out)
+    if sink.stream_path:
+        sink.finalize(sink.stream_path)
     return 0
 
 
@@ -307,8 +315,8 @@ def cmd_delta(args) -> int:
         "quarantine": last["quarantine"],
         "seconds": last["seconds"],
     }))
-    if args.metrics_out:
-        sink.finalize(args.metrics_out)
+    if sink.stream_path:
+        sink.finalize(sink.stream_path)
     return 0
 
 
@@ -326,6 +334,7 @@ def cmd_serve(args) -> int:
         slow_request_s=args.slow_request_s,
         wal=args.wal, standby_of=args.standby_of,
         primary_wal=args.primary_wal,
+        profilez_dir=args.profilez_dir,
     )
     host, port = server.start()
     role = (
@@ -344,8 +353,8 @@ def cmd_serve(args) -> int:
         pass
     finally:
         server.stop()
-        if args.metrics_out:
-            sink.finalize(args.metrics_out)
+        if sink.stream_path:
+            sink.finalize(sink.stream_path)
     return 0
 
 
@@ -358,6 +367,13 @@ def main(argv=None) -> int:
                        help="snapshot store directory")
         p.add_argument("--metrics-out", default=None,
                        help="append serving records to this JSONL")
+        p.add_argument("--obs-dir", default=None,
+                       help="federated metrics plane: stream this "
+                            "process's records to its own shard "
+                            "(<role>-<pid>.jsonl) under this directory; "
+                            "tools/trace_stitch.py joins a fleet's "
+                            "shards into cross-process trace timelines "
+                            "(overrides --metrics-out)")
 
     def client(p):
         p.add_argument("--url", default=None,
@@ -441,6 +457,12 @@ def main(argv=None) -> int:
                         "deployments): promotion copies the un-shipped "
                         "tail straight from it, so a writer kill loses "
                         "nothing")
+    p.add_argument("--profilez-dir", default=None, metavar="DIR",
+                   help="enable the guarded POST /profilez endpoint: "
+                        "on-demand XLA profiler captures land under this "
+                        "directory, tagged with the requesting trace_id "
+                        "(disabled when unset; 501 when jax/profiler is "
+                        "unavailable)")
     p.set_defaults(fn=cmd_serve)
 
     args = ap.parse_args(argv)

@@ -1,0 +1,344 @@
+#!/usr/bin/env python
+"""Stitch a fleet's per-process JSONL shards into cross-process trace
+timelines.
+
+The federated metrics plane (ISSUE 11, docs/OBSERVABILITY.md "Fleet
+tracing") has every process — router, replicas, writer, standby, chaos
+drivers — stream its records to its own shard under one ``--obs-dir``
+(``<role>-<pid>.jsonl``). Each record carries trace identity
+(``trace_id``/``span_id``/``span_path``), and the fleet propagates one
+``traceparent``-style header across every hop, so a single client
+request's records are scattered across shards but share one
+``trace_id``. This tool is the join:
+
+- **per-delta timelines** — a delta's full life across processes:
+  router root span → writer admission verdict → WAL fsync
+  (``wal_append``) → apply/publish (``delta_stages`` with the per-stage
+  split, ``delta_apply``, ``snapshot_publish``) → each replica's
+  reload-to-queryable (``delta_visible``), each line attributed to the
+  shard (= process) that emitted it, with a COMPLETE / partial verdict
+  per timeline;
+- the **failover sequence** — ``fleet_degraded`` → ``writer_promote`` →
+  ``publish_fenced`` → ``wal_replay`` in causal order across shards
+  (the epoch-fence story RUNBOOKS §10 reads);
+- the **rolling-reload walk** — per-replica drain → reload → rejoin
+  transitions merged onto one clock.
+
+Validation is a first-class output: every record is checked against the
+schema registry (``obs/schema.py``), including the all-or-nothing trace
+identity rule — a half-stamped record would silently fall out of the
+join, so by default the exit code is **3** when any violation exists
+(``--lenient`` downgrades to a warning). CI runs this right after the
+fleet chaos e2e as a stamping gate.
+
+Usage::
+
+    python tools/trace_stitch.py OBS_DIR_OR_SHARD [more shards...]
+        [--trace TRACE_ID] [--max-traces N] [--lenient] [--out PATH]
+
+Exit codes: 0 clean, 2 unreadable/empty input, 3 schema or
+trace-stamping violations (unless ``--lenient``). Stdlib-only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = __file__.rsplit("/", 2)[0]
+if _REPO not in sys.path:  # allow `python tools/trace_stitch.py` anywhere
+    sys.path.insert(0, _REPO)
+
+from graphmine_tpu.obs.schema import validate_record  # noqa: E402
+
+# The phases that make a per-delta timeline, in causal order. A timeline
+# is COMPLETE when every STAGE below has at least one record (multiple
+# phases can witness one stage — e.g. delta_apply and snapshot_publish
+# both witness the publish, whichever the coalesced group's leader trace
+# carried).
+_DELTA_STAGES = (
+    ("admission", ("admission",)),
+    ("wal_fsync", ("wal_append",)),
+    ("apply", ("delta_stages", "delta_apply")),
+    ("publish", ("snapshot_publish", "delta_stages")),
+    ("replica_visible", ("delta_visible",)),
+)
+_DELTA_PHASES = frozenset(p for _, ps in _DELTA_STAGES for p in ps)
+
+_FAILOVER_PHASES = ("fleet_degraded", "writer_promote", "publish_fenced",
+                    "wal_replay", "ship_lag")
+
+
+def load_shards(paths) -> tuple[list, int, list]:
+    """Read shard files (or whole directories of ``*.jsonl``) into one
+    record list, each record tagged with its shard name under ``_src``.
+    Torn/unparseable lines are counted, not fatal (a SIGKILLed process's
+    final line is exactly the stream this tool reads). Returns
+    ``(records, bad_lines, problems)`` where ``problems`` are schema /
+    trace-stamping violations."""
+    files: list = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(
+                os.path.join(p, f) for f in sorted(os.listdir(p))
+                if f.endswith(".jsonl")
+            )
+        else:
+            files.append(p)
+    records, bad, problems = [], 0, []
+    for path in files:
+        src = os.path.basename(path)
+        if src.endswith(".jsonl"):
+            src = src[: -len(".jsonl")]
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError as e:
+            problems.append(f"{src}: unreadable shard: {e}")
+            continue
+        for i, line in enumerate(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                bad += 1
+                continue
+            if not isinstance(rec, dict) or "phase" not in rec:
+                bad += 1
+                continue
+            rec["_src"] = src
+            records.append(rec)
+            for prob in validate_record(
+                {k: v for k, v in rec.items() if k != "_src"}
+            ):
+                problems.append(f"{src}:{i + 1}: {prob}")
+    records.sort(key=lambda r: r.get("t", 0.0))
+    return records, bad, problems
+
+
+def stitch(records) -> dict:
+    """Group records by ``trace_id`` (records with no trace identity are
+    per-process housekeeping and stay out of the join)."""
+    traces: dict = {}
+    for rec in records:
+        tid = rec.get("trace_id")
+        if tid is None:
+            continue
+        traces.setdefault(tid, []).append(rec)
+    return traces
+
+
+def delta_traces(traces: dict) -> dict:
+    """The subset of traces that carry a delta's life: trace_id ->
+    (records, stage verdicts)."""
+    out: dict = {}
+    for tid, recs in traces.items():
+        phases = {r.get("phase") for r in recs}
+        if "run_start" in phases or "run_end" in phases:
+            # A process's run-wide root trace: EVERY record of a
+            # single-process stream shares it, so classifying it as one
+            # "delta timeline" would render the whole stream inline.
+            # Per-request traces (router new_trace / adopted remote)
+            # never carry the run lifecycle.
+            continue
+        if not (phases & _DELTA_PHASES):
+            continue
+        stages = {}
+        for stage, witnesses in _DELTA_STAGES:
+            stages[stage] = any(p in phases for p in witnesses)
+        out[tid] = (recs, stages)
+    return out
+
+
+_DETAIL = {
+    "admission": ("verdict", "rows", "queue_depth"),
+    "wal_append": ("seq", "rows", "bytes", "seconds"),
+    "delta_stages": ("version", "seq", "coalesced", "stages"),
+    "delta_apply": ("version", "method", "iterations", "seconds"),
+    "snapshot_publish": ("version", "bytes", "seconds"),
+    "snapshot_load": ("version", "seconds"),
+    "delta_visible": ("replica", "version", "seconds"),
+    "access_log": ("method", "endpoint", "status", "seconds"),
+    "fleet_route": ("endpoint", "verdict", "attempts", "replica"),
+    "query_batch": ("endpoint", "n", "seconds"),
+    "delta_shed": ("stage", "reason"),
+    "delta_coalesce": ("batches", "rows_in", "rows_out"),
+    "fleet_degraded": ("read_only", "writer", "reason"),
+    "writer_promote": ("epoch", "replica", "replayed", "copied_tail"),
+    "publish_fenced": ("attempted_epoch", "store_epoch"),
+    "wal_replay": ("entries", "from_seq", "source"),
+    "ship_lag": ("lag_entries", "lag_s"),
+    "replica_health": ("replica", "from_state", "to_state", "reason"),
+    "profile_capture": ("dir", "ok"),
+    "span": ("name", "seconds", "status"),
+    "ivf_fallback": ("guard",),
+}
+
+
+def _line(rec, t0) -> str:
+    phase = rec.get("phase", "?")
+    keys = _DETAIL.get(phase, ())
+    detail = "  ".join(
+        f"{k}={rec[k]}" for k in keys if k in rec and rec[k] is not None
+    )
+    return (
+        f"  +{rec.get('t', t0) - t0:7.3f}s  [{rec.get('_src', '?'):<18}]"
+        f"  {phase:<17}  {detail}"
+    )
+
+
+def render_trace(tid: str, recs, stages: dict | None = None,
+                 max_records: int = 60) -> list:
+    t0 = min(r.get("t", 0.0) for r in recs)
+    out = [f"trace {tid}  ({len(recs)} records, "
+           f"{len({r.get('_src') for r in recs})} process(es))"]
+    for rec in recs[:max_records]:
+        out.append(_line(rec, t0))
+    if len(recs) > max_records:
+        out.append(
+            f"  ... and {len(recs) - max_records} more record(s) in "
+            "this trace"
+        )
+    if stages is not None:
+        missing = [s for s, ok in stages.items() if not ok]
+        out.append(
+            "  verdict: COMPLETE (admission -> wal fsync -> apply -> "
+            "publish -> replica visible)" if not missing
+            else f"  verdict: partial (missing: {', '.join(missing)})"
+        )
+    return out
+
+
+def failover_section(records) -> list:
+    events = [r for r in records if r.get("phase") in _FAILOVER_PHASES]
+    if not events:
+        return []
+    t0 = min(r.get("t", 0.0) for r in events)
+    out = ["== failover sequence (all shards, one clock) =="]
+    for rec in events:
+        out.append(_line(rec, t0))
+    return out
+
+
+def rolling_reload_section(records) -> list:
+    moves = [
+        r for r in records
+        if r.get("phase") == "replica_health"
+        and ("roll" in str(r.get("reason", "")).lower()
+             or r.get("to_state") == "draining")
+    ]
+    if not moves:
+        return []
+    t0 = min(r.get("t", 0.0) for r in moves)
+    out = ["== rolling reload walk =="]
+    for rec in moves:
+        out.append(_line(rec, t0))
+    return out
+
+
+def build_report(records, bad: int, problems, max_traces: int = 8,
+                 only_trace: str | None = None) -> str:
+    traces = stitch(records)
+    deltas = delta_traces(traces)
+    shards = sorted({r.get("_src", "?") for r in records})
+    lines = ["== graphmine_tpu fleet trace stitch =="]
+    lines.append(
+        f"shards: {len(shards)} ({', '.join(shards)})  records: "
+        f"{len(records)}  traces: {len(traces)}  delta traces: "
+        f"{len(deltas)}"
+    )
+    if bad:
+        lines.append(f"note: {bad} unparseable line(s) skipped")
+    if problems:
+        lines.append(
+            f"VIOLATIONS: {len(problems)} schema/trace-stamping "
+            "problem(s):"
+        )
+        lines.extend(f"  {p}" for p in problems[:40])
+        if len(problems) > 40:
+            lines.append(f"  ... and {len(problems) - 40} more")
+    if only_trace is not None:
+        recs = traces.get(only_trace)
+        if recs is None:
+            lines.append(f"trace {only_trace!r} not found")
+        else:
+            stages = deltas.get(only_trace, (None, None))[1]
+            lines.append("")
+            lines.extend(render_trace(only_trace, recs, stages))
+        return "\n".join(lines) + "\n"
+    complete = sorted(
+        (tid for tid, (_, st) in deltas.items() if all(st.values())),
+    )
+    if deltas:
+        lines.append(
+            f"complete per-delta timelines: {len(complete)}/{len(deltas)}"
+        )
+        lines.append("")
+        lines.append("== per-delta timelines ==")
+        # complete timelines first — they are the ones worth reading
+        ordered = complete + [t for t in deltas if t not in set(complete)]
+        for tid in ordered[:max_traces]:
+            recs, stages = deltas[tid]
+            lines.extend(render_trace(tid, recs, stages))
+            lines.append("")
+        if len(deltas) > max_traces:
+            lines.append(
+                f"({len(deltas) - max_traces} more delta trace(s); "
+                "--max-traces or --trace ID to see them)"
+            )
+    failover = failover_section(records)
+    if failover:
+        lines.append("")
+        lines.extend(failover)
+    roll = rolling_reload_section(records)
+    if roll:
+        lines.append("")
+        lines.extend(roll)
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("shards", nargs="+",
+                    help="shard files and/or --obs-dir directories")
+    ap.add_argument("--trace", default=None,
+                    help="render only this trace_id")
+    ap.add_argument("--max-traces", type=int, default=8,
+                    help="delta timelines to render (default 8)")
+    ap.add_argument("--lenient", action="store_true",
+                    help="report schema/stamping violations but exit 0")
+    ap.add_argument("--out", default=None,
+                    help="write the report here instead of stdout")
+    args = ap.parse_args(argv)
+    records, bad, problems = load_shards(args.shards)
+    if not records:
+        print(
+            f"trace_stitch: no records in {', '.join(args.shards)}",
+            file=sys.stderr,
+        )
+        return 2
+    report = build_report(
+        records, bad, problems, max_traces=args.max_traces,
+        only_trace=args.trace,
+    )
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(report)
+    else:
+        sys.stdout.write(report)
+    if problems and not args.lenient:
+        print(
+            f"trace_stitch: {len(problems)} schema/trace-stamping "
+            "violation(s) — failing (use --lenient to downgrade)",
+            file=sys.stderr,
+        )
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
