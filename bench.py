@@ -2641,6 +2641,37 @@ def orchestrate(tier):
     return finish_suite()
 
 
+def list_missing(strict: bool) -> int:
+    """``--list-missing`` (ISSUE 12): print the silicon-capture manifest
+    over every committed ``BENCH_*.json`` — which tiers/sub-records still
+    exist only as ``*_cpu_fallback`` records (or not at all). This IS the
+    "Silicon capture backlog" ROADMAP used to maintain as prose; with
+    ``--strict`` a non-empty backlog exits 1 (a healthy-TPU CI window can
+    gate on it). Delegates to ``tools/bench_diff.py`` (stdlib-only; no
+    jax/backend probe, so this path is safe on any box)."""
+    tools_dir = os.path.join(_REPO_DIR, "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import bench_diff
+
+    paths = bench_diff.committed_bench_files(_REPO_DIR)
+    captures = []
+    for p in paths:
+        try:
+            captures.append(bench_diff.load_bench(p))
+        except bench_diff.BenchLoadError:
+            continue
+    manifest = bench_diff.silicon_manifest(captures)
+    print(json.dumps(manifest, indent=2))
+    if manifest["pending"]:
+        print(
+            f"bench: {len(manifest['pending'])} tier(s)/sub-record(s) "
+            "pending silicon capture", file=sys.stderr,
+        )
+        return 1 if strict else 0
+    return 0
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -2655,7 +2686,19 @@ if __name__ == "__main__":
         # driver-captured record (VERDICT r2 item 1).
         default="all",
     )
+    ap.add_argument(
+        "--list-missing", action="store_true",
+        help="print the silicon-capture manifest over committed "
+        "BENCH_*.json (tiers/sub-records with only CPU-fallback records) "
+        "and exit — no measurement runs",
+    )
+    ap.add_argument(
+        "--strict", action="store_true",
+        help="with --list-missing: exit 1 when the manifest is non-empty",
+    )
     args = ap.parse_args()
+    if args.list_missing:
+        sys.exit(list_missing(args.strict))
     _TIERS = {
         "chip": main,
         "roofline": main_roofline,

@@ -155,6 +155,17 @@ _EXPORTS = {
     "select_superstep_family": (
         "graphmine_tpu.ops.blocking", "select_superstep_family"
     ),
+    "obs": ("graphmine_tpu.obs", None),
+    "CostEstimate": ("graphmine_tpu.obs.costmodel", "CostEstimate"),
+    "superstep_cost": ("graphmine_tpu.obs.costmodel", "superstep_cost"),
+    "sharded_superstep_cost": (
+        "graphmine_tpu.obs.costmodel", "sharded_superstep_cost"
+    ),
+    "lof_cost": ("graphmine_tpu.obs.costmodel", "lof_cost"),
+    "rooflines": ("graphmine_tpu.obs.costmodel", "rooflines"),
+    "crossover_thresholds": (
+        "graphmine_tpu.ops.blocking", "crossover_thresholds"
+    ),
     "LofPlan": ("graphmine_tpu.pipeline.planner", "LofPlan"),
     "PlanError": ("graphmine_tpu.pipeline.planner", "PlanError"),
     "RunPlan": ("graphmine_tpu.pipeline.planner", "RunPlan"),

@@ -16,9 +16,20 @@ builds on it, not the other way around):
   run is distinguishable from a dead one);
 - :mod:`graphmine_tpu.obs.schema`     the record-schema registry every
   emitted phase name must be declared in (validated in tests and by
-  ``tools/obs_report.py``).
+  ``tools/obs_report.py``);
+- :mod:`graphmine_tpu.obs.costmodel`  the analytical compute-plane cost
+  model (r13): per-plan bytes/slots/exchange derivation, measured
+  roofline anchors, the ``cost`` sub-record builder and the
+  ``superstep_timing`` achieved-vs-model emission.
 """
 
+from graphmine_tpu.obs.costmodel import (
+    CostEstimate,
+    lof_cost,
+    rooflines,
+    sharded_superstep_cost,
+    superstep_cost,
+)
 from graphmine_tpu.obs.histogram import Histogram, HistogramFamily
 from graphmine_tpu.obs.registry import Registry
 from graphmine_tpu.obs.spans import (
@@ -30,6 +41,7 @@ from graphmine_tpu.obs.spans import (
 )
 
 __all__ = [
+    "CostEstimate",
     "Histogram",
     "HistogramFamily",
     "Registry",
@@ -37,5 +49,9 @@ __all__ = [
     "TRACE_HEADER",
     "TraceContext",
     "Tracer",
+    "lof_cost",
     "new_run_id",
+    "rooflines",
+    "sharded_superstep_cost",
+    "superstep_cost",
 ]

@@ -70,6 +70,19 @@ register("impl_selected", "op", "impl", "n", "reason")
 # edge. Host plan cost grows with the tighter ladders; this record keeps
 # it visible in obs_report instead of hiding inside first-call latency.
 register("plan_build", "op", "family", "seconds", "padded_slots_per_edge")
+# superstep_timing (ISSUE 12): achieved-vs-model throughput for one
+# window of supersteps, emitted at the existing tripwire/telemetry
+# cadence (zero extra device syncs — the driver already blocks per
+# superstep) and by the ops-layer fixpoint seams (cc/pagerank/LPA with a
+# sink). Carries BOTH sides of the roofline argument: achieved
+# edges/s/chip and the cost model's prediction, plus the full `cost`
+# sub-record (see COST_KEYS below). obs_report's roofline section
+# renders these; windows below a configurable fraction of model are the
+# RUNBOOKS §12 triage signal.
+register("superstep_timing", "op", "family", "variant", "iteration",
+         "window", "seconds", "edges_per_sec_per_chip",
+         "predicted_edges_per_sec_per_chip", "achieved_fraction",
+         "devices", "cost")
 
 # ---- serving records (docs/SERVING.md) ------------------------------------
 register("snapshot_publish", "version", "snapshot_id", "path", "bytes",
@@ -167,6 +180,20 @@ RECOVERY_PHASES = frozenset((
 ))
 
 
+# The `cost` sub-record shape (obs/costmodel.CostEstimate.record — the
+# single builder; tools/schema_lint.py flags inline cost={...} literals
+# elsewhere in the package). Like trace identity, the sub-record is
+# all-or-nothing: a record carrying `cost` must carry EVERY key below,
+# or the roofline tooling would silently render holes — half-stamped
+# cost records fail validation the same way half-stamped traces do.
+COST_KEYS = frozenset((
+    "family", "devices", "slots", "padded_slots", "bytes_gathered",
+    "bytes_scattered", "padding_overhead", "exchange_bytes",
+    "compute_seconds", "exchange_seconds", "predicted_seconds",
+    "predicted_per_chip", "unit", "roofline",
+))
+
+
 def validate_record(rec) -> list:
     """Problems with one record (empty list = valid)."""
     problems = []
@@ -193,6 +220,21 @@ def validate_record(rec) -> list:
         problems.append(
             f"{phase}: partial trace identity (has {present}, lacks {absent})"
         )
+    if "cost" in rec:
+        cost = rec["cost"]
+        if not isinstance(cost, dict):
+            problems.append(
+                f"{phase}: cost sub-record is {type(cost).__name__}, not "
+                "dict — build it with obs/costmodel CostEstimate.record()"
+            )
+        else:
+            missing = sorted(k for k in COST_KEYS if k not in cost)
+            if missing:
+                problems.append(
+                    f"{phase}: half-stamped cost sub-record (missing "
+                    f"{missing}) — build it with obs/costmodel "
+                    "CostEstimate.record()"
+                )
     return problems
 
 

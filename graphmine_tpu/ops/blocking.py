@@ -103,6 +103,27 @@ DEFAULT_TILE_SLOTS = 1 << 18
 FAMILIES = ("blocked", "bucketed", "sort")
 
 
+def crossover_thresholds() -> dict:
+    """The ACTIVE family-crossover constants, env overrides applied — the
+    numbers that decide every ``plan="auto"`` resolution. One owner for
+    both the selection itself (:func:`select_superstep_family`) and the
+    provenance records (``impl_selected`` carries this dict, so a policy
+    flip is explainable from the JSONL alone — ISSUE 12 satellite)."""
+    return {
+        "bucketed_min_messages": BUCKETED_MIN_MESSAGES,
+        "blocked_min_messages": int(
+            os.environ.get(
+                "GRAPHMINE_BLOCKED_MIN_MESSAGES", BLOCKED_MIN_MESSAGES
+            )
+        ),
+        "blocked_min_vertices": int(
+            os.environ.get(
+                "GRAPHMINE_BLOCKED_MIN_VERTICES", BLOCKED_MIN_VERTICES
+            )
+        ),
+    }
+
+
 def select_superstep_family(
     num_vertices: int, num_messages: int, requested: str = "auto",
     weighted: bool = False,
@@ -136,12 +157,9 @@ def select_superstep_family(
                 f"GRAPHMINE_SUPERSTEP_FAMILY={env!r} is not one of {FAMILIES}"
             )
         return env, f"GRAPHMINE_SUPERSTEP_FAMILY={env} (env override)"
-    min_m = int(
-        os.environ.get("GRAPHMINE_BLOCKED_MIN_MESSAGES", BLOCKED_MIN_MESSAGES)
-    )
-    min_v = int(
-        os.environ.get("GRAPHMINE_BLOCKED_MIN_VERTICES", BLOCKED_MIN_VERTICES)
-    )
+    thr = crossover_thresholds()
+    min_m = thr["blocked_min_messages"]
+    min_v = thr["blocked_min_vertices"]
     if num_messages >= min_m and num_vertices >= min_v:
         return "blocked", (
             f"V={num_vertices} >= {min_v} and M={num_messages} >= {min_m}: "
@@ -535,22 +553,40 @@ def plan_build_stats(plan, num_edges: int) -> dict:
 
 def emit_plan_records(
     sink, op: str, plan, reason: str, seconds: float, cached: bool,
-    num_edges: int, num_messages: int,
+    num_edges: int, num_messages: int, num_vertices: int | None = None,
 ) -> None:
     """Emit the ``impl_selected`` + ``plan_build`` provenance pair for one
     auto-plan resolution (no-op without a sink). ``plan=None`` (sort
-    family) emits only ``impl_selected`` — there is no plan to build."""
+    family) emits only ``impl_selected`` — there is no plan to build.
+
+    Both records carry the decision's full evidence (ISSUE 12): the
+    active crossover ``thresholds`` (:func:`crossover_thresholds`) and
+    the analytical ``cost`` sub-record
+    (:func:`graphmine_tpu.obs.costmodel.superstep_cost` — exact padded
+    slots when a plan exists), so every auto-policy flip ships the
+    numbers that justified it."""
     if sink is None:
         return
+    from graphmine_tpu.obs.costmodel import superstep_cost
+
     family = "sort" if plan is None else plan_build_stats(plan, num_edges)["family"]
+    v = (
+        num_vertices if num_vertices is not None
+        else getattr(plan, "num_vertices", 0)
+    )
+    cost = superstep_cost(
+        op, family, v, num_messages, num_edges, plan=plan
+    )
     sink.emit(
-        "impl_selected", op=op, impl=family, n=num_messages, reason=reason
+        "impl_selected", op=op, impl=family, n=num_messages, reason=reason,
+        thresholds=crossover_thresholds(), cost=cost.record(),
     )
     if plan is None:
         return
     stats = plan_build_stats(plan, num_edges)
     sink.emit(
-        "plan_build", op=op, seconds=round(seconds, 6), cached=cached, **stats
+        "plan_build", op=op, seconds=round(seconds, 6), cached=cached,
+        cost=cost.record(), **stats,
     )
 
 

@@ -130,6 +130,7 @@ def connected_components(
             emit_plan_records(
                 sink, "cc_superstep", plan, reason, seconds, cached,
                 graph.num_edges, graph.num_messages,
+                num_vertices=graph.num_vertices,
             )
     if isinstance(plan, BlockedPlan):
         # Full plan/graph identity check HERE, where the graph is in
@@ -147,6 +148,36 @@ def connected_components(
             )
     elif plan is not None and plan.send_idx is None:
         plan = None  # non-fused plan: no label-gather indices to min over
+    if sink is not None and not isinstance(graph.msg_ptr, jax.core.Tracer):
+        # Achieved-vs-model attribution (ISSUE 12): run the fixpoint with
+        # the iteration counter on (so the window size is the REAL
+        # supersteps-to-fixpoint, not the bound), wall-time it, and judge
+        # it against the analytical cost model.
+        from graphmine_tpu.obs.costmodel import (
+            emit_superstep_timing,
+            superstep_cost,
+            timed_fixpoint,
+        )
+
+        (labels, iters), secs, cold = timed_fixpoint(
+            lambda: _connected_components(graph, max_iter, True, plan),
+            jit_fn=_connected_components,
+        )
+        iters = int(iters)
+        # weighted=False explicitly: CC's min ignores the weight payload
+        # even when the shared auto plan carries one.
+        cost = superstep_cost(
+            "cc_superstep", "sort" if plan is None else "auto",
+            graph.num_vertices, graph.num_messages, graph.num_edges,
+            plan=plan, weighted=False,
+        )
+        emit_superstep_timing(
+            sink, "cc_superstep", cost, iters, iters, secs,
+            graph.num_edges, variant="fused", cold_compile=cold,
+        )
+        if return_iterations:
+            return labels, iters
+        return labels
     return _connected_components(graph, max_iter, return_iterations, plan)
 
 

@@ -65,10 +65,7 @@ def select_lof_impl(
     if impl != "auto":
         family = "ivf" if impl == "ivf" else "exact"
         return family, f"impl={impl!r} requested explicitly"
-    if ivf_min_points is None:
-        ivf_min_points = int(
-            os.environ.get("GRAPHMINE_LOF_IVF_MIN_N", LOF_IVF_MIN_POINTS)
-        )
+    ivf_min_points = resolved_ivf_min_points(ivf_min_points)
     if n >= ivf_min_points:
         if 0 < k < n:
             return "ivf", (
@@ -86,6 +83,15 @@ def select_lof_impl(
         f"n={n} < crossover {ivf_min_points}: exact all-pairs wins below "
         "~131K points (IVF index overheads dominate; measured at 65K)"
     )
+
+
+def resolved_ivf_min_points(ivf_min_points: int | None = None) -> int:
+    """The ACTIVE exact→IVF crossover (env override applied) — the
+    threshold provenance every ``impl_selected`` record carries so an
+    auto flip is explainable from the JSONL alone (ISSUE 12)."""
+    if ivf_min_points is not None:
+        return int(ivf_min_points)
+    return int(os.environ.get("GRAPHMINE_LOF_IVF_MIN_N", LOF_IVF_MIN_POINTS))
 
 
 def lof_scores(
@@ -133,9 +139,19 @@ def lof_scores(
         n, k, impl=impl, ivf_min_points=ivf_min_points
     )
     if sink is not None:
+        from graphmine_tpu.obs.costmodel import lof_cost
+
         sink.emit(
             "impl_selected", op="lof_knn", impl=family, requested=impl,
             n=n, k=k, reason=reason,
+            # the deciding crossover + the model's numbers (ISSUE 12):
+            # a policy flip is explainable from the JSONL alone
+            thresholds={"lof_ivf_min_points": resolved_ivf_min_points(
+                ivf_min_points
+            )},
+            cost=lof_cost(
+                family, n, k, features=int(points.shape[-1])
+            ).record(),
         )
     if family == "ivf":
         from graphmine_tpu.ops.ann import ivf_knn

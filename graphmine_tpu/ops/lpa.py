@@ -101,6 +101,7 @@ def label_propagation(
             emit_plan_records(
                 sink, "lpa_superstep", plan, reason, seconds, cached,
                 graph.num_edges, graph.num_messages,
+                num_vertices=graph.num_vertices,
             )
     elif plan is not None and not isinstance(
         plan, (BucketedModePlan, BlockedPlan)
@@ -128,6 +129,35 @@ def label_propagation(
                 f"[{int(il.min())}, {int(il.max())}] — pass plan=None for "
                 "arbitrary label values"
             )
+    if sink is not None and not isinstance(graph.msg_ptr, jax.core.Tracer):
+        # Achieved-vs-model attribution (ISSUE 12): wall-time the whole
+        # compiled scan as one window of max_iter supersteps and judge it
+        # against the analytical cost model — one superstep_timing record
+        # per call, zero extra device syncs beyond the result fetch the
+        # caller was about to pay anyway.
+        from graphmine_tpu.obs.costmodel import (
+            emit_superstep_timing,
+            superstep_cost,
+            timed_fixpoint,
+        )
+
+        out, secs, cold = timed_fixpoint(
+            lambda: _label_propagation(
+                graph, max_iter, init_labels, return_history, plan
+            ),
+            jit_fn=_label_propagation,
+        )
+        cost = superstep_cost(
+            "lpa_superstep",
+            "sort" if plan is None else "auto",
+            graph.num_vertices, graph.num_messages, graph.num_edges,
+            plan=plan, weighted=graph.msg_weight is not None,
+        )
+        emit_superstep_timing(
+            sink, "lpa_superstep", cost, max_iter, max_iter, secs,
+            graph.num_edges, variant="fused", cold_compile=cold,
+        )
+        return out
     return _label_propagation(graph, max_iter, init_labels, return_history, plan)
 
 

@@ -86,6 +86,7 @@ def pagerank(
                 emit_plan_records(
                     sink, "pagerank_inflow", resolved, reason, seconds,
                     cached, graph.num_edges, graph.num_messages,
+                    num_vertices=graph.num_vertices,
                 )
     elif isinstance(plan, BlockedPlan):
         if (
@@ -118,7 +119,37 @@ def pagerank(
         raise ValueError(
             f"plan must be 'auto', None, or a BlockedPlan; got {plan!r}"
         )
-    return _pagerank(graph, alpha, max_iter, tol, reset, weights, resolved)
+    if sink is not None and not isinstance(graph.msg_ptr, jax.core.Tracer):
+        # Achieved-vs-model attribution (ISSUE 12): _pagerank returns its
+        # while_loop iteration count, so the window is the REAL
+        # supersteps-to-tolerance; judged against the analytical model
+        # (segment_sum inflow ≈ the sort gather; blocked_inflow ≈ the
+        # binned two-pass).
+        from graphmine_tpu.obs.costmodel import (
+            emit_superstep_timing,
+            superstep_cost,
+            timed_fixpoint,
+        )
+
+        (pr, iters), secs, cold = timed_fixpoint(
+            lambda: _pagerank(
+                graph, alpha, max_iter, tol, reset, weights, resolved
+            ),
+            jit_fn=_pagerank,
+        )
+        iters = max(int(iters), 1)
+        cost = superstep_cost(
+            "pagerank_inflow", "sort" if resolved is None else "auto",
+            graph.num_vertices, graph.num_messages, graph.num_edges,
+            plan=resolved, weighted=weights is not None,
+        )
+        emit_superstep_timing(
+            sink, "pagerank_inflow", cost, iters, iters, secs,
+            graph.num_edges, variant="fused", cold_compile=cold,
+        )
+        return pr
+    pr, _ = _pagerank(graph, alpha, max_iter, tol, reset, weights, resolved)
+    return pr
 
 
 @partial(jax.jit, static_argnames=("max_iter",))
@@ -172,8 +203,10 @@ def _pagerank(
         return (delta > tol) & (it < max_iter)
 
     pr0 = jnp.full((v,), 1.0 / v, jnp.float32)
-    pr, _, _ = lax.while_loop(cond, step, (pr0, jnp.float32(1.0), jnp.int32(0)))
-    return pr
+    pr, _, it = lax.while_loop(cond, step, (pr0, jnp.float32(1.0), jnp.int32(0)))
+    # iterations ride along so the sink path can report the REAL window
+    # (the public wrapper discards them for plain callers)
+    return pr, it
 
 
 def _validate_sources(sources, v: int) -> np.ndarray:

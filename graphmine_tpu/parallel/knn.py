@@ -211,9 +211,17 @@ def sharded_lof(points, mesh, k: int = 128, row_tile: int = 1024,
     n = int(np.asarray(points).shape[0])
     family, reason = select_lof_impl(n, k, impl=impl)
     if sink is not None:
+        from graphmine_tpu.obs.costmodel import lof_cost
+        from graphmine_tpu.ops.lof import resolved_ivf_min_points
+
         sink.emit(
             "impl_selected", op="lof_knn", impl=family, requested=impl,
             n=n, k=k, devices=int(mesh.size), reason=reason,
+            thresholds={"lof_ivf_min_points": resolved_ivf_min_points()},
+            cost=lof_cost(
+                family, n, k, features=int(np.asarray(points).shape[-1]),
+                devices=int(mesh.size),
+            ).record(),
         )
     if family == "ivf":
         from graphmine_tpu.ops.ann import ivf_knn

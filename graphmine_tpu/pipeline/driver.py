@@ -242,9 +242,21 @@ def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
                 reason=sstep_plan.reason + " — driver single path: plan "
                 "build shares the graph's CSR pass, bucketed kernel kept",
             )
+        from graphmine_tpu.obs.costmodel import superstep_cost
+        from graphmine_tpu.ops.blocking import crossover_thresholds
+
         m.emit(
             "impl_selected", op="lpa_superstep", impl=sstep_plan.family,
             n=2 * table.num_edges, reason=sstep_plan.reason,
+            # the deciding crossover constants + the model's pre-build
+            # estimate (ISSUE 12; the plan_build record below carries the
+            # exact padded counts once the plan exists)
+            thresholds=crossover_thresholds(),
+            cost=superstep_cost(
+                "lpa_superstep", sstep_plan.family, table.num_vertices,
+                2 * table.num_edges, table.num_edges,
+                weighted=table.weights is not None,
+            ).record(),
         )
     # Scale-out mode (r3): when the planner chose a distributed schedule
     # AND the whole graph cannot also fit one device, the full Graph stays
@@ -281,9 +293,15 @@ def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
             # plan_build: the host plan cost, visible in obs_report
             # instead of hiding inside first-call latency (the
             # impl_selected record above already carries the rationale).
+            from graphmine_tpu.obs.costmodel import superstep_cost
+
             m.emit(
                 "plan_build", op="lpa_superstep",
                 seconds=round(time.perf_counter() - t0, 6), cached=False,
+                cost=superstep_cost(
+                    "lpa_superstep", sstep_plan.family, table.num_vertices,
+                    2 * table.num_edges, table.num_edges, plan=plan,
+                ).record(),
                 **plan_build_stats(plan, table.num_edges),
             )
             # single-element holder, not the bare plan: the LPA loop can
@@ -569,13 +587,39 @@ def _publish_snapshot(config: PipelineConfig, result: PipelineResult, m: Metrics
                 sharded_connected_components,
             )
 
+            from graphmine_tpu.obs.costmodel import (
+                emit_superstep_timing,
+                sharded_superstep_cost,
+                timed_fixpoint,
+            )
+
             mesh = make_mesh(n_dev)
             sg = shard_graph_arrays(partition_graph(graph, mesh=mesh), mesh)
-            cc = np.asarray(sharded_connected_components(sg, mesh))
+            # telemetry=True returns the real supersteps-to-fixpoint on
+            # the existing while-loop carry (no extra device syncs) — the
+            # CC phase's achieved-vs-model window (ISSUE 12).
+            from graphmine_tpu.parallel.sharded import _sharded_cc_jit
+
+            (cc_labels, tele), secs, cold = timed_fixpoint(
+                lambda: sharded_connected_components(sg, mesh, telemetry=True),
+                jit_fn=_sharded_cc_jit,
+            )
+            emit_superstep_timing(
+                m, "cc_superstep",
+                sharded_superstep_cost(
+                    "cc_superstep", sg, graph.num_edges,
+                    num_messages=graph.num_messages, weighted=False,
+                ),
+                tele.iterations, tele.iterations, secs, graph.num_edges,
+                variant="sharded", cold_compile=cold,
+            )
+            cc = np.asarray(cc_labels)
         else:
             from graphmine_tpu.ops.cc import connected_components
 
-            cc = np.asarray(connected_components(graph))
+            # sink=m: the auto seam emits impl_selected/plan_build AND
+            # the CC phase's superstep_timing record (ops/cc.py).
+            cc = np.asarray(connected_components(graph, sink=m))
         present, sizes, edge_counts = result.community_table
         arrays = {
             "src": np.asarray(table.src, np.int32),
@@ -671,6 +715,11 @@ def _run_lpa(
     import jax
     import jax.numpy as jnp
 
+    from graphmine_tpu.obs.costmodel import (
+        WindowTimer,
+        sharded_superstep_cost,
+        superstep_cost,
+    )
     from graphmine_tpu.parallel.mesh import make_mesh
     from graphmine_tpu.parallel.sharded import (
         partition_graph,
@@ -678,6 +727,13 @@ def _run_lpa(
         sharded_label_propagation,
     )
 
+    # Achieved-vs-model window timing (ISSUE 12): per-superstep wall
+    # durations accumulate here and flush as `superstep_timing` records
+    # at the EXISTING telemetry cadence — the driver already syncs every
+    # superstep for the labels-changed counter, so this adds zero device
+    # syncs. Each operating point (make_superstep) installs its own cost
+    # estimate in current["cost"].
+    wtimer = WindowTimer()
     chips = max(n_dev, 1)
     start_iter = 0
     labels = jnp.arange(graph.num_vertices, dtype=jnp.int32)
@@ -779,6 +835,10 @@ def _run_lpa(
             with m.timed("partition", shards=ndev, schedule="ring"):
                 sg = shard_graph_arrays(partition_graph(graph, mesh=mesh), mesh)
             current["chunk_size"] = sg.chunk_size
+            current["cost"] = sharded_superstep_cost(
+                "lpa_superstep", sg, graph.num_edges,
+                num_messages=graph.num_messages,
+            )
             return lambda lbl: ring_label_propagation(
                 sg, mesh, max_iter=1, init_labels=lbl
             )
@@ -791,6 +851,10 @@ def _run_lpa(
                     lpa_only=run_plan.lpa_only,
                 )
             current["chunk_size"] = sg.chunk_size
+            current["cost"] = sharded_superstep_cost(
+                "lpa_superstep", sg, graph.num_edges,
+                num_messages=graph.num_messages,
+            )
             return lambda lbl: sharded_label_propagation(
                 sg, mesh, max_iter=1, init_labels=lbl
             )
@@ -801,6 +865,11 @@ def _run_lpa(
             from graphmine_tpu.ops.lpa import lpa_superstep
 
             current["chunk_size"] = graph.num_vertices
+            current["cost"] = superstep_cost(
+                "lpa_superstep", "sort", graph.num_vertices,
+                graph.num_messages, graph.num_edges,
+                weighted=graph.msg_weight is not None,
+            )
             step = jax.jit(lpa_superstep)
             return lambda lbl: step(lbl, graph)
         if variant == "single_bucketed":
@@ -814,9 +883,14 @@ def _run_lpa(
             from graphmine_tpu.ops.lpa import _cached_auto_plan
 
             plan, secs, cached = _cached_auto_plan(graph, "bucketed")
+            current["cost"] = superstep_cost(
+                "lpa_superstep", "bucketed", graph.num_vertices,
+                graph.num_messages, graph.num_edges, plan=plan,
+            )
             m.emit(
                 "plan_build", op="lpa_superstep", seconds=round(secs, 6),
-                cached=cached, **plan_build_stats(plan, graph.num_edges),
+                cached=cached, cost=current["cost"].record(),
+                **plan_build_stats(plan, graph.num_edges),
             )
             current["chunk_size"] = graph.num_vertices
             step = jax.jit(lpa_superstep_bucketed)
@@ -839,6 +913,10 @@ def _run_lpa(
                              "built by run_pipeline (wants_plan)")
         current["chunk_size"] = graph.num_vertices
         plan = plan_holder[0]
+        current["cost"] = superstep_cost(
+            "lpa_superstep", "auto", graph.num_vertices,
+            graph.num_messages, graph.num_edges, plan=plan,
+        )
         step = jax.jit(
             lpa_superstep_blocked if isinstance(plan, BlockedPlan)
             else lpa_superstep_bucketed
@@ -994,6 +1072,11 @@ def _run_lpa(
                 "graphmine_devices_alive",
                 "devices in the active LPA mesh",
             ).set(nd)
+            # A rung entry (or retry re-entry) starts a fresh timing
+            # window: a window must never mix supersteps from two
+            # operating points — the cost model it is judged against is
+            # per-point.
+            wtimer.reset()
             while state["it"] < config.max_iter:
                 it = state["it"]
 
@@ -1012,6 +1095,7 @@ def _run_lpa(
                 # TraceAnnotation names the XLA profiler slice after the
                 # span path, lining device traces up with the span tree.
                 with m.span("superstep", emit=False, iteration=it + 1):
+                    was_warm = key in warmed
                     t0 = time.perf_counter()
                     # Watchdog contract: checkpoint-then-abort. On a hung
                     # superstep the LAST GOOD labels (iteration `it`) are
@@ -1021,7 +1105,7 @@ def _run_lpa(
                     # see ``warmed`` above.
                     new = resilience.run_with_watchdog(
                         "lpa_superstep", step_sync,
-                        policy.superstep_timeout_s if key in warmed else None,
+                        policy.superstep_timeout_s if was_warm else None,
                         m,
                         # no hook at all without a checkpoint_dir: the
                         # timeout message/record must not claim a
@@ -1033,6 +1117,14 @@ def _run_lpa(
                     )
                     dt = time.perf_counter() - t0
                     warmed.add(key)
+                    if was_warm:
+                        # the compile-bearing first superstep of an
+                        # operating point is excluded from the timing
+                        # window, exactly like the watchdog above — a
+                        # compile-dominated window would read far below
+                        # model on healthy hardware, the false positive
+                        # the roofline flag exists to avoid
+                        wtimer.add(dt)
                     # Cadence (r3): every Nth superstep, plus always the
                     # final one so a completed run's checkpoint is never
                     # stale.
@@ -1063,6 +1155,13 @@ def _run_lpa(
                             m, new, state["labels"],
                             current.get("chunk_size") or graph.num_vertices,
                             nd, var, it + 1,
+                        )
+                        # superstep_timing rides the same cadence: the
+                        # window since the last boundary, judged against
+                        # this operating point's cost model (ISSUE 12).
+                        wtimer.flush(
+                            m, "lpa_superstep", current.get("cost"),
+                            it + 1, graph.num_edges, variant=var,
                         )
                     else:
                         changed = int((new != state["labels"]).sum())

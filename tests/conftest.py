@@ -138,6 +138,16 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "perf: compute-plane performance-observability suite "
+        "(tests/test_costmodel.py: analytical cost model exact against "
+        "hand-computed plans, superstep_timing achieved-vs-model "
+        "attribution e2e, bench_diff regression gate + trajectory "
+        "self-check over the committed BENCH_*.json, the silicon-capture "
+        "manifest, obs_report roofline section); runs in the default CPU "
+        "pass — select with -m perf or tools/run_tier1.sh --perf-only",
+    )
+    config.addinivalue_line(
+        "markers",
         "slo: serving-SLO observability suite (tests/test_slo.py: "
         "bucket histograms + merge associativity, live /metrics and "
         "/statusz under the query hammer, quantile agreement vs the "

@@ -15,6 +15,10 @@ dir):
   seconds, edges/sec/chip with a trend bar);
 - **superstep telemetry**: frontier size and per-shard load-imbalance
   ratios at the tripwire/checkpoint cadence;
+- the **roofline** section (ISSUE 12): achieved-vs-cost-model throughput
+  per ``superstep_timing`` window, with an achieved-fraction column and
+  loud flags on windows below ``--roofline-min-frac`` of model — the
+  triage step RUNBOOKS §12 offers before "blame the device";
 - the **recovery timeline**: every retry / degrade / mesh_degrade /
   tripwire / watchdog_timeout / checkpoint rollback / resume, in causal
   order, each with its span path — *which* incident hit *which* phase on
@@ -177,7 +181,9 @@ def _phase_waterfall(records, t0):
         )
     # implementation selections (r6): which kNN family the LOF phase
     # actually deployed (the auto-policy's measured-crossover decision)
-    # belongs next to the waterfall bar it explains.
+    # belongs next to the waterfall bar it explains — WITH the deciding
+    # crossover constants and the model's numbers (ISSUE 12 small fix:
+    # a policy flip must be explainable from the JSONL alone).
     for r in records:
         if r.get("phase") == "impl_selected":
             out.append(
@@ -185,6 +191,9 @@ def _phase_waterfall(records, t0):
                 f" (n={r.get('n', '?')}, k={r.get('k', '?')}) — "
                 f"{r.get('reason', '')}"
             )
+            extra = _decision_evidence(r)
+            if extra:
+                out.append(f"      {extra}")
     # plan builds (r7): the host cost of materializing a superstep plan
     # (bins/buckets + padded slots/edge) — visible here instead of
     # hiding inside first-call latency.
@@ -198,7 +207,41 @@ def _phase_waterfall(records, t0):
                 f"classes={r.get('width_classes', '?')}, "
                 f"slots/edge={r.get('padded_slots_per_edge', '?')}"
             )
+            extra = _decision_evidence(r, thresholds=False)
+            if extra:
+                out.append(f"      {extra}")
     return out
+
+
+def _decision_evidence(r, thresholds: bool = True) -> str:
+    """The crossover constants + cost-model numbers an auto decision
+    shipped (ISSUE 12): rendered under its waterfall line so "why did
+    the policy flip" never requires repo state — older streams without
+    the keys render nothing."""
+    def num(v, spec=","):
+        return format(v, spec) if isinstance(v, (int, float)) else str(v)
+
+    bits = []
+    thr = r.get("thresholds")
+    if thresholds and isinstance(thr, dict):
+        bits.append(
+            "thresholds: "
+            + ", ".join(f"{k}={num(v)}" for k, v in sorted(thr.items()))
+        )
+    cost = r.get("cost")
+    if isinstance(cost, dict):
+        bits.append(
+            f"model: {num(cost.get('predicted_per_chip', 0), ',.0f')} "
+            f"{cost.get('unit', '?')} "
+            f"(padded x{cost.get('padding_overhead', '?')}, "
+            f"{num(cost.get('bytes_gathered', 0))} B gathered"
+            + (
+                f", {num(cost.get('exchange_bytes', 0))} B ICI"
+                if cost.get("exchange_bytes") else ""
+            )
+            + ")"
+        )
+    return "  ".join(bits)
 
 
 def _superstep_table(records):
@@ -213,6 +256,80 @@ def _superstep_table(records):
             f"  {r.get('iteration', '?'):>2}  {r.get('labels_changed', 0):>7}"
             f"  {r.get('seconds', 0):>8.4f}  {eps:>14,}  {_bar(eps / peak, 20)}"
         )
+    return out
+
+
+def _roofline_section(records, min_frac: float):
+    """Achieved-vs-model roofline attribution (ISSUE 12): one row per
+    ``superstep_timing`` window — achieved edges/s/chip next to the
+    analytical cost model's prediction, with the achieved fraction and a
+    loud flag on windows below ``min_frac`` of model (the RUNBOOKS §12
+    "read this before blaming the device" signal). The exchange-vs-
+    compute split comes from the window's cost sub-record. Empty list =
+    no superstep_timing records (pre-ISSUE-12 stream)."""
+    timings = [r for r in records if r.get("phase") == "superstep_timing"]
+    if not timings:
+        return []
+    out = [
+        "  op               it  win  family/variant     "
+        "achieved/chip      model/chip   frac"
+    ]
+    flagged = 0
+    for r in timings:
+        frac = float(r.get("achieved_fraction", 0.0) or 0.0)
+        # a window that paid an XLA trace+compile (the ops seams mark
+        # it) reads far below model on healthy hardware — report the
+        # honest number, but never raise the triage flag on it
+        cold = bool(r.get("cold_compile"))
+        below = frac < min_frac and not cold
+        flagged += below
+        fam = f"{r.get('family', '?')}/{r.get('variant', '?')}"
+        if int(r.get("devices", 1) or 1) > 1:
+            fam += f"@{r['devices']}dev"
+        note = ""
+        if below:
+            note = f"  << below {min_frac:g}x model"
+        elif cold and frac < min_frac:
+            note = "  (window includes XLA compile — not flagged)"
+        out.append(
+            f"  {str(r.get('op', '?')):<15} {r.get('iteration', '?'):>3}"
+            f"  {r.get('window', '?'):>3}  {fam:<17}"
+            f"  {int(r.get('edges_per_sec_per_chip', 0) or 0):>13,}"
+            f"  {int(r.get('predicted_edges_per_sec_per_chip', 0) or 0):>14,}"
+            f"  {frac:>5.2f}{note}"
+        )
+        cost = r.get("cost")
+        if isinstance(cost, dict) and cost.get("exchange_bytes"):
+            cs = float(cost.get("compute_seconds", 0.0) or 0.0)
+            es = float(cost.get("exchange_seconds", 0.0) or 0.0)
+            tot = (cs + es) or 1.0
+            out.append(
+                f"      model split: compute {100 * cs / tot:.0f}% / "
+                f"exchange {100 * es / tot:.0f}% "
+                f"({cost['exchange_bytes']:,} B ICI per superstep)"
+            )
+    if flagged:
+        out.append(
+            f"  {flagged} window(s) below {min_frac:g}x of model — read "
+            "the telemetry/imbalance tables above before blaming the "
+            "device (docs/RUNBOOKS.md §12)"
+        )
+    roof = next(
+        (
+            r["cost"]["roofline"] for r in reversed(timings)
+            if isinstance(r.get("cost"), dict)
+            and isinstance(r["cost"].get("roofline"), dict)
+        ),
+        None,
+    )
+    if roof:
+        anchors = ", ".join(
+            f"{k}={v:,.3g}" for k, v in sorted(roof.items())
+            if isinstance(v, (int, float))
+        )
+        out.append(f"  model anchors: {anchors}")
+        if roof.get("provenance"):
+            out.append(f"  anchor provenance: {roof['provenance']}")
     return out
 
 
@@ -656,7 +773,10 @@ def _fleet_trace_section(records, max_traces: int = 4):
     return lines
 
 
-def build_report(records, source: str = "", bad_lines: int = 0) -> str:
+def build_report(
+    records, source: str = "", bad_lines: int = 0,
+    roofline_min_frac: float = 0.5,
+) -> str:
     """Render one run's records (already filtered to a single run_id)."""
     start = next((r for r in records if r.get("phase") == "run_start"), None)
     t0 = records[0].get("t", 0.0) if records else 0.0
@@ -699,6 +819,11 @@ def build_report(records, source: str = "", bad_lines: int = 0) -> str:
     lines.append("")
     lines.append("-- superstep telemetry (load imbalance) --")
     lines.extend(_telemetry_table(records))
+    roofline = _roofline_section(records, roofline_min_frac)
+    if roofline:  # pre-ISSUE-12 streams carry no superstep_timing
+        lines.append("")
+        lines.append("-- roofline (achieved vs cost model) --")
+        lines.extend(roofline)
     serving = _serving_table(records, t0)
     if serving:  # serving is opt-in; batch-only streams skip the section
         lines.append("")
@@ -744,6 +869,10 @@ def main(argv=None) -> int:
     ap.add_argument("--lenient", action="store_true",
                     help="note schema/trace-stamping violations instead "
                     "of failing with exit code 3")
+    ap.add_argument("--roofline-min-frac", type=float, default=0.5,
+                    help="flag superstep_timing windows whose achieved "
+                    "throughput is below this fraction of the cost "
+                    "model (default 0.5)")
     args = ap.parse_args(argv)
     if os.path.isdir(args.metrics):
         # A fleet --obs-dir: merge every process shard into ONE report
@@ -786,7 +915,10 @@ def main(argv=None) -> int:
             f"(have: {', '.join(order)})", file=sys.stderr,
         )
         return 2
-    report = build_report(runs[rid], source=args.metrics, bad_lines=bad)
+    report = build_report(
+        runs[rid], source=args.metrics, bad_lines=bad,
+        roofline_min_frac=args.roofline_min_frac,
+    )
     if len(order) > 1:
         report += (f"\n({len(order)} runs in this file: "
                    + ", ".join(order) + ")\n")

@@ -7,7 +7,15 @@
 # (-m faults: tests/test_resilience.py + the tripwire/reshard cases in
 # tests/test_sharded.py) is part of this default pass.
 #
-# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only|--slo-only|--blocking-only|--admission-only|--fleet-only|--wal-only] [extra pytest args...]
+# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only|--slo-only|--blocking-only|--admission-only|--fleet-only|--wal-only|--trace-only|--perf-only] [extra pytest args...]
+#   --perf-only    run just the `perf`-marked compute-plane performance-
+#                  observability suite (tests/test_costmodel.py: the
+#                  analytical cost model exact against hand-computed
+#                  plans, superstep_timing achieved-vs-model e2e,
+#                  bench_diff gate + the trajectory self-check over the
+#                  committed BENCH_r01–r05 files, bench.py
+#                  --list-missing) — the fast slice when iterating on
+#                  obs/costmodel.py or tools/bench_diff.py
 #   --faults-only  run just the `faults`-marked recovery suite — the fast
 #                  pre-commit loop when iterating on resilience paths
 #   --obs-only     run just the `obs`-marked tracing/telemetry suite
@@ -87,6 +95,9 @@ elif [ "${1:-}" = "--wal-only" ]; then
 elif [ "${1:-}" = "--trace-only" ]; then
     shift
     MARKER='trace and not slow'
+elif [ "${1:-}" = "--perf-only" ]; then
+    shift
+    MARKER='perf and not slow'
 fi
 
 LOG="${TIER1_LOG:-/tmp/_t1.log}"

@@ -48,6 +48,14 @@ _EMIT_RE = re.compile(
     r"\b(?:emit|timed|_emit)\(\s*[\"']([A-Za-z_][A-Za-z0-9_]*)[\"']"
 )
 
+# Inline cost sub-record construction (ISSUE 12): the `cost` payload has
+# ONE builder — obs/costmodel.CostEstimate.record(), whose shape the
+# runtime validator pins against schema.COST_KEYS. A hand-rolled
+# `cost={...}` / `cost=dict(...)` at an emit site would drift from that
+# shape silently on cold paths, exactly the rot this lint exists for.
+_INLINE_COST_RE = re.compile(r"\bcost\s*=\s*(?:\{|dict\()")
+_COST_OWNER = os.path.join("graphmine_tpu", "obs", "costmodel.py")
+
 PACKAGE_DIR = os.path.join(_REPO, "graphmine_tpu")
 
 
@@ -70,15 +78,48 @@ def scan(root: str = PACKAGE_DIR) -> list:
     return found
 
 
+def scan_inline_costs(root: str = PACKAGE_DIR) -> list:
+    """``(file, line)`` pairs of inline ``cost={...}``/``cost=dict(...)``
+    literals outside the single builder (obs/costmodel.py)."""
+    found = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, _REPO)
+            if rel == _COST_OWNER:
+                continue
+            with open(path) as f:
+                lines = f.readlines()
+            for i, raw in enumerate(lines, 1):
+                # crude comment strip: good enough for a kwarg lint (a
+                # '#' inside a string arg would hide a same-line match,
+                # which no real emit call shape does)
+                code = raw.split("#", 1)[0]
+                if _INLINE_COST_RE.search(code):
+                    found.append((rel, i))
+    return found
+
+
 def violations(root: str = PACKAGE_DIR) -> list:
-    """Emitted-but-unregistered phases: list of human-readable strings
-    (empty = clean). The tier-1 test asserts on this."""
-    return [
+    """Emitted-but-unregistered phases plus inline cost sub-records:
+    list of human-readable strings (empty = clean). The tier-1 test
+    asserts on this."""
+    out = [
         f"{path}:{line}: phase {phase!r} is emitted but not registered "
         "in graphmine_tpu/obs/schema.py"
         for phase, path, line in scan(root)
         if phase not in SCHEMAS
     ]
+    out.extend(
+        f"{path}:{line}: inline cost=... literal — build cost sub-records "
+        "with graphmine_tpu/obs/costmodel.py (CostEstimate.record()), the "
+        "single shape owner"
+        for path, line in scan_inline_costs(root)
+    )
+    return out
 
 
 def main(argv=None) -> int:
