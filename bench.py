@@ -1104,6 +1104,48 @@ def _serve_writer_failover(tmp, arrays, fp, v):
     }
 
 
+def _serve_quality_pass(rng):
+    """The serve tier's quality_pass sub-record (ISSUE 13): publish-time
+    quality-pass seconds at three graph sizes — the bounded-cost proof
+    for the per-publish result-quality pass (state sketches + drift vs
+    parent + the frozen canary probe re-score). Host-side numbers,
+    honest without silicon; the canary's one-time scorer compile is
+    warmed OUTSIDE the timed windows (steady-state shape: a long-lived
+    writer compiles once per process)."""
+    from graphmine_tpu.obs.quality import CanaryProbe, run_quality_pass
+
+    canary = CanaryProbe.generate(seed=7)
+    canary.score()  # warm the LOF compile outside the timed windows
+    sizes = (1 << 14, 1 << 17, 1 << 20)
+    if _CPU_FALLBACK:
+        sizes = (1 << 12, 1 << 14, 1 << 16)
+    rows = []
+    for v in sizes:
+        n_comm = max(16, v >> 7)
+        parent_labels = rng.integers(0, n_comm, v).astype(np.int32)
+        parent_lof = rng.gamma(2.0, 0.6, v).astype(np.float32)
+        # a ~1% churned child: the drift path does real work, not the
+        # all-buckets-equal fast case
+        labels = parent_labels.copy()
+        idx = rng.integers(0, v, max(8, v // 100))
+        labels[idx] = rng.integers(0, n_comm, len(idx)).astype(np.int32)
+        lof = parent_lof.copy()
+        lof[idx] += 1.0
+        t0 = time.perf_counter()
+        rep = run_quality_pass(
+            labels, lof, 2, parent_labels=parent_labels,
+            parent_lof=parent_lof, parent_version=1, canary=canary,
+        )
+        rows.append({
+            "num_vertices": int(v),
+            "pass_seconds": round(time.perf_counter() - t0, 4),
+            "canary_seconds": rep.canary["seconds"],
+            "canary_recall": rep.canary["recall_at_k"],
+            "churn_frac": rep.drift["churn_frac"],
+        })
+    return {"sizes": rows}
+
+
 def main_serve() -> None:
     """Serving tier (r7, docs/SERVING.md): the steady-state numbers the
     serve/ subsystem exists for — query resolve throughput (single-vertex
@@ -1277,6 +1319,11 @@ def main_serve() -> None:
         # CPU-fallback order too — durability numbers are host-side and
         # honest without silicon.
         writer_failover = _serve_writer_failover(tmp, arrays, fp, v)
+
+        # result-quality pass cost at three graph sizes (ISSUE 13): the
+        # bounded-cost claim for the per-publish quality pass, tracked
+        # by bench_diff's manifest + regression gate.
+        quality_pass = _serve_quality_pass(rng)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -1322,6 +1369,8 @@ def main_serve() -> None:
                     "replicated_read": replicated_read,
                     # WAL durability + fenced failover numbers (r11)
                     "writer_failover": writer_failover,
+                    # per-publish quality-pass cost ladder (ISSUE 13)
+                    "quality_pass": quality_pass,
                     "device": str(jax.devices()[0]),
                 },
             }

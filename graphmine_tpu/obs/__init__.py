@@ -20,9 +20,18 @@ builds on it, not the other way around):
 - :mod:`graphmine_tpu.obs.costmodel`  the analytical compute-plane cost
   model (r13): per-plan bytes/slots/exchange derivation, measured
   roofline anchors, the ``cost`` sub-record builder and the
-  ``superstep_timing`` achieved-vs-model emission.
+  ``superstep_timing`` achieved-vs-model emission;
+- :mod:`graphmine_tpu.obs.sketch`     mergeable quantile sketches over
+  fixed log ladders (the ``Histogram.merge`` contract applied to LOF
+  scores and community sizes) + the PSI drift distance;
+- :mod:`graphmine_tpu.obs.quality`    the result-quality plane (r14):
+  per-publish quality state, snapshot-diff drift, the planted-anomaly
+  canary probe and the ``quality_*``/``canary_score`` record emission;
+- :mod:`graphmine_tpu.obs.alerts`     the declarative threshold +
+  for-duration alert rule engine behind ``/alertz``.
 """
 
+from graphmine_tpu.obs.alerts import AlertManager, AlertRule, default_rules
 from graphmine_tpu.obs.costmodel import (
     CostEstimate,
     lof_cost,
@@ -31,7 +40,13 @@ from graphmine_tpu.obs.costmodel import (
     superstep_cost,
 )
 from graphmine_tpu.obs.histogram import Histogram, HistogramFamily
+from graphmine_tpu.obs.quality import (
+    CanaryProbe,
+    QualityState,
+    run_quality_pass,
+)
 from graphmine_tpu.obs.registry import Registry
+from graphmine_tpu.obs.sketch import QuantileSketch, log_ladder, psi_distance
 from graphmine_tpu.obs.spans import (
     TRACE_HEADER,
     Span,
@@ -41,17 +56,26 @@ from graphmine_tpu.obs.spans import (
 )
 
 __all__ = [
+    "AlertManager",
+    "AlertRule",
+    "CanaryProbe",
     "CostEstimate",
     "Histogram",
     "HistogramFamily",
+    "QualityState",
+    "QuantileSketch",
     "Registry",
     "Span",
     "TRACE_HEADER",
     "TraceContext",
     "Tracer",
+    "default_rules",
     "lof_cost",
+    "log_ladder",
     "new_run_id",
+    "psi_distance",
     "rooflines",
+    "run_quality_pass",
     "sharded_superstep_cost",
     "superstep_cost",
 ]

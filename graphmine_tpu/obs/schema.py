@@ -157,6 +157,28 @@ register("ship_lag", "lag_entries", "lag_s")
 register("delta_stages", "version", "stages")
 register("delta_visible", "replica", "version", "seconds")
 
+# ---- result-quality observability (docs/OBSERVABILITY.md "Result
+# quality") -----------------------------------------------------------------
+# quality_snapshot: one per snapshot publish — the published result
+# distributions (LOF score + community-size sketches, anomaly rate,
+# census scalars) from the bounded host-side quality pass
+# (obs/quality.run_quality_pass); quality_drift: the snapshot-over-
+# parent comparison (partition-matched churn, PSI sketch drift, id-chain
+# community births/deaths); canary_score: the frozen planted-anomaly
+# probe re-scored through the production LOF scorer — recall@k dropping
+# between publishes is a scorer regression by construction; alert: one
+# per firing/resolved transition of an obs/alerts.py rule.
+register("quality_snapshot", "version", "num_vertices", "num_communities",
+         "anomaly_rate", "lof_threshold", "lof_sketch", "size_sketch",
+         "seconds")
+register("quality_drift", "version", "parent_version", "churn_frac",
+         "new_communities", "dissolved_communities", "lof_psi",
+         "size_psi", "anomaly_rate_delta")
+register("canary_score", "version", "recall_at_k", "recall_k",
+         "mean_rank_frac", "num_anomalies", "k")
+register("alert", "name", "state", "severity", "metric", "value",
+         "threshold")
+
 # ---- recovery / resilience records (docs/RESILIENCE.md) -------------------
 register("retry", "stage", "attempt", "backoff_s", "error")
 register("retries_exhausted", "stage", "attempts", "error")
@@ -193,6 +215,14 @@ COST_KEYS = frozenset((
     "predicted_per_chip", "unit", "roofline",
 ))
 
+# The sketch sub-record shape (obs/sketch.QuantileSketch.to_state — the
+# single builder; tools/schema_lint.py flags inline *_sketch={...}
+# literals elsewhere). Same all-or-nothing rule as `cost`: a record
+# carrying a `*_sketch` dict must carry every key below, or the quality
+# tooling (obs_report's quality timeline, the router's counter-wise
+# merge) would silently drop or mis-merge the distribution.
+SKETCH_KEYS = frozenset(("bounds", "counts", "sum", "count"))
+
 
 def validate_record(rec) -> list:
     """Problems with one record (empty list = valid)."""
@@ -220,6 +250,23 @@ def validate_record(rec) -> list:
         problems.append(
             f"{phase}: partial trace identity (has {present}, lacks {absent})"
         )
+    for key in rec:
+        if not key.endswith("_sketch"):
+            continue
+        sk = rec[key]
+        if not isinstance(sk, dict):
+            problems.append(
+                f"{phase}: {key} sub-record is {type(sk).__name__}, not "
+                "dict — build it with obs/sketch QuantileSketch.to_state()"
+            )
+        else:
+            missing = sorted(k for k in SKETCH_KEYS if k not in sk)
+            if missing:
+                problems.append(
+                    f"{phase}: half-stamped {key} sub-record (missing "
+                    f"{missing}) — build it with obs/sketch "
+                    "QuantileSketch.to_state()"
+                )
     if "cost" in rec:
         cost = rec["cost"]
         if not isinstance(cost, dict):

@@ -1,0 +1,206 @@
+"""Mergeable quantile sketches over fixed log ladders + drift distance.
+
+The SLO layer answers "how fast" with bucket histograms over a fixed
+latency ladder (``obs/histogram.py``); this module answers "what are we
+*serving*" the same way: a :class:`QuantileSketch` is a bucket histogram
+whose ladder is log-spaced over a VALUE domain — LOF outlier scores,
+community sizes — instead of seconds. Reusing the histogram machinery is
+the point, not a convenience:
+
+- **mergeable**: sketches over one ladder add counter-wise
+  (``Histogram.merge`` — associative and commutative), so per-replica
+  sketches roll up into a fleet view exactly like latency histograms
+  (pinned by ``tests/test_quality.py`` mirroring the r11 merge suite);
+- **JSON-portable**: :meth:`QuantileSketch.to_state` /
+  :meth:`QuantileSketch.from_state` round-trip through records and HTTP
+  bodies, so the router can merge sketches it fetched from replicas and
+  ``obs_report`` can re-plot a distribution from the JSONL alone;
+- **comparable**: :func:`psi_distance` is a ladder-aligned population-
+  stability-index drift distance between two sketches — THE
+  snapshot-over-snapshot drift number the quality plane alerts on.
+  Ladder alignment is a hard precondition (mismatched ladders raise,
+  same as ``Histogram.merge``): re-binning would fabricate a drift
+  neither snapshot exhibits.
+
+Fixed ladders (not data-dependent quantile summaries like t-digest) are
+a deliberate trade: slightly coarser tails for *exact* mergeability and
+an exact, hand-computable drift formula — the same trade the latency
+histograms already made. Stdlib-only, like everything in ``obs/``.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+from graphmine_tpu.obs.histogram import Histogram
+
+__all__ = [
+    "DEFAULT_SCORE_LADDER",
+    "DEFAULT_SIZE_LADDER",
+    "PSI_EPS",
+    "QuantileSketch",
+    "env_float",
+    "log_ladder",
+    "psi_distance",
+]
+
+
+def env_float(name: str, default: float) -> float:
+    """The quality plane's one env-parsing discipline (shared by
+    ``obs/quality.py`` thresholds and ``obs/alerts.py`` rule defaults —
+    the AdmissionBounds contract): absent = default, malformed raises
+    loudly at construction, never a silent fallback."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError as e:
+        raise ValueError(f"{name}={raw!r} is not a float") from e
+
+
+def log_ladder(lo: float, hi: float, steps_per_octave: int = 1) -> tuple:
+    """Geometric bucket bounds from ``lo`` to at least ``hi``:
+    ``lo * 2**(i / steps_per_octave)``. Values at or below ``lo`` land in
+    the first bucket; values above the last bound land in the implicit
+    overflow bucket (the histogram's +Inf)."""
+    lo, hi = float(lo), float(hi)
+    if lo <= 0 or hi <= lo:
+        raise ValueError(f"need 0 < lo < hi (got lo={lo}, hi={hi})")
+    if steps_per_octave < 1:
+        raise ValueError("steps_per_octave must be >= 1")
+    n = math.ceil(math.log2(hi / lo) * steps_per_octave)
+    return tuple(lo * 2 ** (i / steps_per_octave) for i in range(n + 1))
+
+
+# LOF scores cluster tightly around 1.0 (the inlier fixed point) with an
+# outlier tail of a few tens: quarter-octave resolution from 1/16 to 64
+# keeps the bulk of the distribution out of any single bucket, so a
+# drifting scorer moves probability mass between buckets instead of
+# hiding inside one.
+DEFAULT_SCORE_LADDER = log_ladder(0.0625, 64.0, steps_per_octave=4)
+
+# Community sizes are long-tailed over decades: whole-octave (power-of-
+# two) buckets from 1 to 2^30 — the census's natural resolution, and the
+# ladder the recursive-LPA size-decile machinery already thinks in.
+DEFAULT_SIZE_LADDER = log_ladder(1.0, float(1 << 30), steps_per_octave=1)
+
+# Probability floor for the PSI log-ratio: an empty bucket on one side
+# must contribute a LARGE but finite term, not an infinite one.
+PSI_EPS = 1e-4
+
+
+class QuantileSketch(Histogram):
+    """A value-domain bucket histogram over one fixed log ladder.
+
+    Inherits the whole histogram contract — thread-safe ``observe``,
+    atomic ``snapshot``, counter-wise ``merge`` (ladder-checked),
+    interpolated ``quantile`` — and adds bulk ingestion
+    (:meth:`add_counts`: the quality pass bins a whole label/score array
+    with one vectorized host pass, then deposits the counts here) and a
+    JSON state round-trip for records and cross-process merges.
+    """
+
+    def __init__(self, name: str = "sketch", help: str = "",
+                 buckets=DEFAULT_SCORE_LADDER, labels: dict | None = None):
+        super().__init__(name, help, buckets, labels=labels)
+
+    def add_counts(self, counts, total: float = 0.0) -> "QuantileSketch":
+        """Deposit pre-binned counts: ``counts`` has one entry per finite
+        bound plus the overflow bucket (``len(bounds) + 1``), the shape
+        :meth:`to_state` emits. ``total`` accrues into the running sum
+        (pass the values' sum when quantile interpolation should stay
+        meaningful; 0.0 when only the distribution matters)."""
+        counts = [int(c) for c in counts]
+        if len(counts) != len(self._bounds) + 1:
+            raise ValueError(
+                f"counts has {len(counts)} buckets for a "
+                f"{len(self._bounds)}-bound ladder (+1 overflow)"
+            )
+        if any(c < 0 for c in counts):
+            raise ValueError("bucket counts must be non-negative")
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += c
+            self._sum += float(total)
+        return self
+
+    def to_state(self) -> dict:
+        """One JSON-ready atomic read: the record/HTTP wire shape
+        (``bounds``/``counts``/``sum``/``count``) the schema registry
+        validates all-or-nothing (``SKETCH_KEYS``) and
+        :meth:`from_state` reconstructs exactly."""
+        snap = self.snapshot()
+        return {
+            "bounds": [float(b) for b in snap.bounds],
+            "counts": [int(c) for c in snap.counts],
+            "sum": float(snap.sum),
+            "count": int(snap.count),
+        }
+
+    @classmethod
+    def from_state(cls, state: dict, name: str = "sketch") -> "QuantileSketch":
+        """Rebuild a sketch from :meth:`to_state` output (a record field,
+        a replica's ``/alertz`` body). Malformed state raises ValueError —
+        a router merging replica sketches must refuse a torn payload, not
+        fold garbage into the fleet view."""
+        try:
+            bounds = tuple(float(b) for b in state["bounds"])
+            counts = [int(c) for c in state["counts"]]
+            total = float(state.get("sum", 0.0))
+            sk = cls(name=name, buckets=bounds)
+            sk.add_counts(counts, total=total)
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"malformed sketch state: {e!r}") from e
+        return sk
+
+
+def _state_of(sketch) -> tuple:
+    """``(bounds, counts)`` of a QuantileSketch/Histogram OR a to_state
+    dict — one normalization so :func:`psi_distance` accepts either."""
+    if isinstance(sketch, Histogram):
+        snap = sketch.snapshot()
+        return tuple(snap.bounds), list(snap.counts)
+    try:
+        return (
+            tuple(float(b) for b in sketch["bounds"]),
+            [int(c) for c in sketch["counts"]],
+        )
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(f"malformed sketch state: {e!r}") from e
+
+
+def psi_distance(a, b, eps: float = PSI_EPS) -> float:
+    """Population stability index between two sketches on ONE ladder.
+
+    ``PSI = sum_i (p_i - q_i) * ln(p_i / q_i)`` over every bucket
+    (overflow included), with per-bucket proportions floored at ``eps``
+    so an empty bucket contributes a large finite term instead of an
+    infinite one. Symmetric, zero iff the proportions agree, and exactly
+    hand-computable (the ``tests/test_quality.py`` pin). The usual
+    reading: < 0.1 stable, 0.1-0.25 drifting, > 0.25 shifted — the
+    default alert thresholds in ``obs/alerts.py`` follow it.
+
+    Either side may be a :class:`QuantileSketch` or a ``to_state`` dict.
+    Mismatched ladders raise (the ``Histogram.merge`` refusal applied to
+    comparison): re-binning would fabricate drift. Two empty sketches
+    are identically distributed (0.0); one empty side is maximal drift
+    over every occupied bucket.
+    """
+    bounds_a, counts_a = _state_of(a)
+    bounds_b, counts_b = _state_of(b)
+    if bounds_a != bounds_b:
+        raise ValueError(
+            f"cannot compare sketches with different ladders "
+            f"({len(bounds_a)} vs {len(bounds_b)} bounds)"
+        )
+    tot_a, tot_b = sum(counts_a), sum(counts_b)
+    if tot_a == 0 and tot_b == 0:
+        return 0.0
+    psi = 0.0
+    for ca, cb in zip(counts_a, counts_b):
+        p = max(ca / tot_a if tot_a else 0.0, eps)
+        q = max(cb / tot_b if tot_b else 0.0, eps)
+        psi += (p - q) * math.log(p / q)
+    return psi

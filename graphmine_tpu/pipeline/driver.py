@@ -639,15 +639,61 @@ def _publish_snapshot(config: PipelineConfig, result: PipelineResult, m: Metrics
             # with unweighted supersteps would silently change semantics).
             arrays["weights"] = np.asarray(table.weights, np.float32)
         store = SnapshotStore(config.snapshot_out)
-        return store.publish(
+        # Result-quality plane (ISSUE 13, docs/OBSERVABILITY.md "Result
+        # quality"): a driver publish is the version chain's first link —
+        # seed/readopt the canary probe so the serving writer scores the
+        # SAME frozen probe, read the parent's result columns for drift,
+        # and emit quality_snapshot/quality_drift/canary_score in the
+        # publishing trace. GRAPHMINE_QUALITY=0 disables; failures are
+        # telemetry-only and must never fail the publish phase.
+        quality_on = os.environ.get("GRAPHMINE_QUALITY", "1") != "0"
+        parent_arrays, parent_meta, canary = {}, {}, None
+        if quality_on:
+            from graphmine_tpu.obs.quality import CanaryProbe
+
+            try:
+                peeked = store.peek_arrays(
+                    ("labels", "lof", "canary_features", "canary_is_anomaly")
+                )
+                if peeked is not None:
+                    parent_arrays, parent_meta = peeked
+                canary = CanaryProbe.from_arrays(parent_arrays, parent_meta)
+                if canary is None:
+                    canary = CanaryProbe.generate(
+                        seed=int(os.environ.get("GRAPHMINE_CANARY_SEED", "0"))
+                    )
+                arrays.update(canary.arrays())
+            except Exception as e:  # noqa: BLE001 — telemetry only
+                m.emit("warning", message=f"canary probe unavailable: {e!r}")
+                canary = None
+        snap = store.publish(
             arrays,
             fingerprint=ckpt.graph_fingerprint(
                 table.src, table.dst, table.weights
             ),
             run_id=m.tracer.run_id if m.tracer is not None else "",
             mesh_shape=[n_dev],
+            extra_meta={"canary": canary.meta()} if canary is not None
+            else None,
             sink=m,
         )
+        if quality_on:
+            from graphmine_tpu.obs.quality import run_quality_pass
+
+            try:
+                run_quality_pass(
+                    arrays["labels"], arrays.get("lof"), snap.version,
+                    parent_labels=parent_arrays.get("labels"),
+                    parent_lof=parent_arrays.get("lof"),
+                    parent_version=parent_meta.get("version"),
+                    canary=canary, sink=m, registry=m.registry,
+                )
+            except Exception as e:  # noqa: BLE001 — telemetry only: the
+                # publish already COMMITTED; raising here would hand a
+                # succeeded publish to run_phase as a failure and a
+                # retry would publish a duplicate version
+                m.emit("warning", message=f"quality pass failed: {e!r}")
+        return snap
 
     with m.span("snapshot_publish"):
         resilience.run_phase(

@@ -44,6 +44,7 @@ centers, so each delta scores only the affected vertices' features.
 from __future__ import annotations
 
 import math
+import os
 import threading
 import time
 from collections import deque
@@ -865,6 +866,7 @@ class DeltaIngestor:
         snapshot: Snapshot | None = None,
         debt: RepairDebt | None = None,
         epoch: int | None = None,
+        quality: bool | None = None,
     ):
         self.store = store
         self.sink = sink
@@ -932,6 +934,29 @@ class DeltaIngestor:
         # re-scores everything (rare, and the honest recovery).
         self._stale_aff = np.empty(0, np.int64)
         self._stale_all = bool(snap.meta.get("lof_stale", False))
+        # Result-quality plane (ISSUE 13, docs/OBSERVABILITY.md "Result
+        # quality"): every publish runs a bounded host-side quality pass
+        # — census/LOF drift vs the parent (whose labels this ingestor
+        # already holds), sketch states, and the canary probe re-score.
+        # GRAPHMINE_QUALITY=0 (or quality=False) disables the whole
+        # pass; the canary probe persists in the snapshot (the
+        # lof_centers pattern) so every writer in the store's lifetime
+        # scores the SAME frozen probe — a fresh store generates one,
+        # seeded by GRAPHMINE_CANARY_SEED.
+        if quality is None:
+            quality = os.environ.get("GRAPHMINE_QUALITY", "1") != "0"
+        self.quality_enabled = bool(quality)
+        self.last_quality = None       # QualityReport of the last apply
+        self._quality_state = None     # parent state reused next apply
+        self._canary = None
+        if self.quality_enabled:
+            from graphmine_tpu.obs.quality import CanaryProbe
+
+            self._canary = CanaryProbe.from_snapshot(snap)
+            if self._canary is None:
+                self._canary = CanaryProbe.generate(
+                    seed=int(os.environ.get("GRAPHMINE_CANARY_SEED", "0"))
+                )
 
     @property
     def num_vertices(self) -> int:
@@ -1104,6 +1129,13 @@ class DeltaIngestor:
             else _null_ctx()
         )
         with span:
+            # Parent snapshot's result columns, captured BEFORE the
+            # repair overwrites them: the quality pass's drift baseline.
+            # References, not copies — the LOF splice is copy-on-write
+            # and labels are reassigned wholesale, so these stay the
+            # parent's arrays.
+            prev_labels, prev_lof = self.labels, self.lof
+            prev_version = self.snapshot.version
             clean, quarantine = validate_delta(delta, self.num_vertices)
             if self.weights is not None:
                 src2, dst2, w2, v2, stats = splice_edges(
@@ -1148,6 +1180,12 @@ class DeltaIngestor:
                 arrays["weights"] = self.weights
             if self._centers is not None:
                 arrays["lof_centers"] = np.asarray(self._centers, np.float32)
+            if self._canary is not None:
+                # probe identity rides the store (the lof_centers
+                # pattern): a restarted or promoted writer re-scores the
+                # SAME frozen probe, so canary recall is comparable
+                # across the whole version chain
+                arrays.update(self._canary.arrays())
             snap = self.store.publish(
                 arrays,
                 fingerprint=graph_fingerprint(
@@ -1158,11 +1196,57 @@ class DeltaIngestor:
                 extra_meta={
                     **(extra_meta or {}),
                     **({"lof_stale": True} if lof_stale else {}),
+                    **(
+                        {"canary": self._canary.meta()}
+                        if self._canary is not None else {}
+                    ),
                 } or None,
                 sink=self.sink,
                 epoch=self.epoch,
             )
             self.snapshot = snap
+            if self.quality_enabled:
+                # The result-quality pass (ISSUE 13): still inside the
+                # delta_apply span, so quality_snapshot/quality_drift/
+                # canary_score land span-joined to the publishing trace.
+                # Bounded O(V) host work + the tiny frozen canary probe;
+                # its seconds ride the quality_snapshot record (the
+                # bench `quality_pass` sub-record measures the same
+                # pass at three graph sizes).
+                from graphmine_tpu.obs.quality import run_quality_pass
+
+                # The cached state is reusable only when it describes
+                # the ACTUAL parent (a skipped/failed pass leaves it at
+                # an older version — drift vs stale sketches would lie).
+                parent_state = self._quality_state
+                if (
+                    parent_state is not None
+                    and parent_state.version != prev_version
+                ):
+                    parent_state = None
+                try:
+                    report = run_quality_pass(
+                        self.labels, self.lof, snap.version,
+                        parent_labels=prev_labels, parent_lof=prev_lof,
+                        parent_version=prev_version,
+                        parent_state=parent_state,
+                        canary=self._canary,
+                        sink=self.sink,
+                        registry=(
+                            self.sink.registry if self.sink is not None
+                            else None
+                        ),
+                    )
+                    self.last_quality = report
+                    self._quality_state = report.state
+                except Exception as e:  # noqa: BLE001 — telemetry only:
+                    # a quality-pass crash must never fail (or appear to
+                    # fail) a publish that already landed
+                    if self.sink is not None:
+                        self.sink.emit(
+                            "warning",
+                            message=f"quality pass failed: {e!r}",
+                        )
             # Settle the debt ledger BEFORE emitting, so the record's
             # repair_debt snapshot reflects this apply as drained.
             self.debt.applied(

@@ -92,6 +92,7 @@ from urllib.parse import urlparse
 
 from graphmine_tpu.obs.histogram import Histogram
 from graphmine_tpu.obs.registry import Registry
+from graphmine_tpu.obs.sketch import QuantileSketch
 from graphmine_tpu.obs.spans import (
     TRACE_HEADER,
     TraceContext,
@@ -753,12 +754,13 @@ class ReplicaSet:
 # One route table per method (the serve/server.py discipline): the same
 # table resolves the histogram endpoint label and dispatches, so a route
 # can never exist in one place and not the other.
-_PROXY_GET = ("/vertex", "/neighbors", "/topk", "/snapshot")
+_PROXY_GET = ("/vertex", "/explain", "/neighbors", "/topk", "/snapshot")
 _GET_ROUTES = {
     "/healthz": "_ep_healthz",
     "/fleetz": "_ep_fleetz",
     "/statusz": "_ep_statusz",
     "/metrics": "_ep_metrics",
+    "/alertz": "_ep_alertz",
     **{p: "_ep_read" for p in _PROXY_GET},
 }
 _POST_ROUTES = {
@@ -813,6 +815,12 @@ class FleetRouter:
         self._visibility: dict = {}
         self._vis_max = 256            # bounded: old entries expire
         self._vis_expire_s = 600.0
+        # TTL cache of the /alertz quality fan-out (ISSUE 13): one pass
+        # serves /alertz + /statusz + /metrics reads within the window,
+        # and the lock keeps a scrape burst from stampeding replicas.
+        self._alertz_cache: tuple = (-1e9, {})
+        self._alertz_cache_lock = threading.Lock()
+        self._alertz_refreshing = False
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> tuple[str, int]:
@@ -1507,6 +1515,122 @@ class FleetRouter:
         return {**self.replica_set.snapshot(),
                 "config": self.config.snapshot()}
 
+    # -- result quality & alerts (ISSUE 13) --------------------------------
+    def _collect_alertz(self, max_age_s: float = 1.0) -> dict:
+        """Best-effort ``GET /alertz`` fan-out to every not-DOWN
+        replica: per-replica alert/quality payloads keyed by replica id.
+        A replica that fails the call is simply absent (its prober
+        verdict, not this page, owns its health story).
+
+        TTL-cached (``max_age_s``): /alertz, /statusz and /metrics all
+        read through here, and each fan-out is a serial HTTP pass whose
+        per-replica timeout a hung-but-not-yet-DOWN replica can spend in
+        full — a monitoring cycle hitting all three endpoints must cost
+        ONE pass, not three, and a scrape burst must not multiply
+        replica load. The per-replica timeout is the data-plane
+        ``read_timeout_s`` (the quality state is cached on the replica
+        engine — the prober's own /healthz reads already built it), so
+        the worst-case stall is bounded by the same budget as any
+        routed read."""
+        with self._alertz_cache_lock:
+            t_cached, cached = self._alertz_cache
+            if time.monotonic() - t_cached <= max_age_s:
+                return cached
+            if self._alertz_refreshing:
+                # Single-flight: one thread pays the fan-out; everyone
+                # else gets the stale-but-bounded cached view instead of
+                # queueing behind a hung replica's timeout (a sick
+                # replica must not stall every /metrics scrape).
+                return cached
+            self._alertz_refreshing = True
+        out = {}
+        try:
+            for rep in self.replica_set.replicas():
+                if rep.state == DOWN:
+                    continue
+                try:
+                    status, body, _ = self._replica_call(
+                        rep, "GET", "/alertz",
+                        timeout=self.config.read_timeout_s,
+                    )
+                    if status == 200:
+                        out[rep.spec.id] = json.loads(body)
+                except Exception:  # noqa: BLE001 — dead replica, not a 500
+                    continue
+        finally:
+            with self._alertz_cache_lock:
+                self._alertz_cache = (time.monotonic(), out)
+                self._alertz_refreshing = False
+        return out
+
+    @staticmethod
+    def _merge_sketches(payloads: dict, key: str) -> QuantileSketch | None:
+        """Counter-wise merge of one sketch family across replica
+        quality payloads — EXACTLY the ``Histogram.merge`` rollup the
+        latency histograms use (associative, ladder-checked; pinned
+        equal to the by-hand per-replica merge in the quality suite).
+        Mismatched-ladder or torn payloads are skipped, never re-binned.
+        """
+        merged = None
+        for payload in payloads.values():
+            state = (payload.get("quality") or {}).get("state") or {}
+            sk_state = state.get(key)
+            if not sk_state:
+                continue
+            try:
+                sk = QuantileSketch.from_state(sk_state, name=key)
+                if merged is None:
+                    merged = sk
+                else:
+                    merged.merge(sk)
+            except (ValueError, TypeError):
+                continue
+        return merged
+
+    def quality_merged(self, payloads: dict | None = None) -> dict:
+        """The fleet-level quality view: per-replica firing counts plus
+        the counter-wise merged LOF-score and community-size sketches."""
+        if payloads is None:
+            payloads = self._collect_alertz()
+        merged = {}
+        for key in ("lof_sketch", "size_sketch"):
+            sk = self._merge_sketches(payloads, key)
+            if sk is not None:
+                merged[key] = sk.to_state()
+        # No silent truncation: a replica whose /alertz fan-out call
+        # failed (e.g. its first post-swap O(V) quality build outran the
+        # read timeout) is NAMED, so a partial fleet distribution never
+        # reads as a complete one.
+        missing = sorted(
+            rep.spec.id for rep in self.replica_set.replicas()
+            if rep.state != DOWN and rep.spec.id not in payloads
+        )
+        return {
+            **({"replicas_missing": missing} if missing else {}),
+            "replicas": {
+                rid: {
+                    "firing": p.get("firing", 0),
+                    "version": p.get("version"),
+                    "anomaly_rate": (
+                        (p.get("quality") or {}).get("state") or {}
+                    ).get("anomaly_rate"),
+                }
+                for rid, p in payloads.items()
+            },
+            "firing_total": sum(p.get("firing", 0) for p in payloads.values()),
+            "merged": merged,
+        }
+
+    def alertz(self) -> dict:
+        """The router's ``/alertz``: every replica's alert level state
+        plus the fleet-merged quality sketches."""
+        payloads = self._collect_alertz()
+        return {
+            "role": "router",
+            "replicas": payloads,
+            "quality": self.quality_merged(payloads),
+        }
+
     def statusz(self) -> dict:
         """The fleet SLO page, gap-filled in one place (ISSUE 11
         satellite): WAL state + settled ship lag, the current writer
@@ -1546,6 +1670,9 @@ class FleetRouter:
             # snapshot (pending/applied seqs) is the "settled ship lag"
             # numerator the standby's replication lag pairs with.
             "wal": writer.last_health.get("wal"),
+            # fleet-merged result-quality view (ISSUE 13): counter-wise
+            # sketch merge across replicas + per-replica firing counts
+            "quality": self.quality_merged(),
         }
         if rs.standby_id is not None:
             sb = rs.replica(rs.standby_id).last_health
@@ -1568,6 +1695,20 @@ class FleetRouter:
                 f"# HELP {merged.name} {merged.help}",
                 f"# TYPE {merged.name} histogram",
                 *merged.render_lines(extra_labels=labels),
+            ]
+            text += "\n".join(lines) + "\n"
+        # Fleet-merged LOF score distribution (ISSUE 13): the quality
+        # sketch rolled up across replicas rides the scrape as a value-
+        # domain histogram (buckets are LOF score bounds, not seconds).
+        payloads = self._collect_alertz()
+        sk = self._merge_sketches(payloads, "lof_sketch")
+        if sk is not None and sk.count:
+            sk.name = "graphmine_fleet_lof_score_sketch"
+            lines = [
+                f"# HELP {sk.name} fleet-merged LOF score distribution "
+                "(counter-wise quality-sketch merge across replicas)",
+                f"# TYPE {sk.name} histogram",
+                *sk.render_lines(extra_labels=labels),
             ]
             text += "\n".join(lines) + "\n"
         return text
@@ -1671,6 +1812,9 @@ class _FleetHandler(BaseHTTPRequestHandler):
 
     def _ep_statusz(self, url) -> None:
         self._reply_json(200, self.rtr.statusz())
+
+    def _ep_alertz(self, url) -> None:
+        self._reply_json(200, self.rtr.alertz())
 
     def _ep_metrics(self, url) -> None:
         self._send(

@@ -76,6 +76,16 @@ class QueryEngine:
         # pressure, results carry this flag so readers can tell a fresh
         # score from one that predates the latest deltas.
         self.lof_stale = bool(snapshot.meta.get("lof_stale", False))
+        # Result-quality plane (docs/OBSERVABILITY.md "Result quality"):
+        # the anomaly threshold /explain verdicts use, and a lazily-
+        # built-once QualityState (sketches + census scalars) served on
+        # /statusz and /alertz and merged fleet-wide by the router.
+        from graphmine_tpu.obs.quality import lof_threshold
+
+        self._lof_threshold = lof_threshold()
+        self._quality_state = None
+        self._explain_idx = None   # lazy /explain side index
+        self._quality_lock = threading.Lock()
         self.labels = np.asarray(snapshot["labels"], np.int32)
         v = len(self.labels)
         self.num_vertices = v
@@ -153,6 +163,27 @@ class QueryEngine:
     def version(self) -> int:
         return self.snapshot.version
 
+    def quality_state(self, build: bool = True):
+        """This snapshot's :class:`~graphmine_tpu.obs.quality
+        .QualityState`, built ONCE on first read (engines are immutable
+        and die at snapshot swap, so the state can never go stale) —
+        the /statusz "quality" section and the router's fleet-merge
+        source. Lazy: a replica nobody asks never pays the O(V) pass.
+        ``build=False`` returns only an already-built state (else None)
+        — the /healthz alert pass reads through here, and a liveness
+        probe must never be the thing that pays the O(V) build (the
+        probe would time out on exactly the replicas swapping snapshots
+        fastest)."""
+        with self._quality_lock:
+            if self._quality_state is None and build:
+                from graphmine_tpu.obs.quality import QualityState
+
+                self._quality_state = QualityState.from_arrays(
+                    self.labels, self.lof, version=self.version,
+                    threshold=self._lof_threshold,
+                )
+            return self._quality_state
+
     def stage_snapshot(self) -> dict:
         """Accumulated batched-path stage split since this engine was
         built (engines die at snapshot swap, so the window is one served
@@ -206,6 +237,76 @@ class QueryEngine:
         multiplicity kept) — one CSR row slice."""
         vertex = self._check(vertex)
         return self._nbr[self._nbr_ptr[vertex]: self._nbr_ptr[vertex + 1]]
+
+    def _explain_index(self):
+        """Lazily-built-once /explain side index (the quality_state
+        lifecycle: engines are immutable and die at swap): the inverse
+        permutation of the (label asc, LOF desc) vertex order — making
+        rank-in-community one subtraction — and the sorted LOF column
+        for O(log V) percentile lookups. Without it every /explain
+        would scan the full LOF column; a dashboard walking a firing
+        alert's top-k would pay O(kV)."""
+        with self._quality_lock:
+            if self._explain_idx is None:
+                pos = np.empty(self.num_vertices, np.int64)
+                pos[self._by_comm] = np.arange(self.num_vertices)
+                self._explain_idx = (pos, np.sort(self.lof))
+            return self._explain_idx
+
+    def explain(self, vertex: int, max_neighbors: int = 32) -> dict:
+        """Per-vertex outlier explanation — the triage companion to a
+        firing canary/drift alert (RUNBOOKS §13): everything the engine's
+        existing indexes say about WHY this vertex scores the way it
+        does, in one read — O(log V + deg) against indexes built at
+        load plus a lazily-built-once side index (:meth:`_explain_index`):
+        LOF/label columns, the census tables, the neighbor CSR and the
+        (label asc, LOF desc) community blocks.
+
+        Fields: the vertex row (label/component/LOF/size/decile), its
+        LOF rank within its community and global score percentile, and
+        degree + up to ``max_neighbors`` neighbor ids with their scores'
+        mean/max (an outlier whose neighbors also score high is a
+        shifted REGION — drift — not a point anomaly). Per-vertex
+        k-distances are NOT served: the streaming scorer's window does
+        not cover all V, so no snapshot column holds them.
+        """
+        vertex = self._check(vertex)
+        nbrs = self.neighbors(vertex)
+        label = int(self.labels[vertex])
+        score = float(self.lof[vertex])
+        pos_in_order, lof_sorted = self._explain_index()
+        # rank of this vertex inside its community's LOF-desc block:
+        # its position in the global (label asc, LOF desc) order minus
+        # the block start — one array read, no block scan
+        i = int(np.searchsorted(self._block_labels, label))
+        start = int(self._block_starts[i])
+        rank_in_comm = int(pos_in_order[vertex]) - start
+        out = {
+            "vertex": int(vertex),
+            "label": label,
+            "component": int(self.cc_labels[vertex]),
+            "lof": score,
+            "lof_stale": self.lof_stale,
+            "community_size": int(self._size_by_vertex[vertex]),
+            "community_decile": self.community_decile(vertex),
+            "lof_rank_in_community": rank_in_comm,
+            "community_top_lof": float(self.lof[self._by_comm[start]]),
+            # global percentile of this score (1.0 = the most outlying)
+            "lof_percentile": round(
+                float(np.searchsorted(lof_sorted, score, side="right"))
+                / max(1, len(lof_sorted)), 4
+            ),
+            "anomaly": bool(score > self._lof_threshold),
+            "lof_threshold": self._lof_threshold,
+            "degree": int(len(nbrs)),
+            "neighbors": nbrs[:max_neighbors],
+            "neighbors_truncated": bool(len(nbrs) > max_neighbors),
+        }
+        if len(nbrs):
+            nscores = self.lof[nbrs]
+            out["neighbor_lof_mean"] = round(float(nscores.mean()), 4)
+            out["neighbor_lof_max"] = round(float(nscores.max()), 4)
+        return out
 
     def top_outliers(self, community: int, k: int = 10):
         """Top-``k`` LOF outliers of one community:

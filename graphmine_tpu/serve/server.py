@@ -22,8 +22,16 @@ Endpoints (JSON unless noted):
                        repair-debt ledger, batched-query stage split
 ``GET  /metrics``      live Prometheus text exposition (counters, gauges,
                        request-latency histogram buckets)
+``GET  /alertz``       result-quality alerts + the quality section
+                       (sketches, anomaly rate, drift, canary) —
+                       evaluated at read time (docs/OBSERVABILITY.md
+                       "Result quality")
 ``GET  /snapshot``     current snapshot manifest metadata
 ``GET  /vertex?v=``    one vertex: label, component, LOF, size, decile
+``GET  /explain?vertex=`` per-vertex outlier explanation (LOF score +
+                       rank/percentile, community id/size/decile,
+                       neighbors + their score context) — the triage
+                       companion to a firing canary/drift alert
 ``GET  /neighbors?v=`` neighbor ids of one vertex
 ``GET  /topk?community=&k=``  top-k LOF outliers of one community
 ``POST /query``        ``{"vertices": [...]}`` — the batched gather path
@@ -116,6 +124,7 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from graphmine_tpu.obs.alerts import AlertManager
 from graphmine_tpu.obs.registry import Registry
 from graphmine_tpu.obs.spans import (
     TRACE_HEADER,
@@ -150,8 +159,10 @@ _GET_ROUTES = {
     "/healthz": "_ep_healthz",
     "/statusz": "_ep_statusz",
     "/metrics": "_ep_metrics",
+    "/alertz": "_ep_alertz",
     "/snapshot": "_ep_snapshot",
     "/vertex": "_ep_vertex",
+    "/explain": "_ep_explain",
     "/neighbors": "_ep_neighbors",
     "/topk": "_ep_topk",
     "/wal": "_ep_wal",
@@ -282,6 +293,20 @@ class SnapshotServer:
             sink.registry if sink is not None else Registry()
         )
         self.debt = RepairDebt(registry=self.registry)
+        # Result-quality alerting (ISSUE 13, obs/alerts.py): evaluated
+        # on the EXISTING cadences — every /healthz (the fleet prober's
+        # probe loop drives it fleet-wide), every /alertz or /statusz
+        # read, and after each publish swap. No new threads.
+        # GRAPHMINE_QUALITY=0 is the same kill switch the ingestor
+        # honors: it must also stop the READ-time engine-state pass, or
+        # the first /healthz after every swap would still pay the O(V)
+        # census/sketch build the operator switched off.
+        self.quality_enabled = os.environ.get("GRAPHMINE_QUALITY", "1") != "0"
+        self.alerts = AlertManager(sink=sink, registry=self.registry)
+        # The writer's last full quality pass (drift + canary, from the
+        # ingestor); replicas fall back to the engine's lazily-built
+        # QualityState — both served on /statusz + /alertz.
+        self._quality_report = None
         # The single write-path policy owner (serve/admission.py). A
         # caller-supplied controller keeps its own bounds; the default
         # reads GRAPHMINE_ADMIT_* env.
@@ -1296,6 +1321,10 @@ class SnapshotServer:
                         self.debt.abandoned()
                 raise
             self._swap(QueryEngine(snap))
+            # Adopt the ingestor's quality pass (drift + canary) for
+            # /statusz, /alertz and the alert rules — the served engine
+            # and the report now describe the same version.
+            self._quality_report = ing.last_quality
             if self.wal is not None and seqs:
                 # Compaction keyed to the published snapshot version:
                 # the durable watermark says "everything up to this seq
@@ -1305,6 +1334,11 @@ class SnapshotServer:
                 # still in flight toward the queue).
                 self.wal.commit_applied(seqs, snap.version)
         self._emit_delta_stages(group, snap, t_apply_start)
+        # Publish-time alert evaluation (outside the delta lock — a
+        # record fsync must not serialize handlers): a quality or canary
+        # regression this publish introduced fires NOW, not at the next
+        # prober pass.
+        self.evaluate_alerts()
         self.registry.counter(
             "graphmine_serve_deltas_total", "delta batches ingested"
         ).inc(len(group))
@@ -1505,8 +1539,13 @@ class SnapshotServer:
             depth = len(self._queue)
         overloaded, why = self.admission.overloaded(depth, debt)
         ready, not_ready_why = self._ready(eng)
+        # The prober cadence IS the alert-evaluation cadence (ISSUE 13):
+        # the fleet prober polls /healthz, so firing→resolved transitions
+        # happen fleet-wide without a new timer thread.
+        self.evaluate_alerts()
         out = {
             "ok": True,
+            "alerts_firing": len(self.alerts.firing()),
             "ready": ready,
             "draining": self._draining,
             "version": eng.version,
@@ -1545,6 +1584,71 @@ class SnapshotServer:
         created = eng.snapshot.meta.get("created")
         base = float(created) if created else self._t0_wall
         return round(max(0.0, time.time() - base), 3)
+
+    # -- result quality & alerts ------------------------------------------
+    def quality_payload(self) -> dict:
+        """The "quality" section /statusz and /alertz serve: the
+        writer's last full pass (state + drift + canary) when it is
+        still the served version, else the engine's own lazily-built
+        state — a replica that only reloads still exposes its sketches
+        for the router's fleet merge."""
+        eng = self._engine
+        rep = self._quality_report
+        if rep is not None and rep.state.version == eng.version:
+            return rep.payload()
+        if not self.quality_enabled:
+            return {"disabled": True}
+        from graphmine_tpu.obs.quality import export_gauges
+
+        state = eng.quality_state()
+        export_gauges(self.registry, state)
+        return {"state": state.payload()}
+
+    def _alert_values(self) -> dict:
+        """The flat metric dict the alert rules evaluate over: quality
+        numbers from the freshest source plus the serving-side gauges
+        the default ingest-lag rule reads."""
+        debt = self.debt.snapshot()
+        eng = self._engine
+        values = {
+            "ingest_lag_s": debt["ingest_lag_s"],
+            "repair_debt_rows": debt["pending_rows"],
+            "snapshot_age_s": self._snapshot_age_s(eng),
+        }
+        rep = self._quality_report
+        if rep is not None and rep.state.version == eng.version:
+            values.update(rep.values())
+        elif self.quality_enabled:
+            # cached-only (build=False): /healthz drives this path at
+            # probe cadence, and a liveness probe must not pay the O(V)
+            # state build after every swap — the quality rules simply
+            # don't evaluate until an /alertz or /statusz read (or the
+            # router's fan-out) builds the state explicitly.
+            state = eng.quality_state(build=False)
+            if state is not None:
+                values["quality_anomaly_rate"] = state.anomaly_rate
+                values["quality_num_communities"] = state.num_communities
+        return values
+
+    def evaluate_alerts(self) -> list:
+        """One alert-rule evaluation pass; returns the transitions.
+        Never raises into a caller — /healthz answering 500 because a
+        quality pass hiccuped would fail the prober over telemetry."""
+        try:
+            return self.alerts.evaluate(self._alert_values())
+        except Exception:  # noqa: BLE001 — alerting must not break serving
+            return []
+
+    def alertz(self) -> dict:
+        """The ``/alertz`` body: alert level state + the quality section
+        (evaluated at read time, so a drained-and-idle server still
+        transitions rules whose conditions cleared)."""
+        self.evaluate_alerts()
+        return {
+            "version": self._engine.version,
+            **self.alerts.snapshot(),
+            "quality": self.quality_payload(),
+        }
 
     def endpoint_latency(self) -> dict:
         """Per-endpoint latency/error summary from the request histogram
@@ -1598,6 +1702,11 @@ class SnapshotServer:
             },
             "writer_epoch": self.writer_epoch,
             "delta_stages": self.delta_stage_latency(),
+            # result-quality section (ISSUE 13): the served snapshot's
+            # sketches/anomaly rate (+ drift/canary on the writer) and
+            # the alert level view — the same payloads /alertz serves
+            "quality": self.quality_payload(),
+            "alerts": self.alerts.snapshot(),
         }
         if self.wal is not None:
             payload["wal"] = self.wal.snapshot()
@@ -1880,6 +1989,22 @@ class _Handler(BaseHTTPRequestHandler):
         row = self.srv.vertex_row(eng, v)
         self.srv.record_batch("vertex", 1, time.perf_counter() - t0)
         self._reply(200, row)
+
+    def _ep_explain(self, url) -> None:
+        eng = self.srv.engine
+        if not self._pin_ok(eng):
+            return
+        t0 = time.perf_counter()
+        qs = parse_qs(url.query)
+        vals = qs.get("vertex") or qs.get("v")
+        if not vals:
+            raise ValueError("explain needs ?vertex=<id>")
+        row = eng.explain(int(vals[0]))
+        self.srv.record_batch("explain", 1, time.perf_counter() - t0)
+        self._reply(200, row)
+
+    def _ep_alertz(self, url) -> None:
+        self._reply(200, self.srv.alertz())
 
     def _ep_neighbors(self, url) -> None:
         eng = self.srv.engine

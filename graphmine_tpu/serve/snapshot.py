@@ -498,6 +498,36 @@ class SnapshotStore:
         except (KeyError, TypeError, ValueError):
             return None
 
+    def peek_arrays(self, names) -> tuple[dict, dict] | None:
+        """Load ONLY the named arrays (plus the manifest meta) from the
+        newest intact generation, without per-array hash verification —
+        the cheap parent read the publish-time quality pass
+        (``obs/quality.py``) uses for snapshot-over-parent drift when the
+        parent is not already in memory. Advisory-telemetry contract:
+        full verification stays with :meth:`load`; any read failure here
+        returns None (drift is then simply skipped) instead of raising
+        into a publish. Returns ``({name: array}, meta)`` with absent
+        names simply missing from the dict."""
+        for gen in (self._gen(), self._prev()):
+            body = self._peek_dir(gen)
+            if body is None:
+                continue
+            out = {}
+            try:
+                for name in names:
+                    ent = body.get("arrays", {}).get(name)
+                    if ent is None:
+                        continue
+                    out[name] = np.load(os.path.join(gen, ent["file"]))
+            except Exception:  # noqa: BLE001 — advisory read, never raise
+                continue
+            meta = {
+                k: v for k, v in body.items()
+                if k not in ("arrays", "checksum")
+            }
+            return out, meta
+        return None
+
     def _read_verified(self, gen_dir: str, fingerprint: str | None):
         """Load one generation, verifying manifest checksum, every
         array's sha256/dtype/shape, then the graph fingerprint. Raises a

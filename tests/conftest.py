@@ -148,6 +148,17 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "quality: result-quality observability suite "
+        "(tests/test_quality.py: quantile-sketch merge associativity/"
+        "commutativity, PSI drift hand-computed exactness, partition-"
+        "matched churn, canary probe recall + injected scorer "
+        "regression, alert firing/resolve/flap sequences, /alertz + "
+        "fleet sketch-merge e2e, the obs_report quality timeline and "
+        "its exit-4 canary gate); runs in the default CPU pass — "
+        "select with -m quality or tools/run_tier1.sh --quality-only",
+    )
+    config.addinivalue_line(
+        "markers",
         "slo: serving-SLO observability suite (tests/test_slo.py: "
         "bucket histograms + merge associativity, live /metrics and "
         "/statusz under the query hammer, quantile agreement vs the "

@@ -1,0 +1,668 @@
+"""Result-quality observability suite (marker ``quality``, ISSUE 13):
+quantile sketches + PSI drift, the publish-time quality pass, the canary
+probe, the alert rule engine, ``/alertz``/``/explain``, the fleet sketch
+merge and the obs_report quality gate — tools/run_tier1.sh
+--quality-only.
+
+The acceptance pins:
+
+- sketch merge is associative/commutative over random observation sets
+  on one ladder (the ``Histogram.merge`` contract), mismatched ladders
+  refuse, and the ROUTER's fleet-merged sketch equals the counter-wise
+  per-replica merge done by hand;
+- PSI drift distance and partition-matched churn are EXACT against
+  hand-computed values;
+- two publishes with an injected scorer regression between them produce
+  schema-valid, span-joined ``quality_drift`` + ``canary_score``
+  records, an alert firing→resolved transition observable on
+  ``/alertz``, and an ``obs_report`` that renders the quality timeline
+  from the JSONL alone with a non-zero exit while the canary alert is
+  still firing.
+"""
+
+import json
+import math
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from graphmine_tpu.graph.container import build_graph
+from graphmine_tpu.obs.alerts import AlertManager, AlertRule, default_rules
+from graphmine_tpu.obs.quality import (
+    CanaryProbe,
+    QualityState,
+    partition_churn,
+    quality_drift,
+    run_quality_pass,
+)
+from graphmine_tpu.obs.schema import validate_record, validate_records
+from graphmine_tpu.obs.sketch import (
+    DEFAULT_SCORE_LADDER,
+    PSI_EPS,
+    QuantileSketch,
+    log_ladder,
+    psi_distance,
+)
+from graphmine_tpu.obs.spans import Tracer
+from graphmine_tpu.pipeline.checkpoint import graph_fingerprint
+from graphmine_tpu.pipeline.metrics import MetricsSink
+from graphmine_tpu.serve.delta import cold_recompute
+from graphmine_tpu.serve.query import QueryEngine
+from graphmine_tpu.serve.server import SnapshotServer
+from graphmine_tpu.serve.snapshot import SnapshotStore
+from graphmine_tpu.testing import faults
+
+pytestmark = pytest.mark.quality
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(_REPO, "tools")
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return json.loads(r.read())
+
+
+def _post(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(), method="POST",
+    )
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def _sbm_store(tmp_path, sink=None, v_per_block=40, blocks=8,
+               lof="linspace"):
+    from graphmine_tpu.datasets import sbm
+
+    src, dst, _ = sbm([v_per_block] * blocks, 0.2, 0.002, seed=3)
+    v = v_per_block * blocks
+    g = build_graph(src, dst, num_vertices=v)
+    labels, cc, _ = cold_recompute(g)
+    store = SnapshotStore(str(tmp_path / "snap"))
+    if lof == "linspace":
+        lof_col = np.linspace(0.5, 1.4, v).astype(np.float32)
+    else:
+        lof_col = np.zeros(v, np.float32)
+    store.publish(
+        {"src": src, "dst": dst, "labels": labels, "cc_labels": cc,
+         "lof": lof_col},
+        fingerprint=graph_fingerprint(src, dst), sink=sink,
+    )
+    return store, v
+
+
+# ---- sketches -------------------------------------------------------------
+
+
+def test_log_ladder_shape_and_refusals():
+    lad = log_ladder(1.0, 8.0, steps_per_octave=1)
+    assert lad == (1.0, 2.0, 4.0, 8.0)
+    assert log_ladder(1.0, 7.9)[-1] >= 7.9  # covers hi
+    with pytest.raises(ValueError):
+        log_ladder(0.0, 8.0)
+    with pytest.raises(ValueError):
+        log_ladder(8.0, 1.0)
+    with pytest.raises(ValueError):
+        log_ladder(1.0, 8.0, steps_per_octave=0)
+
+
+def test_sketch_state_roundtrip_and_add_counts():
+    sk = QuantileSketch(buckets=(1.0, 2.0, 4.0))
+    sk.observe(0.5)   # <= first bound -> bucket 0
+    sk.observe(3.0)
+    sk.observe(100.0)  # overflow
+    state = sk.to_state()
+    assert state["counts"] == [1, 0, 1, 1]
+    assert state["count"] == 3
+    back = QuantileSketch.from_state(state)
+    assert back.to_state() == state
+    # JSON round-trip is exact (the /alertz wire path)
+    wired = QuantileSketch.from_state(json.loads(json.dumps(state)))
+    assert wired.to_state() == state
+    with pytest.raises(ValueError):
+        sk.add_counts([1, 2])          # wrong bucket count
+    with pytest.raises(ValueError):
+        sk.add_counts([1, -1, 0, 0])   # negative
+    with pytest.raises(ValueError):
+        QuantileSketch.from_state({"bounds": [1.0]})  # torn payload
+    with pytest.raises(ValueError):  # non-numeric count element: still
+        # ValueError, so a router merging replica payloads skips it
+        # instead of 500ing (the review-pinned torn-payload contract)
+        QuantileSketch.from_state({"bounds": [1.0], "counts": [None, 0]})
+
+
+def test_sketch_merge_associative_commutative():
+    """The r11 Histogram.merge property suite applied to sketches:
+    random observation sets, every grouping/order lands on identical
+    counters."""
+    rng = np.random.default_rng(7)
+    sets = [rng.gamma(2.0, 1.0, size=rng.integers(5, 60)) for _ in range(3)]
+
+    def sketch(*obs_sets):
+        sk = QuantileSketch(buckets=DEFAULT_SCORE_LADDER)
+        for obs in obs_sets:
+            for x in obs:
+                sk.observe(float(x))
+        return sk
+
+    a, b, c = (sketch(s) for s in sets)
+    ab_c = sketch(sets[0]).merge(sketch(sets[1])).merge(sketch(sets[2]))
+    a_bc = sketch(sets[0]).merge(sketch(sets[1]).merge(sketch(sets[2])))
+    cba = sketch(sets[2]).merge(sketch(sets[1])).merge(sketch(sets[0]))
+    want = sketch(*sets).to_state()
+    for got in (ab_c, a_bc, cba):
+        st = got.to_state()
+        assert st["counts"] == want["counts"]
+        assert st["count"] == want["count"]
+        assert st["sum"] == pytest.approx(want["sum"])
+    # mismatched ladders refuse (merge AND psi)
+    other = QuantileSketch(buckets=(1.0, 2.0))
+    with pytest.raises(ValueError, match="ladder"):
+        a.merge(other)
+    with pytest.raises(ValueError, match="ladder"):
+        psi_distance(a, other)
+
+
+def test_psi_hand_computed_exact():
+    """PSI against the literal hand formula on a 2-bound ladder: all
+    mass moving from bucket 0 to bucket 1."""
+    a = QuantileSketch(buckets=(1.0, 2.0))
+    a.add_counts([10, 0, 0])
+    b = QuantileSketch(buckets=(1.0, 2.0))
+    b.add_counts([0, 10, 0])
+    # buckets: (1, eps, eps) vs (eps, 1, eps) ->
+    # 2 * (1 - eps) * ln(1 / eps), third term zero
+    want = 2 * (1.0 - PSI_EPS) * math.log(1.0 / PSI_EPS)
+    assert psi_distance(a, b) == pytest.approx(want, rel=1e-12)
+    # symmetric, zero on identity, zero on two empties
+    assert psi_distance(b, a) == pytest.approx(want, rel=1e-12)
+    assert psi_distance(a, a) == 0.0
+    empty = QuantileSketch(buckets=(1.0, 2.0))
+    assert psi_distance(empty, QuantileSketch(buckets=(1.0, 2.0))) == 0.0
+    # a 30/70 -> 50/50 shift, by hand
+    c = QuantileSketch(buckets=(1.0, 2.0))
+    c.add_counts([3, 7, 0])
+    d = QuantileSketch(buckets=(1.0, 2.0))
+    d.add_counts([5, 5, 0])
+    want = (0.3 - 0.5) * math.log(0.3 / 0.5) + (0.7 - 0.5) * math.log(0.7 / 0.5)
+    assert psi_distance(c, d) == pytest.approx(want, rel=1e-12)
+    # state-dict operands work too (the obs_report path)
+    assert psi_distance(c.to_state(), d.to_state()) == pytest.approx(
+        want, rel=1e-12
+    )
+
+
+def test_partition_churn_hand_computed():
+    # identical up to renumbering: zero churn
+    assert partition_churn([0, 0, 1, 1], [9, 9, 4, 4]) == 0.0
+    # one vertex moved: child comm 0 = {v0,v1,v2}, majority parent 0,
+    # v2 (parent 1) churned -> 1/4
+    assert partition_churn([0, 0, 1, 1], [0, 0, 0, 1]) == 0.25
+    # empty edge case
+    assert partition_churn([], []) == 0.0
+    # growth: only the common prefix is compared
+    assert partition_churn([0, 0], [5, 5, 7, 7]) == 0.0
+
+
+def test_quality_state_and_drift_fields():
+    labels = np.array([0, 0, 0, 3, 3, 7])
+    lof = np.array([0.5, 0.8, 1.0, 1.2, 2.0, 9.0], np.float32)
+    st = QualityState.from_arrays(labels, lof, version=4, threshold=1.5)
+    assert st.num_communities == 3
+    assert st.largest_community == 3
+    assert st.anomaly_count == 2
+    assert st.anomaly_rate == pytest.approx(2 / 6)
+    assert st.lof_sketch.count == 6
+    assert st.size_sketch.count == 3
+    # drift against a renamed-but-identical partition: no churn, no PSI
+    st2 = QualityState.from_arrays(
+        np.array([9, 9, 9, 4, 4, 5]), lof, version=5, threshold=1.5
+    )
+    d = quality_drift(st, st2, labels, [9, 9, 9, 4, 4, 5])
+    assert d["churn_frac"] == 0.0
+    assert d["lof_psi"] == 0.0
+    assert d["size_psi"] == 0.0
+    assert d["anomaly_rate_delta"] == 0.0
+    # id-chain diagnostics see the renumbering (documented noise)
+    assert d["new_communities"] == 3 and d["dissolved_communities"] == 3
+
+
+# ---- canary probe ---------------------------------------------------------
+
+
+def test_canary_deterministic_and_healthy_recall():
+    p1 = CanaryProbe.generate(seed=11)
+    p2 = CanaryProbe.generate(seed=11)
+    assert np.array_equal(np.asarray(p1.features), np.asarray(p2.features))
+    assert np.array_equal(
+        np.asarray(p1.is_anomaly), np.asarray(p2.is_anomaly)
+    )
+    out = p1.score()
+    assert out["recall_at_k"] == 1.0
+    assert out["mean_rank_frac"] < 0.05
+    assert out["num_anomalies"] == p1.num_anomalies > 0
+
+
+def test_canary_detects_injected_scorer_regression():
+    probe = CanaryProbe.generate(seed=11)
+
+    def corrupt(**ctx):
+        st = ctx["state"]
+        st["scores"] = np.zeros_like(np.asarray(st["scores"]))
+        return None
+
+    corrupt.wants_ctx = True
+    inj = faults.FaultInjector().add("canary_probe", corrupt)
+    with inj.installed():
+        out = probe.score()
+    assert inj.fired("canary_probe") == 1
+    assert out["recall_at_k"] < 0.7  # the default alert threshold trips
+
+
+def test_canary_snapshot_roundtrip(tmp_path):
+    probe = CanaryProbe.generate(seed=5)
+    store = SnapshotStore(str(tmp_path / "s"))
+    arrays = {
+        "labels": np.zeros(4, np.int32),
+        **probe.arrays(),
+    }
+    store.publish(arrays, extra_meta={"canary": probe.meta()})
+    snap = store.load()
+    back = CanaryProbe.from_snapshot(snap)
+    assert back is not None
+    assert np.array_equal(
+        np.asarray(back.features), np.asarray(probe.features)
+    )
+    assert back.k == probe.k and back.seed == probe.seed
+    # a snapshot with no probe yields None, not a crash
+    store2 = SnapshotStore(str(tmp_path / "s2"))
+    store2.publish({"labels": np.zeros(4, np.int32)})
+    assert CanaryProbe.from_snapshot(store2.load()) is None
+
+
+# ---- alert engine ---------------------------------------------------------
+
+
+def test_alert_fire_resolve_flap_sequence():
+    clock = {"t": 0.0}
+    mgr = AlertManager(
+        rules=[AlertRule("r", "x", ">", 1.0)], clock=lambda: clock["t"]
+    )
+    # below threshold: nothing
+    assert mgr.evaluate({"x": 0.5}) == []
+    assert mgr.firing() == []
+    # above: pending -> firing in one pass (for_s=0)
+    trans = mgr.evaluate({"x": 2.0})
+    assert trans and trans[-1][2] == "firing"
+    assert mgr.firing() == ["r"]
+    # still above: no new transition
+    assert mgr.evaluate({"x": 3.0}) == []
+    # below: resolved
+    trans = mgr.evaluate({"x": 0.1})
+    assert [t for _, _, t in trans][-1] == "resolved"
+    assert mgr.firing() == []
+    # flap: fires again
+    mgr.evaluate({"x": 5.0})
+    assert mgr.firing() == ["r"]
+    snap = mgr.snapshot()
+    rule = snap["rules"][0]
+    assert rule["times_fired"] == 2 and rule["times_resolved"] == 1
+    assert snap["firing"] == 1
+
+
+def test_alert_for_duration_and_missing_metric():
+    clock = {"t": 0.0}
+    mgr = AlertManager(
+        rules=[AlertRule("lag", "lag_s", ">", 10.0, for_s=5.0)],
+        clock=lambda: clock["t"],
+    )
+    assert mgr.evaluate({"lag_s": 20.0}) != []       # -> pending
+    assert mgr.firing() == []
+    clock["t"] = 3.0
+    mgr.evaluate({"lag_s": 20.0})                     # sustained, < for_s
+    assert mgr.firing() == []
+    # a pass with the metric ABSENT leaves state untouched
+    mgr.evaluate({})
+    clock["t"] = 6.0
+    mgr.evaluate({"lag_s": 20.0})                     # sustained past for_s
+    assert mgr.firing() == ["lag"]
+    # a dip resets: pending must restart the clock
+    mgr.evaluate({"lag_s": 1.0})
+    clock["t"] = 7.0
+    mgr.evaluate({"lag_s": 20.0})
+    assert mgr.firing() == []                         # pending again, not firing
+
+
+def test_alert_records_and_env_overrides(monkeypatch):
+    sink = MetricsSink(tracer=Tracer())
+    monkeypatch.setenv("GRAPHMINE_ALERT_CANARY_RECALL", "0.9")
+    rules = {r.name: r for r in default_rules()}
+    assert rules["canary_recall_low"].threshold == 0.9
+    assert rules["canary_recall_low"].severity == "page"
+    mgr = AlertManager(rules=list(rules.values()), sink=sink)
+    mgr.evaluate({"canary_recall": 0.5})
+    mgr.evaluate({"canary_recall": 1.0})
+    recs = [r for r in sink.records if r.get("phase") == "alert"]
+    assert [r["state"] for r in recs] == ["firing", "resolved"]
+    assert all(validate_record(r) == [] for r in recs)
+    # malformed env raises loudly at rule construction
+    monkeypatch.setenv("GRAPHMINE_ALERT_LOF_PSI", "not-a-float")
+    with pytest.raises(ValueError, match="GRAPHMINE_ALERT_LOF_PSI"):
+        default_rules()
+    # malformed rule fields refuse
+    with pytest.raises(ValueError):
+        AlertRule("bad", "m", ">=", 1.0)
+    with pytest.raises(ValueError):
+        AlertRule("bad", "m", ">", 1.0, severity="critical")
+    with pytest.raises(ValueError, match="duplicate"):
+        AlertManager(rules=[AlertRule("a", "m", ">", 1.0),
+                            AlertRule("a", "m", "<", 1.0)])
+
+
+# ---- quality pass + schema ------------------------------------------------
+
+
+def test_run_quality_pass_records_schema_valid():
+    sink = MetricsSink(tracer=Tracer())
+    rng = np.random.default_rng(0)
+    parent = rng.integers(0, 20, 500)
+    labels = parent.copy()
+    labels[:30] = 21
+    lof = rng.random(500).astype(np.float32)
+    rep = run_quality_pass(
+        labels, lof, 2, parent_labels=parent, parent_lof=lof,
+        parent_version=1, canary=CanaryProbe.generate(seed=3), sink=sink,
+    )
+    assert rep.drift is not None and rep.canary is not None
+    assert rep.seconds > 0
+    phases = [r["phase"] for r in sink.records]
+    for want in ("quality_snapshot", "quality_drift", "canary_score"):
+        assert want in phases
+    assert validate_records(sink.records) == []
+
+
+def test_schema_sketch_subrecord_all_or_nothing():
+    ok = {
+        "phase": "quality_snapshot", "t": 1.0, "version": 1,
+        "num_vertices": 4, "num_communities": 1, "anomaly_rate": 0.0,
+        "lof_threshold": 1.5, "seconds": 0.1,
+        "lof_sketch": QuantileSketch(buckets=(1.0,)).to_state(),
+        "size_sketch": QuantileSketch(buckets=(1.0,)).to_state(),
+    }
+    assert validate_record(ok) == []
+    torn = dict(ok)
+    torn["lof_sketch"] = {"bounds": [1.0], "counts": [0, 0]}  # no sum/count
+    problems = validate_record(torn)
+    assert any("half-stamped lof_sketch" in p for p in problems)
+    not_dict = dict(ok)
+    not_dict["size_sketch"] = [1, 2]
+    assert any("size_sketch" in p for p in validate_record(not_dict))
+
+
+def test_schema_lint_flags_inline_sketch(tmp_path):
+    import schema_lint
+
+    bad = tmp_path / "mod.py"
+    bad.write_text(
+        "def f(sink, sk):\n"
+        "    sink.emit('quality_snapshot', lof_sketch={'bounds': []})\n"
+    )
+    hits = schema_lint.scan_inline_sketches(str(tmp_path))
+    assert len(hits) == 1
+    # the real package is clean (to_state() everywhere)
+    assert schema_lint.violations() == []
+
+
+# ---- /explain -------------------------------------------------------------
+
+
+def test_explain_fields_and_http(tmp_path):
+    sink = MetricsSink(tracer=Tracer())
+    store, v = _sbm_store(tmp_path, sink=sink)
+    eng = QueryEngine(store.load(), device=False)
+    row = eng.explain(3)
+    assert row["vertex"] == 3
+    assert row["label"] == eng.membership(3)
+    assert row["community_size"] == eng.community_size(3)
+    assert 0 <= row["community_decile"] <= 9
+    assert row["degree"] == len(eng.neighbors(3))
+    assert 0 <= row["lof_rank_in_community"] < row["community_size"]
+    assert row["community_top_lof"] >= row["lof"]
+    assert 0.0 <= row["lof_percentile"] <= 1.0
+    assert "neighbor_lof_mean" in row and "neighbor_lof_max" in row
+    with pytest.raises(KeyError):
+        eng.explain(v + 5)
+
+    srv = SnapshotServer(store, sink=sink)
+    _, port = srv.start()
+    try:
+        got = _get(port, "/explain?vertex=3")
+        assert got["vertex"] == 3 and got["label"] == row["label"]
+        for bad_path in ("/explain", "/explain?vertex=abc",
+                         f"/explain?vertex={v + 5}"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(port, bad_path)
+            assert ei.value.code == 400
+    finally:
+        srv.stop()
+    assert validate_records(sink.records) == []
+
+
+# ---- serve e2e: anomaly-rate shift fires an alert -------------------------
+
+
+def test_delta_burst_shifts_anomaly_rate_fires_alert(tmp_path, monkeypatch):
+    """The ISSUE 13 satellite e2e: a delta burst that shifts the anomaly
+    rate produces a quality_drift record and a firing alert visible on
+    /alertz and in obs_report."""
+    monkeypatch.setenv("GRAPHMINE_ALERT_ANOMALY_RATE", "0.004")
+    sink = MetricsSink(tracer=Tracer())
+    store, v = _sbm_store(tmp_path, sink=sink, lof="zeros")
+    srv = SnapshotServer(store, sink=sink)
+    _, port = srv.start()
+    try:
+        base_rate = _get(port, "/alertz")["quality"]["state"]["anomaly_rate"]
+        assert base_rate == 0.0
+        # wire 8 vertices as cross-community hubs: their LOF scores jump
+        rng = np.random.default_rng(5)
+        hubs = rng.choice(v, 8, replace=False)
+        ins = [
+            [int(h), int(t)]
+            for h in hubs for t in rng.integers(0, v, 30)
+        ]
+        out = _post(port, "/delta", {"insert": ins})
+        assert out["version"] == 2
+        az = _get(port, "/alertz")
+        assert az["quality"]["drift"] is not None
+        rate = az["quality"]["state"]["anomaly_rate"]
+        assert rate > 0.004, f"burst did not shift the anomaly rate: {rate}"
+        rules = {r["name"]: r for r in az["rules"]}
+        assert rules["anomaly_rate_high"]["state"] == "firing"
+        assert az["firing"] >= 1
+        # the drift record is in the stream and schema-valid
+        drifts = [
+            r for r in sink.records if r.get("phase") == "quality_drift"
+        ]
+        assert drifts and drifts[-1]["anomaly_rate_delta"] > 0
+    finally:
+        srv.stop()
+    assert validate_records(sink.records) == []
+    # obs_report renders the quality section + the firing (warn) alert
+    # without gating (anomaly_rate_high is warn, not page)
+    import obs_report
+
+    stream = tmp_path / "m.jsonl"
+    with open(stream, "w") as f:
+        for r in sink.records:
+            f.write(json.dumps(r) + "\n")
+    out_path = tmp_path / "report.txt"
+    rc = obs_report.main([str(stream), "--out", str(out_path)])
+    assert rc == 0
+    text = out_path.read_text()
+    assert "quality & alerts" in text
+    assert "anomaly_rate_high" in text and "ALERT FIRING" in text
+
+
+# ---- THE acceptance: scorer regression between two publishes --------------
+
+
+def test_acceptance_scorer_regression_canary_alert_fleet_and_report(
+    tmp_path, monkeypatch,
+):
+    sink = MetricsSink(tracer=Tracer())
+    store, v = _sbm_store(tmp_path, sink=sink)
+    srv = SnapshotServer(store, sink=sink)
+    _, port = srv.start()
+    try:
+        # publish 1: healthy scorer
+        out = _post(port, "/delta", {"insert": [[0, 1]]})
+        assert out["version"] == 2
+        az = _get(port, "/alertz")
+        assert az["quality"]["canary"]["recall_at_k"] == 1.0
+        rules = {r["name"]: r for r in az["rules"]}
+        assert rules["canary_recall_low"]["state"] in (
+            "inactive", "resolved"
+        )
+
+        # publish 2: an injected scorer regression (the canary_probe
+        # fault seam corrupts the scores the production scorer returned)
+        def corrupt(**ctx):
+            st = ctx["state"]
+            st["scores"] = np.zeros_like(np.asarray(st["scores"]))
+            return None
+
+        corrupt.wants_ctx = True
+        inj = faults.FaultInjector().add("canary_probe", corrupt)
+        with inj.installed():
+            out = _post(port, "/delta", {"insert": [[1, 2]]})
+        assert out["version"] == 3
+        assert inj.fired("canary_probe") == 1
+        az = _get(port, "/alertz")
+        assert az["quality"]["canary"]["recall_at_k"] < 0.7
+        rules = {r["name"]: r for r in az["rules"]}
+        assert rules["canary_recall_low"]["state"] == "firing"
+
+        # the firing stream: obs_report gates with exit 4 HERE
+        firing_stream = tmp_path / "firing.jsonl"
+        with open(firing_stream, "w") as f:
+            for r in sink.records:
+                f.write(json.dumps(r) + "\n")
+
+        # publish 3: healthy again -> firing -> resolved on /alertz
+        out = _post(port, "/delta", {"insert": [[2, 3]]})
+        assert out["version"] == 4
+        az = _get(port, "/alertz")
+        rules = {r["name"]: r for r in az["rules"]}
+        assert rules["canary_recall_low"]["state"] == "resolved"
+        assert az["quality"]["canary"]["recall_at_k"] == 1.0
+
+        # records: schema-valid, span-joined to the publishing trace
+        by_phase: dict = {}
+        for r in sink.records:
+            by_phase.setdefault(r.get("phase"), []).append(r)
+        assert validate_records(sink.records) == []
+        for phase in ("quality_snapshot", "quality_drift", "canary_score"):
+            recs = by_phase[phase]
+            assert len(recs) >= 3
+            for r in recs:
+                for key in ("run_id", "trace_id", "span_id", "span_path"):
+                    assert r.get(key), (phase, key, r)
+                assert "delta_apply" in r["span_path"]
+        states = [r["state"] for r in by_phase["alert"]
+                  if r["name"] == "canary_recall_low"]
+        assert states == ["firing", "resolved"]
+
+        # fleet: router-merged sketch == counter-wise per-replica merge
+        srv2 = SnapshotServer(store)
+        addr2 = srv2.start()
+        from graphmine_tpu.serve.fleet import FleetRouter
+
+        router = FleetRouter([
+            ("r0", "127.0.0.1", port),
+            ("r1", addr2[0], addr2[1]),
+        ])
+        _, rport = router.start()
+        try:
+            router.probe_once()
+            r_az = _get(rport, "/alertz")
+            assert sorted(r_az["replicas"]) == ["r0", "r1"]
+            merged = r_az["quality"]["merged"]
+            for key in ("lof_sketch", "size_sketch"):
+                by_hand = None
+                for rid in ("r0", "r1"):
+                    sk = QuantileSketch.from_state(
+                        r_az["replicas"][rid]["quality"]["state"][key]
+                    )
+                    by_hand = sk if by_hand is None else by_hand.merge(sk)
+                assert merged[key]["counts"] == by_hand.to_state()["counts"]
+                assert merged[key]["count"] == by_hand.to_state()["count"]
+            # the fleet /metrics scrape carries the merged sketch
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{rport}/metrics"
+            ).read().decode()
+            assert "graphmine_fleet_lof_score_sketch_bucket" in text
+        finally:
+            router.stop()
+            srv2.stop()
+    finally:
+        srv.stop()
+
+    # obs_report from the JSONL alone: quality timeline renders; the
+    # firing-canary stream exits 4 (the CI gate), the resolved stream 0
+    import obs_report
+
+    out_path = tmp_path / "firing_report.txt"
+    rc = obs_report.main([str(firing_stream), "--out", str(out_path)])
+    assert rc == 4
+    text = out_path.read_text()
+    assert "quality & alerts" in text
+    assert "canary_recall_low" in text
+    assert "canary@k" in text
+    # --lenient downgrades the gate
+    assert obs_report.main(
+        [str(firing_stream), "--lenient", "--out", str(out_path)]
+    ) == 0
+    # the full (resolved) stream passes
+    full_stream = tmp_path / "full.jsonl"
+    with open(full_stream, "w") as f:
+        for r in sink.records:
+            f.write(json.dumps(r) + "\n")
+    assert obs_report.main(
+        [str(full_stream), "--out", str(out_path)]
+    ) == 0
+
+
+def test_quality_disabled_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("GRAPHMINE_QUALITY", "0")
+    sink = MetricsSink(tracer=Tracer())
+    store, v = _sbm_store(tmp_path, sink=sink)
+    from graphmine_tpu.serve.delta import DeltaIngestor, EdgeDelta
+
+    ing = DeltaIngestor(store, sink=sink)
+    assert not ing.quality_enabled and ing._canary is None
+    snap = ing.apply(EdgeDelta.from_pairs(insert=[(0, 1)]))
+    assert "canary_features" not in snap.arrays
+    phases = {r["phase"] for r in sink.records}
+    assert "quality_snapshot" not in phases and "canary_score" not in phases
+    assert validate_records(sink.records) == []
+    # the kill switch also covers the READ-time engine pass: /healthz
+    # and /alertz must not build the O(V) quality state
+    srv = SnapshotServer(store, sink=sink)
+    _, port = srv.start()
+    try:
+        assert not srv.quality_enabled
+        h = _get(port, "/healthz")
+        assert h["ok"] and h["alerts_firing"] == 0
+        az = _get(port, "/alertz")
+        assert az["quality"] == {"disabled": True}
+        assert srv.engine._quality_state is None  # never built
+    finally:
+        srv.stop()

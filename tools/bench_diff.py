@@ -73,7 +73,7 @@ SUB_RECORDS = {
     "blocking": ("binned_vs_random_gather",),
     "stream": ("ivf_reuse",),
     "serve": ("write_load", "replicated_read", "writer_failover",
-              "latency_quantiles"),
+              "latency_quantiles", "quality_pass"),
 }
 
 # metric-name prefix -> tier, for records read from a tail where no

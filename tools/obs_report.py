@@ -45,7 +45,13 @@ dir):
   cross-process join rendered inline — complete per-delta timelines
   (admission → WAL fsync → apply → publish → each replica visible, each
   line attributed to the emitting process) and the failover epoch-fence
-  sequence.
+  sequence;
+- the **quality & alerts** section (ISSUE 13): the result-quality
+  timeline — one row per published version joining
+  ``quality_snapshot`` / ``quality_drift`` / ``canary_score`` (anomaly
+  rate, churn, PSI sketch drift, canary recall, pass seconds), sketch
+  quantiles of the latest snapshot, and every alert firing/resolved
+  transition (RUNBOOKS §13 keys its triage off this view).
 
 Usage::
 
@@ -60,10 +66,13 @@ A reused metrics file holds several ``run_start``-delimited segments; the
 default is the most recent run (``--run-id`` selects another). Exit code
 0 on success, 2 when the file is missing/empty or the run id is unknown,
 **3 when the reported run carries schema violations or half-stamped
-trace records** (the all-or-nothing identity rule in ``obs/schema.py``)
-— so CI can run this as a post-e2e gate; ``--lenient`` downgrades the
-violations to a report note. Stdlib-only (usable on a machine with no
-jax at all).
+trace records** (the all-or-nothing identity rule in ``obs/schema.py``),
+**4 when the stream ends with a firing page-severity alert** (the
+canary scorer-regression rule is the built-in page — the result-quality
+CI gate, distinct from 3 so CI can tell "telemetry rotted" from "the
+scorer regressed") — so CI can run this as a post-e2e gate;
+``--lenient`` downgrades both to a report note. Stdlib-only (usable on
+a machine with no jax at all).
 """
 
 from __future__ import annotations
@@ -675,6 +684,103 @@ def _failover_section(records, t0):
     return out
 
 
+def _sketch_quantiles(state) -> str:
+    """p50/p90/p99 of a sketch state dict — rebuilt through the one
+    shared QuantileSketch machinery so the report's numbers can never
+    drift from the live /statusz estimates."""
+    try:
+        from graphmine_tpu.obs.sketch import QuantileSketch
+
+        sk = QuantileSketch.from_state(state)
+        if not sk.count:
+            return "(empty)"
+        return (
+            f"p50 {sk.quantile(0.50):.3g} / p90 {sk.quantile(0.90):.3g}"
+            f" / p99 {sk.quantile(0.99):.3g}"
+        )
+    except (ValueError, KeyError, TypeError):
+        return "(malformed sketch)"
+
+
+def _quality_section(records, t0):
+    """Result-quality timeline (ISSUE 13, docs/OBSERVABILITY.md "Result
+    quality"): one row per published version joining quality_snapshot /
+    quality_drift / canary_score, then every alert transition — the
+    RUNBOOKS §13 "read the quality timeline before blaming the data"
+    view, rendered from the JSONL shards alone. Empty = no quality
+    records in the stream."""
+    snaps = [r for r in records if r.get("phase") == "quality_snapshot"]
+    drifts = {
+        r.get("version"): r for r in records
+        if r.get("phase") == "quality_drift"
+    }
+    canaries = {
+        r.get("version"): r for r in records
+        if r.get("phase") == "canary_score"
+    }
+    alerts = [r for r in records if r.get("phase") == "alert"]
+    if not (snaps or alerts):
+        return []
+    out = []
+    if snaps:
+        out.append(
+            "  version  communities  anomaly%   churn   lof_psi  size_psi"
+            "  canary@k  pass_s"
+        )
+        for r in snaps:
+            ver = r.get("version")
+            d = drifts.get(ver, {})
+            c = canaries.get(ver, {})
+
+            def num(src, key, fmt, absent="      -"):
+                v = src.get(key)
+                if not isinstance(v, (int, float)):
+                    return absent
+                return fmt.format(v)
+
+            out.append(
+                f"  v{ver!s:<7} {r.get('num_communities', '?'):>11}  "
+                f"{num(r, 'anomaly_rate', '{:7.2%}')} "
+                f"{num(d, 'churn_frac', '{:7.2%}')} "
+                f"{num(d, 'lof_psi', '{:9.3f}')} "
+                f"{num(d, 'size_psi', '{:9.3f}')} "
+                f"{num(c, 'recall_at_k', '{:9.2f}')} "
+                f"{num(r, 'seconds', '{:7.3f}')}"
+            )
+        last = snaps[-1]
+        for key, label in (("lof_sketch", "lof scores"),
+                           ("size_sketch", "community sizes")):
+            state = last.get(key)
+            if isinstance(state, dict):
+                out.append(
+                    f"  latest {label:<16} {_sketch_quantiles(state)}"
+                )
+    for r in alerts:
+        mark = "ALERT FIRING" if r.get("state") == "firing" else "resolved"
+        out.append(
+            f"  {_fmt_offset(r, t0)}  {mark:<12} {r.get('name', '?')}"
+            f"  [{r.get('severity', '?')}]  {r.get('metric', '?')}"
+            f" {r.get('op', '')} {r.get('threshold', '?')}"
+            f"  value={r.get('value', '?')}"
+        )
+    return out
+
+
+def gating_alerts(records) -> list:
+    """Alert names whose LAST transition in the stream is a firing
+    page-severity alert (the canary rule is the built-in page) — the CI
+    gate: ``main`` exits 4 when this is non-empty, alongside the
+    schema-violation exit 3 (docs/OBSERVABILITY.md "Result quality")."""
+    last: dict = {}
+    for r in records:
+        if r.get("phase") == "alert" and r.get("name"):
+            last[r["name"]] = r
+    return sorted(
+        name for name, r in last.items()
+        if r.get("state") == "firing" and r.get("severity") == "page"
+    )
+
+
 def _recovery_timeline(records, t0):
     events = [r for r in records if r.get("phase") in RECOVERY_PHASES]
     if not events:
@@ -839,6 +945,11 @@ def build_report(
         lines.append("")
         lines.append("-- fleet (replica health / breakers / routing) --")
         lines.extend(fleet)
+    qual = _quality_section(records, t0)
+    if qual:
+        lines.append("")
+        lines.append("-- quality & alerts (result drift / canary) --")
+        lines.extend(qual)
     ftrace = _fleet_trace_section(records)
     if ftrace:
         lines.append("")
@@ -950,6 +1061,20 @@ def main(argv=None) -> int:
             print(f"  ... and {len(problems) - 20} more", file=sys.stderr)
         if not args.lenient:
             return 3
+    # The quality CI gate (ISSUE 13): a stream that ENDS with a firing
+    # page-severity alert (the canary scorer-regression rule is the
+    # built-in page) fails with exit 4 — distinct from the schema exit 3
+    # so CI can tell "the telemetry rotted" from "the scorer regressed".
+    # --lenient downgrades both.
+    firing = gating_alerts(runs[rid])
+    if firing:
+        print(
+            f"obs_report: {len(firing)} page-severity alert(s) still "
+            f"firing at end of stream: {', '.join(firing)}",
+            file=sys.stderr,
+        )
+        if not args.lenient:
+            return 4
     return 0
 
 

@@ -7,7 +7,14 @@
 # (-m faults: tests/test_resilience.py + the tripwire/reshard cases in
 # tests/test_sharded.py) is part of this default pass.
 #
-# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only|--slo-only|--blocking-only|--admission-only|--fleet-only|--wal-only|--trace-only|--perf-only] [extra pytest args...]
+# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only|--slo-only|--blocking-only|--admission-only|--fleet-only|--wal-only|--trace-only|--perf-only|--quality-only] [extra pytest args...]
+#   --quality-only run just the `quality`-marked result-quality suite
+#                  (tests/test_quality.py: sketch merge associativity,
+#                  PSI drift exactness, canary probe recall + injected
+#                  scorer regression, alert firing/resolve/flap, the
+#                  /alertz + fleet-merge e2e and the obs_report quality
+#                  gate) — the fast slice when iterating on obs/sketch,
+#                  obs/quality or obs/alerts
 #   --perf-only    run just the `perf`-marked compute-plane performance-
 #                  observability suite (tests/test_costmodel.py: the
 #                  analytical cost model exact against hand-computed
@@ -98,6 +105,9 @@ elif [ "${1:-}" = "--trace-only" ]; then
 elif [ "${1:-}" = "--perf-only" ]; then
     shift
     MARKER='perf and not slow'
+elif [ "${1:-}" = "--quality-only" ]; then
+    shift
+    MARKER='quality and not slow'
 fi
 
 LOG="${TIER1_LOG:-/tmp/_t1.log}"

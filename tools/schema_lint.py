@@ -56,6 +56,18 @@ _EMIT_RE = re.compile(
 _INLINE_COST_RE = re.compile(r"\bcost\s*=\s*(?:\{|dict\()")
 _COST_OWNER = os.path.join("graphmine_tpu", "obs", "costmodel.py")
 
+# Inline sketch sub-record construction (ISSUE 13): `*_sketch` payloads
+# have ONE builder — obs/sketch.QuantileSketch.to_state(), whose shape
+# the runtime validator pins against schema.SKETCH_KEYS. A hand-rolled
+# `lof_sketch={...}` at an emit site would drift from the merge/report
+# tooling's expectations silently on cold paths — same rot class as the
+# cost lint above.
+_INLINE_SKETCH_RE = re.compile(r"\b\w+_sketch\s*=\s*(?:\{|dict\()")
+_SKETCH_OWNERS = (
+    os.path.join("graphmine_tpu", "obs", "sketch.py"),
+    os.path.join("graphmine_tpu", "obs", "quality.py"),
+)
+
 PACKAGE_DIR = os.path.join(_REPO, "graphmine_tpu")
 
 
@@ -78,9 +90,9 @@ def scan(root: str = PACKAGE_DIR) -> list:
     return found
 
 
-def scan_inline_costs(root: str = PACKAGE_DIR) -> list:
-    """``(file, line)`` pairs of inline ``cost={...}``/``cost=dict(...)``
-    literals outside the single builder (obs/costmodel.py)."""
+def _scan_inline(root, pattern, owners) -> list:
+    """``(file, line)`` pairs of an inline sub-record kwarg literal
+    outside its owning builder module(s)."""
     found = []
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
@@ -89,7 +101,7 @@ def scan_inline_costs(root: str = PACKAGE_DIR) -> list:
                 continue
             path = os.path.join(dirpath, name)
             rel = os.path.relpath(path, _REPO)
-            if rel == _COST_OWNER:
+            if rel in owners:
                 continue
             with open(path) as f:
                 lines = f.readlines()
@@ -98,9 +110,21 @@ def scan_inline_costs(root: str = PACKAGE_DIR) -> list:
                 # '#' inside a string arg would hide a same-line match,
                 # which no real emit call shape does)
                 code = raw.split("#", 1)[0]
-                if _INLINE_COST_RE.search(code):
+                if pattern.search(code):
                     found.append((rel, i))
     return found
+
+
+def scan_inline_costs(root: str = PACKAGE_DIR) -> list:
+    """``(file, line)`` pairs of inline ``cost={...}``/``cost=dict(...)``
+    literals outside the single builder (obs/costmodel.py)."""
+    return _scan_inline(root, _INLINE_COST_RE, (_COST_OWNER,))
+
+
+def scan_inline_sketches(root: str = PACKAGE_DIR) -> list:
+    """``(file, line)`` pairs of inline ``*_sketch={...}`` literals
+    outside the sketch builders (obs/sketch.py, obs/quality.py)."""
+    return _scan_inline(root, _INLINE_SKETCH_RE, _SKETCH_OWNERS)
 
 
 def violations(root: str = PACKAGE_DIR) -> list:
@@ -118,6 +142,12 @@ def violations(root: str = PACKAGE_DIR) -> list:
         "with graphmine_tpu/obs/costmodel.py (CostEstimate.record()), the "
         "single shape owner"
         for path, line in scan_inline_costs(root)
+    )
+    out.extend(
+        f"{path}:{line}: inline *_sketch=... literal — build sketch "
+        "sub-records with graphmine_tpu/obs/sketch.py "
+        "(QuantileSketch.to_state()), the single shape owner"
+        for path, line in scan_inline_sketches(root)
     )
     return out
 

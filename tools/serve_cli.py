@@ -204,23 +204,35 @@ def cmd_query(args) -> int:
         merged: dict = {}
         calls = []
         if args.vertex:
-            calls.append((f"{base}/query", {"vertices": list(args.vertex)}))
+            calls.append(
+                (f"{base}/query", {"vertices": list(args.vertex)}, None)
+            )
         if args.neighbors is not None:
-            calls.append((f"{base}/neighbors?v={args.neighbors}", None))
+            calls.append((f"{base}/neighbors?v={args.neighbors}", None, None))
+        if args.explain is not None:
+            # nested under "explain" (the local-mode shape): /explain's
+            # body shares keys ("vertex", "neighbors") with the other
+            # calls and a flat merge would clobber their answers
+            calls.append(
+                (f"{base}/explain?vertex={args.explain}", None, "explain")
+            )
         if args.community is not None:
             calls.append((
                 f"{base}/topk?community={args.community}&k={args.topk}",
-                None,
+                None, None,
             ))
         if not calls:  # bare `query --url`: still resolve something
-            calls.append((f"{base}/query", {"vertices": []}))
+            calls.append((f"{base}/query", {"vertices": []}, None))
         worst, attempts = 200, 0
-        for call_url, payload in calls:
+        for call_url, payload, nest in calls:
             out = request_with_retries(call_url, payload, **kw)
             attempts += out["attempts"]
             if out["status"] != 200:
                 worst = out["status"]
-            merged.update(out["body"])
+            if nest is not None:
+                merged[nest] = out["body"]
+            else:
+                merged.update(out["body"])
         print(json.dumps({
             "status": worst, "attempts": attempts, **merged,
         }))
@@ -243,6 +255,8 @@ def cmd_query(args) -> int:
         out["rows"] = batch
     if args.neighbors is not None:
         out["neighbors"] = eng.neighbors(args.neighbors)
+    if args.explain is not None:
+        out["explain"] = eng.explain(args.explain)
     if args.community is not None:
         out["top"] = [
             {"vertex": v, "lof": s}
@@ -399,6 +413,11 @@ def main(argv=None) -> int:
                    help="vertex ids to resolve (batched gather)")
     p.add_argument("--neighbors", type=int, default=None,
                    help="list this vertex's neighbors")
+    p.add_argument("--explain", type=int, default=None,
+                   help="per-vertex outlier explanation (LOF score + "
+                        "rank, community size/decile, neighbor score "
+                        "context) — the triage companion to a firing "
+                        "canary/drift alert")
     p.add_argument("--community", type=int, default=None,
                    help="top-k outliers of this community")
     p.add_argument("--topk", type=int, default=10)
