@@ -2302,9 +2302,53 @@ def _probe_tpu(timeout_s=None):
     return True, platform, info
 
 
+def _children_maxrss_bytes():
+    """Cumulative reaped-children peak RSS in bytes, or None off-POSIX.
+    ru_maxrss is KiB on Linux but already bytes on macOS — scale by
+    platform so a darwin capture doesn't record 1024x-inflated peaks
+    into the bench_diff memory gate."""
+    try:
+        import resource
+
+        raw = int(resource.getrusage(resource.RUSAGE_CHILDREN).ru_maxrss)
+    except Exception:
+        return None
+    return raw if sys.platform == "darwin" else raw * 1024
+
+
+def _tier_memory_subrecord(record, before):
+    """The per-tier ``memory`` sub-record (ISSUE 14): the measurement
+    child's peak RSS plus the memmodel estimate when the record's detail
+    names the workload size. ``before`` is the cumulative
+    reaped-children max sampled just BEFORE this child spawned —
+    getrusage(RUSAGE_CHILDREN) is a running max over ALL children
+    (probe children, the backend audit), so a tier whose child did not
+    raise it reports the bound with upper_bound=true and the bench_diff
+    memory gate never attributes another child's peak to this tier.
+    Tracked in tools/bench_diff.py's silicon manifest; peak bytes
+    regress UP in its gate. None off-POSIX."""
+    peak = _children_maxrss_bytes()
+    if peak is None or before is None:
+        return None
+    out = {
+        "peak_rss_bytes": peak,
+        "upper_bound": peak <= before,
+        "source": "rusage_children",
+    }
+    det = record.get("detail") or {}
+    v, e = det.get("num_vertices"), det.get("num_edges")
+    if isinstance(v, int) and isinstance(e, int) and v > 0 and e > 0:
+        # stdlib-only import — safe even when jax is unreachable
+        from graphmine_tpu.obs.memmodel import schedule_bytes_per_device
+
+        out["model_bytes"] = schedule_bytes_per_device("single", v, e, 1)
+    return out
+
+
 def _run_child(tier, env, timeout_s):
     """Run one measurement child. -> (record dict | None, error | None)."""
     env = dict(env, _GRAPHMINE_BENCH_CHILD="1")
+    rss_before = _children_maxrss_bytes()
     try:
         p = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--tier", tier],
@@ -2342,6 +2386,11 @@ def _run_child(tier, env, timeout_s):
             "record salvaged", file=sys.stderr,
         )
         record.setdefault("detail", {})["child_rc"] = p.returncode
+    mem = _tier_memory_subrecord(record, rss_before)
+    if mem is not None:
+        # per-tier memory sub-record (ISSUE 14): model + measured peak,
+        # tracked by bench_diff's manifest and regression gate
+        record.setdefault("detail", {}).setdefault("memory", mem)
     return record, None
 
 

@@ -163,6 +163,18 @@ _EXPORTS = {
     ),
     "lof_cost": ("graphmine_tpu.obs.costmodel", "lof_cost"),
     "rooflines": ("graphmine_tpu.obs.costmodel", "rooflines"),
+    # memory plane (ISSUE 14) — the HBM footprint twins of the cost rows
+    "MemEstimate": ("graphmine_tpu.obs.memmodel", "MemEstimate"),
+    "superstep_footprint": (
+        "graphmine_tpu.obs.memmodel", "superstep_footprint"
+    ),
+    "sharded_superstep_footprint": (
+        "graphmine_tpu.obs.memmodel", "sharded_superstep_footprint"
+    ),
+    "lof_footprint": ("graphmine_tpu.obs.memmodel", "lof_footprint"),
+    "schedule_footprint": (
+        "graphmine_tpu.obs.memmodel", "schedule_footprint"
+    ),
     "crossover_thresholds": (
         "graphmine_tpu.ops.blocking", "crossover_thresholds"
     ),

@@ -21,6 +21,10 @@ builds on it, not the other way around):
   model (r13): per-plan bytes/slots/exchange derivation, measured
   roofline anchors, the ``cost`` sub-record builder and the
   ``superstep_timing`` achieved-vs-model emission;
+- :mod:`graphmine_tpu.obs.memmodel`   the analytical memory-plane model
+  (ISSUE 14): per-plan HBM footprint inventories, the byte seeds the
+  pipeline planner derives its schedule model from, the ``mem``
+  sub-record builder and the ``memory_watermark`` emission;
 - :mod:`graphmine_tpu.obs.sketch`     mergeable quantile sketches over
   fixed log ladders (the ``Histogram.merge`` contract applied to LOF
   scores and community sizes) + the PSI drift distance;
@@ -40,6 +44,14 @@ from graphmine_tpu.obs.costmodel import (
     superstep_cost,
 )
 from graphmine_tpu.obs.histogram import Histogram, HistogramFamily
+from graphmine_tpu.obs.memmodel import (
+    MemEstimate,
+    emit_memory_watermark,
+    lof_footprint,
+    schedule_footprint,
+    sharded_superstep_footprint,
+    superstep_footprint,
+)
 from graphmine_tpu.obs.quality import (
     CanaryProbe,
     QualityState,
@@ -62,6 +74,7 @@ __all__ = [
     "CostEstimate",
     "Histogram",
     "HistogramFamily",
+    "MemEstimate",
     "QualityState",
     "QuantileSketch",
     "Registry",
@@ -70,12 +83,17 @@ __all__ = [
     "TraceContext",
     "Tracer",
     "default_rules",
+    "emit_memory_watermark",
     "lof_cost",
+    "lof_footprint",
     "log_ladder",
     "new_run_id",
     "psi_distance",
     "rooflines",
     "run_quality_pass",
+    "schedule_footprint",
     "sharded_superstep_cost",
+    "sharded_superstep_footprint",
     "superstep_cost",
+    "superstep_footprint",
 ]

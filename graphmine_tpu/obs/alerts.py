@@ -99,6 +99,7 @@ def default_rules() -> list:
     anomaly_rate_high     quality_anomaly_rate > ANOMALY_RATE    0.2
     ingest_lag_high       ingest_lag_s > INGEST_LAG_S            60.0
                           for INGEST_LAG_FOR_S                   5.0
+    mem_headroom_low      memory_headroom_frac < MEM_HEADROOM    0.1
     ====================  =====================================  ========
 
     ``canary_recall_low`` is the one ``page``: the probe's features are
@@ -137,6 +138,13 @@ def default_rules() -> list:
             for_s=env_float("GRAPHMINE_ALERT_INGEST_LAG_FOR_S", 5.0),
             description="oldest accepted-but-unapplied delta is older "
             "than the lag bound",
+        ),
+        AlertRule(
+            "mem_headroom_low", "memory_headroom_frac", "<",
+            env_float("GRAPHMINE_ALERT_MEM_HEADROOM", 0.1),
+            description="serve-process memory headroom below the low "
+            "watermark — read the memory waterfall before shrinking the "
+            "graph (RUNBOOKS §14)",
         ),
     ]
 

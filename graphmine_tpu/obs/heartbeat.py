@@ -29,6 +29,37 @@ log = logging.getLogger("graphmine_tpu")
 
 _PAGESIZE = None
 
+# Latest per-device memory_stats() sample, CACHED by the driver from its
+# own thread (ISSUE 14 satellite): the heartbeat thread must NEVER call
+# into the runtime itself — a probe into a wedged runtime would hang the
+# very thread that exists to report the hang — so it reads this cache
+# instead, and a HUNG verdict carries memory context at the age the
+# driver last sampled it. RSS-only when no backend ever exposed stats.
+_DEV_MEM_LOCK = threading.Lock()
+_DEV_MEM: dict | None = None
+
+
+def note_device_memory(per_device: list) -> None:
+    """Cache the driver's latest per-device ``memory_stats()`` sample
+    (``[{device, bytes_in_use, peak_bytes_in_use, bytes_limit}, ...]``)
+    for heartbeat records. Called from the driver's telemetry cadence,
+    never from the heartbeat thread."""
+    global _DEV_MEM
+    with _DEV_MEM_LOCK:
+        _DEV_MEM = {"t": time.time(), "per_device": list(per_device)}
+
+
+def device_memory() -> dict | None:
+    """The cached sample with its staleness (``age_s``), or None when no
+    backend has exposed memory stats this process."""
+    with _DEV_MEM_LOCK:
+        if _DEV_MEM is None:
+            return None
+        return {
+            "age_s": round(time.time() - _DEV_MEM["t"], 1),
+            "per_device": list(_DEV_MEM["per_device"]),
+        }
+
 
 def rss_mb() -> float | None:
     """Resident set size in MiB via ``/proc/self/statm`` (Linux), None
@@ -82,6 +113,12 @@ class Heartbeat:
         rss = rss_mb()
         if rss is not None:
             kv["rss_mb"] = rss
+        dm = device_memory()
+        if dm is not None:
+            # per-device bytes_in_use context for the HUNG verdict
+            # (ISSUE 14) — read from the driver-maintained cache, never
+            # from a runtime call on this thread (see note_device_memory)
+            kv["device_memory"] = dm
         if self.extra is not None:
             kv.update(self.extra())
         self.beats += 1

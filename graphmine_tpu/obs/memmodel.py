@@ -1,0 +1,657 @@
+"""Analytical memory-plane model: what a plan SHOULD cost in HBM.
+
+The compute plane has achieved-vs-model attribution (``obs/costmodel.py``,
+r13) and the product has a quality plane (r14) — but memory, the resource
+every degradation ladder actually trips on, was modeled blind: the
+planner picked schedules against hand-seeded byte constants and nothing
+ever measured whether a run's real HBM peak matched the plan. This
+module is the memory plane's single owner (ISSUE 14), the direct
+analogue of the cost model, in the same GraphBLAST / propagation-blocking
+tradition (PAPERS arXiv 1908.01407, 2011.08451) where explicit workspace
+budgets ARE the scaling argument:
+
+1. **Per-plan footprint inventory** — for every superstep family (sort /
+   bucketed / blocked, fused and sharded) and LOF impl, derive a named
+   byte inventory **directly off the already-built plan/graph objects**:
+   CSR arrays, bucketed width-ladder mats, BlockedPlan stream+tile
+   slots, sharded twins plus the per-superstep all_gather exchange
+   buffer, LOF exact distance/top-k workspace vs IVF cluster-batched
+   workspace, weighted payload doubling
+   (:func:`superstep_footprint`, :func:`sharded_superstep_footprint`,
+   :func:`lof_footprint`). With a plan the counts are exact (the plan's
+   own matrix shapes); without one the estimate is anchored to the seed
+   constants below, so the pre-build view can never disagree with the
+   planner's accept/reject arithmetic.
+
+2. **One inventory, two consumers** — the byte seeds below
+   (:data:`BYTES_PER_EDGE` …) are THE constants
+   ``pipeline/planner.py``'s schedule model is derived from
+   (``estimate_bytes_per_device`` delegates to
+   :func:`schedule_bytes_per_device`); the same seeds decompose into the
+   :func:`schedule_inventory` components the ``plan`` record ships. A
+   recalibration (obs_report's memory section suggests one when measured
+   peaks drift from model) therefore moves the planner and the records
+   together, never one without the other.
+
+3. **Measured watermarks** — :func:`emit_memory_watermark` is the single
+   builder of schema-registered ``memory_watermark`` records (predicted
+   vs achieved bytes + ``headroom_frac``), fed by the driver's
+   ``memory_stats()`` samples at the existing phase/rung/telemetry
+   cadence (``memory_stats`` is a host-side allocator query — zero extra
+   device syncs) with host RSS as the backend-less fallback. The ``mem``
+   sub-record (:meth:`MemEstimate.record`) mirrors the ``cost``
+   sub-record: one builder, all-or-nothing validation
+   (``obs.schema.MEM_KEYS``), ``tools/schema_lint.py`` flags inline
+   ``mem={...}`` literals anywhere else.
+
+The model is deliberately coarse — a per-phase budget, not an allocator
+simulator. Its job is triage leverage: a rung whose predicted footprint
+exceeds budget pre-degrades at plan time with the inventory in the
+record (:func:`predegrade_superstep`), and a reactive OOM's degrade
+record carries the last watermark + inventory so model-miss vs
+fragmentation is triageable from the JSONL alone (docs/RUNBOOKS.md §14).
+
+Import discipline: **stdlib only** — no jax, no numpy. Plan objects are
+inspected by duck-typed attributes/shapes so this module loads on a
+machine with no accelerator stack at all (the ``obs/`` contract).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from math import sqrt
+
+from graphmine_tpu.obs.costmodel import (
+    _bucketed_padded_slots,
+    _plan_family,
+    _plan_weighted,
+)
+
+_I32 = 4  # bytes per int32/float32 slot — the one word size
+
+# ---- byte seeds (single owner) ---------------------------------------------
+#
+# The DESIGN.md-measured schedule model, decomposed: ``36 B/edge`` on the
+# fused path (edge endpoints + message CSR + plan mats + gather
+# transient), ``16 B/edge`` more when weighted (msg weights + slot-
+# aligned weight mats), and the per-vertex label/exchange terms of each
+# schedule. ``pipeline/planner.py`` derives its ``_BYTES_PER_*``
+# constants FROM these — edit here, both consumers move.
+BYTES_PER_EDGE = 36.0
+BYTES_PER_EDGE_WEIGHTED = 16.0
+SINGLE_BYTES_PER_VERTEX = 8.0
+REPLICATED_BYTES_PER_VERTEX = 16.0
+RING_BYTES_PER_VERTEX = 24.0  # divided by D (labels are sharded)
+
+# Pre-plan tile estimate for the blocked family (the real plan knows its
+# ``tile_alloc`` exactly): one bin's message-tile budget, mirroring
+# ops/blocking.DEFAULT_TILE_SLOTS (2^18 slots = 1 MiB) without importing
+# the jax-loading ops layer.
+BLOCKED_TILE_SLOTS_EST = 1 << 18
+
+# IVF cluster-batch balance pad (model seed): real Qmax/Lmax are
+# data-dependent cluster sizes; the model assumes balanced clusters of
+# n/C padded by this factor (k-means imbalance at the measured scales —
+# ops/ann.py pads to the true max).
+IVF_BALANCE_PAD = 2.0
+
+# The family ladder the plan-time pre-degrade walks — the same
+# blocked -> bucketed -> sort order as planner._SUPERSTEP_DEGRADE
+# (sort is the floor: None, nothing leaner exists).
+FAMILY_DEGRADE = {"blocked": "bucketed", "bucketed": "sort", "sort": None}
+
+
+@dataclass(frozen=True)
+class MemEstimate:
+    """Predicted peak HBM footprint for one operating point, as a named
+    per-device byte inventory. ``exact=True`` when the counts were read
+    off a built plan's real matrix shapes; False for pre-build estimates
+    (the ~10% ladder pad) and structural model seeds (IVF batches)."""
+
+    op: str
+    family: str          # superstep family / LOF impl / schedule name
+    devices: int
+    weighted: bool
+    inventory: dict      # component -> bytes per device
+    exact: bool
+    unit: str = "bytes/device"
+
+    @property
+    def total_bytes(self) -> int:
+        return int(sum(self.inventory.values()))
+
+    def record(self) -> dict:
+        """The ``mem`` sub-record (shape registered as
+        ``obs.schema.MEM_KEYS`` — a half-stamped copy fails validation
+        like a half-stamped cost record). This method is the SINGLE
+        builder: ``tools/schema_lint.py`` flags inline ``mem={...}``
+        literals anywhere else in the package."""
+        return {
+            "family": self.family,
+            "devices": self.devices,
+            "weighted": self.weighted,
+            "total_bytes": self.total_bytes,
+            "inventory": {
+                k: int(v) for k, v in sorted(self.inventory.items())
+            },
+            "exact": self.exact,
+            "unit": self.unit,
+        }
+
+
+# ---- schedule model (the planner's consumer) -------------------------------
+
+
+def schedule_bytes_per_device(
+    schedule: str,
+    num_vertices: int,
+    num_edges: int,
+    num_devices: int,
+    weighted: bool = False,
+) -> int:
+    """Modeled peak HBM per device for a whole-run ``schedule`` — the
+    EXACT arithmetic ``pipeline/planner.py`` has always planned against
+    (one ``int()`` over the float sum, so the planner's accept/reject
+    decisions are bit-identical to the pre-ISSUE-14 constants)."""
+    v = float(num_vertices)
+    e = float(num_edges)
+    d = float(max(num_devices, 1))
+    edge = BYTES_PER_EDGE + (BYTES_PER_EDGE_WEIGHTED if weighted else 0.0)
+    if schedule == "single":
+        return int(edge * e + SINGLE_BYTES_PER_VERTEX * v)
+    if schedule == "replicated":
+        return int(edge * e / d + REPLICATED_BYTES_PER_VERTEX * v)
+    if schedule == "ring":
+        return int(edge * e / d + RING_BYTES_PER_VERTEX * v / d)
+    raise ValueError(f"unknown schedule {schedule!r}")
+
+
+def schedule_inventory(
+    schedule: str,
+    num_vertices: int,
+    num_edges: int,
+    num_devices: int = 1,
+    weighted: bool = False,
+) -> dict:
+    """The seed constants decomposed into named components (per device).
+    Component sums reproduce :func:`schedule_bytes_per_device` to within
+    per-term rounding: 36 B/edge = endpoints 8 + CSR 16 + plan mats 6 +
+    gather transient 6; weighted adds msg weights 8 + weight mats 8; the
+    per-vertex terms are each schedule's label/exchange model."""
+    v = float(num_vertices)
+    e = float(num_edges)
+    d = float(max(num_devices, 1))
+    div = 1.0 if schedule == "single" else d
+    inv = {
+        "edge_endpoints": 8.0 * e / div,
+        "message_csr": 16.0 * e / div,
+        "plan_mats": 6.0 * e / div,
+        "gather_transient": 6.0 * e / div,
+    }
+    if weighted:
+        inv["msg_weights"] = 8.0 * e / div
+        inv["weight_mats"] = 8.0 * e / div
+    if schedule == "single":
+        inv["labels"] = 8.0 * v
+    elif schedule == "replicated":
+        inv["labels_replicated"] = 8.0 * v
+        inv["exchange_buffer"] = 8.0 * v
+    elif schedule == "ring":
+        inv["labels_sharded"] = 8.0 * v / d
+        inv["ring_chunks"] = 16.0 * v / d
+    else:
+        raise ValueError(f"unknown schedule {schedule!r}")
+    return {k: int(b) for k, b in inv.items()}
+
+
+def schedule_footprint(
+    schedule: str,
+    num_vertices: int,
+    num_edges: int,
+    num_devices: int = 1,
+    weighted: bool = False,
+    op: str = "run_plan",
+) -> MemEstimate:
+    """The whole-run schedule model as a :class:`MemEstimate` — what the
+    driver's ``plan`` record ships alongside the planner's verdict."""
+    return MemEstimate(
+        op=op, family=schedule, devices=max(int(num_devices), 1),
+        weighted=bool(weighted),
+        inventory=schedule_inventory(
+            schedule, num_vertices, num_edges, num_devices, weighted
+        ),
+        exact=False,
+    )
+
+
+# ---- fused superstep families ----------------------------------------------
+
+
+def superstep_footprint(
+    op: str,
+    family: str,
+    num_vertices: int,
+    num_messages: int,
+    num_edges: int | None = None,
+    plan=None,
+    weighted: bool | None = None,
+) -> MemEstimate:
+    """Footprint of ONE fused (single-device) superstep operating point.
+
+    With a built ``plan`` the counts are EXACT — the plan's own matrix
+    shapes: edge endpoints + the message CSR + labels in/out + msg
+    weights, plus per family the width-ladder mats + vertex ids (+
+    slot-aligned weight mats) + the gathered transient (bucketed), or
+    the sender-major stream pair + the destination-binned tile + reduce
+    rows + owners (+ weight mats) + the row-gather transient (blocked).
+
+    WITHOUT a plan (the driver's plan-time pre-degrade fires before any
+    build) the estimate is anchored to the SAME seed constants the
+    planner accepted the run with — the fused bucketed path IS the
+    measured ``BYTES_PER_EDGE`` model, so ``bucketed`` reproduces
+    :func:`schedule_inventory`'s single-device decomposition exactly
+    (the two consumers can never disagree about the path the planner
+    just admitted, so an admitted run never spuriously pre-degrades),
+    ``sort`` drops the plan-mats term (the planner's documented
+    degradation saving), and ``blocked`` adds the stream pair + tile
+    the 36 B/edge seed predates.
+    """
+    if plan is not None:
+        family = _plan_family(plan)
+        if weighted is None:
+            weighted = _plan_weighted(plan)
+    weighted = bool(weighted)
+    v = int(num_vertices)
+    m = max(int(num_messages), 1)
+    e = int(num_edges) if num_edges is not None else m // 2
+    if family not in ("sort", "bucketed", "blocked"):
+        raise ValueError(f"unknown superstep family {family!r}")
+    if plan is None:
+        # Seed-anchored estimates (see docstring): the bucketed path is
+        # the measured schedule model verbatim, so an admitted run can
+        # never pre-degrade off the family the planner just accepted.
+        inv = schedule_inventory("single", v, e, 1, weighted)
+        if family == "sort":
+            del inv["plan_mats"]
+        elif family == "blocked":
+            inv["stream"] = 2 * _I32 * m
+            inv["tile"] = _I32 * min(m, BLOCKED_TILE_SLOTS_EST)
+        return MemEstimate(
+            op=op, family=family, devices=1, weighted=weighted,
+            inventory=inv, exact=False,
+        )
+    inv = {
+        "edge_endpoints": 2 * _I32 * e,
+        "message_csr": _I32 * (2 * m + v + 1),
+        "labels": 2 * _I32 * v,
+    }
+    if weighted:
+        inv["msg_weights"] = _I32 * m
+    if family == "sort":
+        inv["gather_transient"] = _I32 * m * (2 if weighted else 1)
+    elif family == "bucketed":
+        padded = _bucketed_padded_slots(plan)
+        ids = sum(int(x.shape[0]) for x in (plan.vertex_ids or ()))
+        if plan.hist_vertex_ids is not None:
+            ids += int(plan.hist_vertex_ids.shape[0])
+        inv["plan_mats"] = _I32 * padded
+        inv["plan_vertex_ids"] = _I32 * ids
+        if weighted:
+            inv["weight_mats"] = _I32 * padded
+        inv["gather_transient"] = _I32 * padded
+    else:
+        rows = int(plan.padded_row_slots)
+        owners = sum(int(r.shape[0]) for r in plan.row_idx)
+        inv["stream"] = 2 * _I32 * m
+        inv["tile"] = _I32 * int(plan.tile_alloc)
+        inv["reduce_rows"] = _I32 * rows
+        inv["row_vertex"] = _I32 * owners
+        if weighted:
+            inv["weight_mats"] = _I32 * rows
+        inv["gather_transient"] = _I32 * rows
+    return MemEstimate(
+        op=op, family=family, devices=1, weighted=weighted,
+        inventory=inv, exact=True,
+    )
+
+
+# ---- sharded supersteps ----------------------------------------------------
+
+
+def _per_chip_bytes(arr) -> int:
+    """Per-chip bytes of one stacked ``[D, ...]`` shard array."""
+    n = 1
+    for s in arr.shape[1:]:
+        n *= int(s)
+    return _I32 * n
+
+
+def sharded_superstep_footprint(
+    op: str,
+    sg,
+    weighted: bool | None = None,
+    schedule: str = "replicated",
+) -> MemEstimate:
+    """Per-chip footprint of ONE sharded superstep, derived from a built
+    ``ShardedGraph`` (shapes only — no device sync, no jax import; the
+    ``sharded_superstep_cost`` contract).
+
+    The shard arrays are counted at their REAL stacked shapes (the
+    sharded twins of the fused inventory, padding included); the label
+    terms follow ``schedule``: ``replicated`` holds the full label
+    vector + updated copy plus the per-superstep all_gather exchange
+    buffer, ``ring`` keeps labels sharded with two rotating ppermute
+    chunks + staging (no replicated V-term at all — exactly why it is
+    the planner's memory floor)."""
+    d = int(sg.num_shards)
+    vc = int(sg.chunk_size)
+    v = int(sg.num_vertices)
+    if weighted is None:
+        weighted = (
+            sg.msg_weight is not None
+            or bool(sg.bucket_weight) or bool(sg.blk_row_weight)
+        )
+    weighted = bool(weighted)
+    # NOTE: shard_graph_arrays(lpa_only=True) trims the sort-body CSR
+    # (msg_recv_local/msg_send/degrees may all be None on a bucketed or
+    # blocked partition) — count only the arrays that exist, exactly
+    # like sharded_superstep_cost.
+    inv: dict = {}
+    if sg.degrees is not None:
+        inv["degrees"] = _per_chip_bytes(sg.degrees)
+    msgs = 0
+    if sg.msg_recv_local is not None:
+        msgs += _per_chip_bytes(sg.msg_recv_local)
+    if sg.msg_send is not None:
+        msgs += _per_chip_bytes(sg.msg_send)
+    if msgs:
+        inv["shard_messages"] = msgs
+    if sg.msg_weight is not None:
+        inv["msg_weights"] = _per_chip_bytes(sg.msg_weight)
+    if sg.blk_src is not None:
+        family = "blocked"
+        inv["stream"] = (
+            _per_chip_bytes(sg.blk_src) + _per_chip_bytes(sg.blk_pos)
+        )
+        inv["tile"] = _I32 * int(sg.blk_tile_alloc)
+        rows = sum(_per_chip_bytes(r) for r in sg.blk_row_idx)
+        inv["reduce_rows"] = rows
+        inv["row_vertex"] = sum(
+            _per_chip_bytes(t) for t in sg.blk_row_target
+        )
+        if sg.blk_row_weight:
+            inv["weight_mats"] = sum(
+                _per_chip_bytes(w) for w in sg.blk_row_weight
+            )
+        inv["gather_transient"] = rows
+    elif sg.bucket_send:
+        family = "bucketed"
+        mats = sum(_per_chip_bytes(b) for b in sg.bucket_send)
+        inv["plan_mats"] = mats
+        inv["plan_vertex_ids"] = sum(
+            _per_chip_bytes(t) for t in sg.bucket_target
+        )
+        if sg.bucket_weight:
+            inv["weight_mats"] = sum(
+                _per_chip_bytes(w) for w in sg.bucket_weight
+            )
+        inv["gather_transient"] = mats
+    else:
+        family = "sort"
+        inv["gather_transient"] = msgs // (2 if sg.msg_send is not None
+                                           and sg.msg_recv_local is not None
+                                           else 1)
+    if schedule == "ring":
+        inv["labels_sharded"] = 2 * _I32 * vc
+        inv["ring_chunks"] = 2 * _I32 * vc
+        inv["exchange_staging"] = 2 * _I32 * vc
+    else:
+        inv["labels_replicated"] = 2 * _I32 * v
+        inv["exchange_buffer"] = 2 * _I32 * vc * d
+    return MemEstimate(
+        op=op, family=family, devices=d, weighted=weighted,
+        inventory=inv, exact=True,
+    )
+
+
+# ---- LOF impls -------------------------------------------------------------
+
+
+def ivf_model_clusters(n: int) -> int:
+    """Mirror of ``ops/ann.default_n_clusters`` (~sqrt(N), rounded to a
+    multiple of 8, min 8) — duplicated here as a model seed because the
+    ops layer imports jax and this module must not."""
+    return max(8, int(round(sqrt(max(int(n), 1)) / 8)) * 8)
+
+
+def lof_footprint(
+    impl: str,
+    n: int,
+    k: int,
+    features: int = 8,
+    devices: int = 1,
+) -> MemEstimate:
+    """Workspace footprint of one LOF scoring pass over ``[n, features]``.
+
+    - **exact**: the ``[rows, n]`` all-pairs distance tile (the
+      ring-sharded scorer splits the rows 1/D) + the top-k
+      distance/index workspace.
+    - **ivf**: centers + assignments + ONE cluster-batched search block
+      (query block, distance block, per-batch top-k) under the balanced-
+      cluster model (``n/C`` padded by :data:`IVF_BALANCE_PAD`); the
+      real Qmax/Lmax are data-dependent, which is exactly why the
+      measured watermark rides next to this estimate.
+    """
+    n = int(n)
+    k = max(int(k), 1)
+    f = int(features)
+    d = max(int(devices), 1)
+    if impl not in ("exact", "ivf"):
+        raise ValueError(f"unknown LOF impl family {impl!r}")
+    inv: dict = {"features": _I32 * n * f, "scores": _I32 * n}
+    if impl == "exact":
+        rows = -(-n // d)
+        inv["distance_tile"] = _I32 * rows * n
+        inv["topk_workspace"] = 2 * _I32 * rows * k
+    else:
+        c = ivf_model_clusters(n)
+        b = int(IVF_BALANCE_PAD * n / c) + 1
+        inv["centers"] = _I32 * c * f
+        inv["assignments"] = 2 * _I32 * n
+        inv["cluster_batch"] = _I32 * (b * f + b * b + 2 * b * k)
+    return MemEstimate(
+        op="lof_knn", family=impl, devices=d, weighted=False,
+        inventory=inv, exact=False,
+    )
+
+
+# ---- plan-time pre-degrade -------------------------------------------------
+
+
+def predegrade_superstep(
+    family: str,
+    num_vertices: int,
+    num_messages: int,
+    num_edges: int,
+    weighted: bool,
+    budget_bytes: int,
+):
+    """Walk the family ladder at PLAN time until the modeled footprint
+    fits ``budget_bytes`` — the proactive twin of the driver's reactive
+    OOM rungs: a rung the model already knows cannot fit is consumed
+    before any device allocation, with the oversized inventory in the
+    degrade record instead of an XLA OOM minutes later.
+
+    Returns ``(family, fit_estimate, steps)`` where ``steps`` is the
+    ``(from_family, to_family, oversized_estimate)`` descent trail
+    (empty = the requested family fits). The sort floor is returned
+    even when it does not fit: there is nothing leaner, and the
+    planner's schedule model already accepted the run — the reactive
+    ladder (and the watermark trail) owns whatever happens next."""
+    budget = int(budget_bytes)
+    steps = []
+    while True:
+        est = superstep_footprint(
+            "lpa_superstep", family, num_vertices, num_messages,
+            num_edges=num_edges, weighted=weighted,
+        )
+        nxt = FAMILY_DEGRADE.get(family)
+        if est.total_bytes <= budget or nxt is None:
+            return family, est, steps
+        steps.append((family, nxt, est))
+        family = nxt
+
+
+# ---- measured watermarks ---------------------------------------------------
+
+
+def rss_sample() -> dict | None:
+    """Host-RSS fallback measurement for backends whose allocator does
+    not report ``memory_stats()`` (CPU smoke runs, some tunneled
+    runtimes) — the watermark then says so (``source: "rss"``) instead
+    of silently comparing device model against nothing."""
+    from graphmine_tpu.obs.heartbeat import rss_mb
+
+    rss = rss_mb()
+    if rss is None:
+        return None
+    b = int(rss * (1 << 20))
+    return {"bytes_in_use": b, "peak_bytes_in_use": b, "source": "rss"}
+
+
+def emit_memory_watermark(
+    sink,
+    op: str,
+    est: MemEstimate | None,
+    measured: dict | None,
+    budget_bytes: int | None = None,
+    **kv,
+) -> dict | None:
+    """Emit one ``memory_watermark`` record: the operating point's
+    predicted footprint next to the measured bytes (device allocator or
+    RSS fallback), plus ``headroom_frac`` against the planning budget.
+    No-op without a sink, estimate or measurement (a record claiming a
+    comparison neither side made would poison the waterfall). This is
+    the record's single emission point — the schema-registered shape and
+    the ``mem`` sub-record builder live together.
+
+    ``achieved_bytes`` is the CURRENT ``bytes_in_use`` at the sampling
+    boundary — the phase-attributable number the waterfall and the
+    recalibration suggestion compare against this phase's model.
+    ``peak_bytes_in_use`` is a process-LIFETIME allocator high-water
+    mark (no portable reset exists), so it rides the record as context
+    and drives ``headroom_frac`` (how close the PROCESS ever came to the
+    budget — the conservative OOM-forecast number), but is never
+    attributed to the phase that happened to sample it."""
+    if sink is None or est is None or not measured:
+        return None
+    achieved = measured.get("bytes_in_use")
+    if achieved is None:
+        achieved = measured.get("peak_bytes_in_use")
+    if achieved is None:
+        return None
+    achieved = int(achieved)
+    headroom = None
+    # headroom is only meaningful when the measurement and the budget
+    # live in the same domain: a host-RSS fallback judged against a
+    # per-device HBM budget would print a confident nonsense fraction
+    # (and trip low-headroom rules on zero device pressure).
+    if budget_bytes and measured.get("source", "device") == "device":
+        worst = int(measured.get("peak_bytes_in_use") or achieved)
+        headroom = round((int(budget_bytes) - worst) / int(budget_bytes), 4)
+    rec = dict(
+        op=op,
+        predicted_bytes=est.total_bytes,
+        achieved_bytes=achieved,
+        headroom_frac=headroom,
+        source=measured.get("source", "device"),
+        mem=est.record(),
+        **kv,
+    )
+    if budget_bytes:
+        rec["budget_bytes"] = int(budget_bytes)
+    for opt in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        if measured.get(opt) is not None:
+            rec[opt] = int(measured[opt])
+    return sink.emit("memory_watermark", **rec)
+
+
+# ---- serve-process accounting ---------------------------------------------
+
+
+def serve_mem_budget_bytes() -> int | None:
+    """The serve-process memory budget headroom is judged against:
+    ``GRAPHMINE_SERVE_MEM_BUDGET_BYTES`` (malformed raises loudly — the
+    AdmissionBounds discipline) falling back to host ``MemTotal``
+    (/proc/meminfo), None where neither exists."""
+    raw = os.environ.get("GRAPHMINE_SERVE_MEM_BUDGET_BYTES")
+    if raw:
+        try:
+            return int(float(raw))
+        except ValueError as e:
+            raise ValueError(
+                f"GRAPHMINE_SERVE_MEM_BUDGET_BYTES={raw!r} is not a number"
+            ) from e
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+# The graphmine_memory_* gauge surface — ONE owner for the metric names
+# and help strings (server /metrics, the fleet router, and the WAL's
+# segment accounting all export from this table; registry.gauge is
+# get-or-create with first-help-wins, so duplicated literals would
+# silently diverge).
+MEMORY_GAUGE_HELP = {
+    "graphmine_memory_rss_bytes":
+        "resident set size of this serve process",
+    "graphmine_memory_snapshot_bytes":
+        "array bytes of the snapshot currently serving queries",
+    "graphmine_memory_index_bytes":
+        "derived query-index bytes (adjacency, census, explain)",
+    "graphmine_memory_wal_segment_bytes":
+        "bytes held by retained write-ahead-log segments",
+    "graphmine_memory_headroom_frac":
+        "fraction of the process memory budget still free",
+}
+
+_GAUGE_OF_KEY = {
+    "rss_bytes": "graphmine_memory_rss_bytes",
+    "snapshot_bytes": "graphmine_memory_snapshot_bytes",
+    "index_bytes": "graphmine_memory_index_bytes",
+    "wal_segment_bytes": "graphmine_memory_wal_segment_bytes",
+    "headroom_frac": "graphmine_memory_headroom_frac",
+}
+
+
+def export_memory_gauges(registry, payload: dict) -> None:
+    """Mirror a memory payload's present keys into the
+    ``graphmine_memory_*`` gauges (absent/None keys leave their gauge
+    untouched — a router payload has no snapshot bytes to zero out)."""
+    for key, name in _GAUGE_OF_KEY.items():
+        val = payload.get(key)
+        if val is not None:
+            registry.gauge(name, MEMORY_GAUGE_HELP[name]).set(val)
+
+
+def host_memory(budget_bytes: int | None = None) -> dict:
+    """RSS + headroom for one serve process — the shared core of the
+    replica's and the fleet router's ``/statusz`` memory sections."""
+    from graphmine_tpu.obs.heartbeat import rss_mb
+
+    rss = rss_mb()
+    rss_bytes = int(rss * (1 << 20)) if rss is not None else None
+    headroom = None
+    if budget_bytes and rss_bytes is not None:
+        headroom = round((budget_bytes - rss_bytes) / budget_bytes, 4)
+    return {
+        "rss_bytes": rss_bytes,
+        "budget_bytes": budget_bytes,
+        "headroom_frac": headroom,
+    }

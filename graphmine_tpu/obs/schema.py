@@ -84,6 +84,18 @@ register("superstep_timing", "op", "family", "variant", "iteration",
          "predicted_edges_per_sec_per_chip", "achieved_fraction",
          "devices", "cost")
 
+# memory_watermark (ISSUE 14): predicted-vs-measured HBM/RSS for one
+# operating point, emitted by obs/memmodel.emit_memory_watermark (the
+# single builder) at the existing phase/rung/telemetry cadence — zero
+# extra device syncs (memory_stats is a host-side allocator query).
+# `headroom_frac` may be None when no budget is known; `source` says
+# whether `achieved_bytes` is a device allocator peak ("device") or the
+# host-RSS fallback ("rss"). The `mem` sub-record carries the full
+# inventory (see MEM_KEYS below). obs_report's memory section renders
+# the per-phase predicted-vs-peak waterfall from these.
+register("memory_watermark", "op", "predicted_bytes", "achieved_bytes",
+         "headroom_frac", "source", "mem")
+
 # ---- serving records (docs/SERVING.md) ------------------------------------
 register("snapshot_publish", "version", "snapshot_id", "path", "bytes",
          "arrays", "seconds")
@@ -215,6 +227,17 @@ COST_KEYS = frozenset((
     "predicted_per_chip", "unit", "roofline",
 ))
 
+# The `mem` sub-record shape (obs/memmodel.MemEstimate.record — the
+# single builder; tools/schema_lint.py flags inline mem={...} literals
+# elsewhere in the package). Same all-or-nothing rule as `cost`: a
+# record carrying `mem` must carry EVERY key below, or the memory-plane
+# tooling (obs_report's waterfall, the recalibration suggestion) would
+# silently render holes.
+MEM_KEYS = frozenset((
+    "family", "devices", "weighted", "total_bytes", "inventory", "exact",
+    "unit",
+))
+
 # The sketch sub-record shape (obs/sketch.QuantileSketch.to_state — the
 # single builder; tools/schema_lint.py flags inline *_sketch={...}
 # literals elsewhere). Same all-or-nothing rule as `cost`: a record
@@ -266,6 +289,21 @@ def validate_record(rec) -> list:
                     f"{phase}: half-stamped {key} sub-record (missing "
                     f"{missing}) — build it with obs/sketch "
                     "QuantileSketch.to_state()"
+                )
+    if "mem" in rec:
+        mem = rec["mem"]
+        if not isinstance(mem, dict):
+            problems.append(
+                f"{phase}: mem sub-record is {type(mem).__name__}, not "
+                "dict — build it with obs/memmodel MemEstimate.record()"
+            )
+        else:
+            missing = sorted(k for k in MEM_KEYS if k not in mem)
+            if missing:
+                problems.append(
+                    f"{phase}: half-stamped mem sub-record (missing "
+                    f"{missing}) — build it with obs/memmodel "
+                    "MemEstimate.record()"
                 )
     if "cost" in rec:
         cost = rec["cost"]

@@ -35,23 +35,89 @@ def _visible_devices() -> int:
     return len(jax.devices())
 
 
-def device_hbm_bytes() -> int | None:
-    """Best-effort real per-device HBM via ``memory_stats()``.
+def device_hbm_bytes(devices=None) -> int | None:
+    """Best-effort real per-device HBM via ``memory_stats()``: the MIN
+    of ``bytes_limit`` across all local devices (ISSUE 14 satellite) — a
+    heterogeneous or partially-occupied mesh must plan against its
+    smallest chip, and trusting ``jax.devices()[0]`` alone budgeted
+    against whichever part happened to enumerate first.
 
-    Returns None when the backend doesn't report it (CPU returns None,
-    some tunneled runtimes raise) — the planner then falls back to its
+    Returns None when no backend reports it (CPU returns None, some
+    tunneled runtimes raise) — the planner then falls back to its
     16 GiB default. Queried here, not in the planner, so host-side
-    planning paths never import jax (planner.hbm_bytes_per_device)."""
-    import jax
+    planning paths never import jax (planner.hbm_bytes_per_device).
+    ``devices`` overrides the enumeration (tests)."""
+    if devices is None:
+        import jax
 
+        try:
+            devices = jax.local_devices()
+        except Exception:
+            return None
+    limits = []
+    for dev in devices:
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            continue
+        if not stats:
+            continue
+        limit = stats.get("bytes_limit")
+        if limit and limit > 0:
+            limits.append(int(limit))
+    return min(limits) if limits else None
+
+
+def _memory_sample() -> dict | None:
+    """Measured memory for ``memory_watermark`` records (ISSUE 14):
+    per-device ``bytes_in_use``/``peak_bytes_in_use`` when the backend's
+    allocator exposes them (also cached for the heartbeat thread, which
+    must never probe the runtime itself — obs/heartbeat.py), host RSS
+    otherwise (``source: "rss"``). ``memory_stats`` is a host-side
+    allocator query — sampling at the telemetry cadence adds zero
+    device syncs."""
+    per = []
     try:
-        stats = jax.devices()[0].memory_stats()
+        import jax
+
+        for dev in jax.local_devices():
+            try:
+                stats = dev.memory_stats() or {}
+            except Exception:
+                continue
+            if stats.get("bytes_in_use") is None:
+                continue
+            in_use = int(stats["bytes_in_use"])
+            per.append({
+                "device": int(getattr(dev, "id", len(per))),
+                "bytes_in_use": in_use,
+                "peak_bytes_in_use": int(
+                    stats.get("peak_bytes_in_use") or in_use
+                ),
+                "bytes_limit": int(stats.get("bytes_limit") or 0) or None,
+            })
     except Exception:
-        return None
-    if not stats:
-        return None
-    limit = stats.get("bytes_limit")
-    return int(limit) if limit and limit > 0 else None
+        per = []
+    if per:
+        from graphmine_tpu.obs.heartbeat import note_device_memory
+
+        note_device_memory(per)
+        # achieved is the CURRENT fleet-wide max (phase-attributable);
+        # the lifetime peak and the smallest limit ride as context — the
+        # device holding an old allocator peak may be near-idle NOW,
+        # and reporting its current bytes would understate the phase.
+        limits = [s["bytes_limit"] for s in per if s["bytes_limit"]]
+        return {
+            "bytes_in_use": max(s["bytes_in_use"] for s in per),
+            "peak_bytes_in_use": max(
+                s["peak_bytes_in_use"] for s in per
+            ),
+            "bytes_limit": min(limits) if limits else None,
+            "source": "device",
+        }
+    from graphmine_tpu.obs.memmodel import rss_sample
+
+    return rss_sample()
 
 
 @dataclass
@@ -198,12 +264,22 @@ def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
             requested=config.schedule,
             hbm=hbm_bytes_per_device(device_hbm_bytes),
         )
+        from graphmine_tpu.obs.memmodel import schedule_footprint
+
         m.emit(
             "plan",
             schedule=run_plan.schedule,
             bytes_per_device=run_plan.bytes_per_device,
             hbm_budget=run_plan.hbm_bytes,
             reason=run_plan.reason,
+            # the memory plane's named inventory behind bytes_per_device
+            # (ISSUE 14): the same seeds the planner's accept/reject used,
+            # decomposed — obs_report's recalibration suggestion compares
+            # measured watermarks against exactly these components
+            mem=schedule_footprint(
+                run_plan.schedule, table.num_vertices, table.num_edges,
+                n_dev, weighted=table.weights is not None,
+            ).record(),
         )
     # The fused LPA plan is only consumed by the single-device jax LPA
     # path; build it (from the same message-CSR pass as the Graph) only
@@ -242,6 +318,40 @@ def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
                 reason=sstep_plan.reason + " — driver single path: plan "
                 "build shares the graph's CSR pass, bucketed kernel kept",
             )
+        # Plan-time memory pre-degrade (ISSUE 14): a family whose MODELED
+        # footprint already exceeds the planning budget cannot survive
+        # the build — consume its rung NOW, with the oversized inventory
+        # in the degrade record, instead of letting XLA OOM after the
+        # plan materializes. Honors degradation="off" (an operator who
+        # sized the run wants the OOM, not a silently leaner family).
+        if config.resilience.degradation == "auto":
+            from graphmine_tpu.obs.memmodel import predegrade_superstep
+            from graphmine_tpu.pipeline.planner import _SUPERSTEP_DEGRADE
+
+            fam, _fit, steps = predegrade_superstep(
+                sstep_plan.family, table.num_vertices, 2 * table.num_edges,
+                table.num_edges, table.weights is not None,
+                run_plan.hbm_bytes,
+            )
+            for depth, (frm, to, oversized) in enumerate(steps, 1):
+                m.emit(
+                    "degrade", stage="plan_superstep", to=to, depth=depth,
+                    kind="mem_plan",
+                    error=(
+                        f"plan-time memory pre-degrade: modeled {frm!r} "
+                        f"footprint {oversized.total_bytes:,} B exceeds "
+                        f"the {run_plan.hbm_bytes:,} B budget"
+                    ),
+                    mem=oversized.record(),
+                )
+            if steps:
+                sstep_plan = _dc.replace(
+                    sstep_plan, family=fam,
+                    degrade_to=_SUPERSTEP_DEGRADE[fam],
+                    reason=sstep_plan.reason
+                    + f" — pre-degraded to {fam!r}: modeled footprint of "
+                    f"{steps[0][0]!r} exceeds the memory budget",
+                )
         from graphmine_tpu.obs.costmodel import superstep_cost
         from graphmine_tpu.ops.blocking import crossover_thresholds
 
@@ -431,6 +541,31 @@ def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
         # rescue rung. The scorers re-apply the same policy function and
         # emit the impl_selected record through the sink.
         lof_plan = plan_lof(graph.num_vertices, k, requested=config.lof_impl)
+        # Memory plane (ISSUE 14): the planned impl's workspace inventory
+        # (exact [rows, n] distance/top-k tiles vs the IVF cluster-batched
+        # model) — watermarked after scoring, attached to any OOM degrade.
+        from graphmine_tpu.obs.memmodel import (
+            emit_memory_watermark,
+            lof_footprint,
+        )
+
+        lof_mem_holder = [lof_footprint(
+            lof_plan.impl, graph.num_vertices, k, features=8,
+            devices=n_dev if use_sharded_lof else 1,
+        )]
+
+        def _lof_degrade_context() -> dict:
+            return {"mem": lof_mem_holder[0].record()}
+
+        def _lof_rung_entered() -> None:
+            # The ladder rung runs the OPPOSITE impl: re-point the holder
+            # so the post-phase watermark pairs the surviving rung's
+            # model with its measured peak (the failed primary's model
+            # already rode the degrade record via _lof_degrade_context).
+            lof_mem_holder[0] = lof_footprint(
+                lof_plan.degrade_to, graph.num_vertices, k, features=8,
+                devices=n_dev if use_sharded_lof else 1,
+            )
         if use_sharded_lof and config.lof_impl in ("xla", "pallas"):
             m.emit(
                 "warning",
@@ -521,12 +656,15 @@ def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
                         sink=m,
                     )
 
-                ladder = ((
-                    f"lof_sharded_{lof_plan.degrade_to}",
-                    lambda: sharded_lof(
+                def _rung_sharded():
+                    _lof_rung_entered()
+                    return sharded_lof(
                         feats, make_mesh(n_dev), k=k,
                         impl=lof_plan.degrade_to, sink=m,
-                    ),
+                    )
+
+                ladder = ((
+                    f"lof_sharded_{lof_plan.degrade_to}", _rung_sharded,
                 ),)
             else:
                 # Planner-selected family (r6): impl="auto" deploys the
@@ -546,14 +684,28 @@ def _run_pipeline(config: PipelineConfig, m: MetricsSink) -> PipelineResult:
                 rung_impl = (
                     "xla" if lof_plan.degrade_to == "exact" else "ivf"
                 )
+
+                def _rung_fused():
+                    _lof_rung_entered()
+                    return lof_scores(feats, k=k, impl=rung_impl, sink=m)
+
                 ladder = ((
-                    f"lof_{lof_plan.degrade_to}",
-                    lambda: lof_scores(feats, k=k, impl=rung_impl, sink=m),
+                    f"lof_{lof_plan.degrade_to}", _rung_fused,
                 ),)
             scores = resilience.run_phase(
-                "outliers_lof", _score, config.resilience, m, ladder=ladder
+                "outliers_lof", _score, config.resilience, m, ladder=ladder,
+                degrade_context=_lof_degrade_context,
             )
             result.lof = np.asarray(scores)
+            # Phase-cadence watermark (ISSUE 14): the workspace model of
+            # the impl that actually SCORED (the holder re-points on a
+            # rung entry) vs the bytes peaked while scoring.
+            emit_memory_watermark(
+                m, "lof_knn", lof_mem_holder[0], _memory_sample(),
+                budget_bytes=run_plan.hbm_bytes if run_plan is not None
+                else None,
+                impl=lof_mem_holder[0].family,
+            )
         m.emit(
             "outlier_summary",
             method="lof",
@@ -766,6 +918,11 @@ def _run_lpa(
         sharded_superstep_cost,
         superstep_cost,
     )
+    from graphmine_tpu.obs.memmodel import (
+        emit_memory_watermark,
+        sharded_superstep_footprint,
+        superstep_footprint,
+    )
     from graphmine_tpu.parallel.mesh import make_mesh
     from graphmine_tpu.parallel.sharded import (
         partition_graph,
@@ -830,6 +987,38 @@ def _run_lpa(
     # by whatever is current (a checkpoint's shard count is metadata, not
     # a restore constraint — load_sharded re-shards).
     current = {"ndev": n_dev, "variant": run_plan.schedule}
+    # The last memory_watermark record emitted (ISSUE 14): a reactive
+    # OOM's degrade record attaches it (plus the active operating
+    # point's modeled inventory) via run_phase's degrade_context, so
+    # model-miss vs fragmentation is triageable from the JSONL alone —
+    # joinable back to the full watermark by span path.
+    last_watermark: dict = {"rec": None}
+
+    def _mem_watermark(op_iteration: int, variant: str, ndev: int) -> None:
+        rec = emit_memory_watermark(
+            m, "lpa_superstep", current.get("mem"), _memory_sample(),
+            budget_bytes=run_plan.hbm_bytes, iteration=int(op_iteration),
+            variant=variant, devices=int(ndev),
+        )
+        if rec is not None:
+            last_watermark["rec"] = rec
+
+    def _lpa_degrade_context() -> dict:
+        ctx = {}
+        est = current.get("mem")
+        if est is not None:
+            ctx["mem"] = est.record()
+        w = last_watermark["rec"]
+        if w is not None:
+            ctx["last_watermark"] = {
+                k: w.get(k)
+                for k in (
+                    "t", "op", "iteration", "predicted_bytes",
+                    "achieved_bytes", "headroom_frac", "source",
+                    "span_path",
+                )
+            }
+        return ctx
     # Device indices implicated in a device-loss error (parsed best-effort
     # from its message): the runtime usually still LISTS a chip that just
     # failed a collective, and a rung mesh built from the first N visible
@@ -885,6 +1074,9 @@ def _run_lpa(
                 "lpa_superstep", sg, graph.num_edges,
                 num_messages=graph.num_messages,
             )
+            current["mem"] = sharded_superstep_footprint(
+                "lpa_superstep", sg, schedule="ring",
+            )
             return lambda lbl: ring_label_propagation(
                 sg, mesh, max_iter=1, init_labels=lbl
             )
@@ -901,6 +1093,9 @@ def _run_lpa(
                 "lpa_superstep", sg, graph.num_edges,
                 num_messages=graph.num_messages,
             )
+            current["mem"] = sharded_superstep_footprint(
+                "lpa_superstep", sg, schedule="replicated",
+            )
             return lambda lbl: sharded_label_propagation(
                 sg, mesh, max_iter=1, init_labels=lbl
             )
@@ -914,6 +1109,11 @@ def _run_lpa(
             current["cost"] = superstep_cost(
                 "lpa_superstep", "sort", graph.num_vertices,
                 graph.num_messages, graph.num_edges,
+                weighted=graph.msg_weight is not None,
+            )
+            current["mem"] = superstep_footprint(
+                "lpa_superstep", "sort", graph.num_vertices,
+                graph.num_messages, num_edges=graph.num_edges,
                 weighted=graph.msg_weight is not None,
             )
             step = jax.jit(lpa_superstep)
@@ -932,6 +1132,10 @@ def _run_lpa(
             current["cost"] = superstep_cost(
                 "lpa_superstep", "bucketed", graph.num_vertices,
                 graph.num_messages, graph.num_edges, plan=plan,
+            )
+            current["mem"] = superstep_footprint(
+                "lpa_superstep", "bucketed", graph.num_vertices,
+                graph.num_messages, num_edges=graph.num_edges, plan=plan,
             )
             m.emit(
                 "plan_build", op="lpa_superstep", seconds=round(secs, 6),
@@ -962,6 +1166,10 @@ def _run_lpa(
         current["cost"] = superstep_cost(
             "lpa_superstep", "auto", graph.num_vertices,
             graph.num_messages, graph.num_edges, plan=plan,
+        )
+        current["mem"] = superstep_footprint(
+            "lpa_superstep", "auto", graph.num_vertices,
+            graph.num_messages, num_edges=graph.num_edges, plan=plan,
         )
         step = jax.jit(
             lpa_superstep_blocked if isinstance(plan, BlockedPlan)
@@ -1123,6 +1331,11 @@ def _run_lpa(
             # operating points — the cost model it is judged against is
             # per-point.
             wtimer.reset()
+            # Rung-entry watermark (ISSUE 14): predicted footprint of the
+            # operating point just built vs the bytes actually resident —
+            # the baseline an OOM later in this rung is triaged against
+            # (memory_stats is a host query; no device sync).
+            _mem_watermark(state["it"], var, nd)
             while state["it"] < config.max_iter:
                 it = state["it"]
 
@@ -1209,6 +1422,10 @@ def _run_lpa(
                             m, "lpa_superstep", current.get("cost"),
                             it + 1, graph.num_edges, variant=var,
                         )
+                        # memory_watermark rides the same boundary
+                        # (ISSUE 14): predicted vs measured peak for
+                        # this operating point, zero extra syncs.
+                        _mem_watermark(it + 1, var, nd)
                     else:
                         changed = int((new != state["labels"]).sum())
                     state["labels"] = new
@@ -1277,6 +1494,9 @@ def _run_lpa(
             # supersteps advanced since the last failure => a NEW incident:
             # the retry budget bounds attempts per incident, not per run
             progress=lambda: state["it"],
+            # a reactive OOM's degrade record carries the failed point's
+            # modeled inventory + the last watermark (ISSUE 14)
+            degrade_context=_lpa_degrade_context,
         )
     return labels
 

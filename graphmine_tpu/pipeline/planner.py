@@ -43,11 +43,19 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass, field
 
-_BYTES_PER_EDGE = 36.0
-_BYTES_PER_EDGE_WEIGHTED = 16.0
-_SINGLE_BYTES_PER_VERTEX = 8.0
-_REPLICATED_BYTES_PER_VERTEX = 16.0
-_RING_BYTES_PER_VERTEX = 24.0  # divided by D (labels are sharded)
+from graphmine_tpu.obs import memmodel
+
+# Byte model constants: DERIVED from the memory plane's single owner
+# (obs/memmodel.py, ISSUE 14) — the same seeds decompose into the named
+# inventory the `plan` record and every memory_watermark ship, so a
+# recalibration moves this planner and the records together. The names
+# are kept as local aliases because this module's docstring/derivation
+# notes above reference them.
+_BYTES_PER_EDGE = memmodel.BYTES_PER_EDGE
+_BYTES_PER_EDGE_WEIGHTED = memmodel.BYTES_PER_EDGE_WEIGHTED
+_SINGLE_BYTES_PER_VERTEX = memmodel.SINGLE_BYTES_PER_VERTEX
+_REPLICATED_BYTES_PER_VERTEX = memmodel.REPLICATED_BYTES_PER_VERTEX
+_RING_BYTES_PER_VERTEX = memmodel.RING_BYTES_PER_VERTEX
 
 # Default HBM per device: 16 GiB (TPU v5e, the measured chip of
 # DESIGN.md). Overridable per-process for other parts/CPU testing.
@@ -127,16 +135,14 @@ def estimate_bytes_per_device(
     num_devices: int,
     weighted: bool = False,
 ) -> int:
-    """Modeled peak HBM per device for ``schedule`` (constants above)."""
-    v, e, d = float(num_vertices), float(num_edges), float(max(num_devices, 1))
-    edge = _BYTES_PER_EDGE + (_BYTES_PER_EDGE_WEIGHTED if weighted else 0.0)
-    if schedule == "single":
-        return int(edge * e + _SINGLE_BYTES_PER_VERTEX * v)
-    if schedule == "replicated":
-        return int(edge * e / d + _REPLICATED_BYTES_PER_VERTEX * v)
-    if schedule == "ring":
-        return int(edge * e / d + _RING_BYTES_PER_VERTEX * v / d)
-    raise ValueError(f"unknown schedule {schedule!r}")
+    """Modeled peak HBM per device for ``schedule`` — delegated to the
+    memory plane's single owner (:func:`memmodel.schedule_bytes_per_device`,
+    ISSUE 14): one inventory, two consumers (this planner's accept/reject
+    and the ``plan``/``memory_watermark`` record inventories), bit-identical
+    arithmetic to the constants this module used to own."""
+    return memmodel.schedule_bytes_per_device(
+        schedule, num_vertices, num_edges, num_devices, weighted
+    )
 
 
 def degradation_ladder(

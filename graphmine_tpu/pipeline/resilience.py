@@ -286,6 +286,7 @@ def run_phase(
     sleep=time.sleep,
     progress=None,
     device_ladder: tuple = (),
+    degrade_context=None,
 ):
     """Run ``fn()`` with the full retry/degrade/fail taxonomy applied.
 
@@ -307,6 +308,15 @@ def run_phase(
     resets — ``max_retries`` bounds attempts per *incident*, not per phase
     lifetime (see :func:`_retry_loop`).
 
+    ``degrade_context``: optional zero-arg callable returning extra
+    key/values merged into every ``degrade`` record — the memory plane
+    (ISSUE 14) uses it to attach the failed operating point's modeled
+    ``mem`` inventory and the last ``memory_watermark``, so a reactive
+    OOM is triageable (model-miss vs fragmentation) from the JSONL
+    alone. Telemetry only: a raising context never masks the failure
+    being recorded, and its keys must not collide with the record's own
+    (``stage``/``to``/``depth``/``kind``/``error``).
+
     Emits ``retry`` / ``retries_exhausted`` / ``degrade`` records through
     ``metrics`` (device rungs carry ``kind="device"``). Raises the
     classified-fatal error, the degradable error when its ladder is
@@ -325,6 +335,22 @@ def run_phase(
     # ("rung:primary", then the ladder labels) — the span-path join key
     # that ties a retry record to the operating point it retried AT.
     rung = "primary"
+
+    def _degrade_extra() -> dict:
+        if degrade_context is None:
+            return {}
+        try:
+            extra = dict(degrade_context() or {})
+        except Exception:  # noqa: BLE001 — context is telemetry only
+            return {}
+        # A context key colliding with the record's own kwargs would
+        # raise TypeError AT the emit call — outside the guard above,
+        # masking the very failure being recorded. Drop reserved keys.
+        for reserved in ("phase", "t", "stage", "to", "depth", "kind",
+                         "error"):
+            extra.pop(reserved, None)
+        return extra
+
     while True:
         try:
             with _rung_span(metrics, rung):
@@ -341,7 +367,7 @@ def run_phase(
                 _count(metrics, "graphmine_degrades_total")
                 metrics.emit(
                     "degrade", stage=name, to=rung, depth=depth,
-                    error=repr(e),
+                    error=repr(e), **_degrade_extra(),
                 )
                 continue
             if cls == DEGRADABLE_DEVICE and dev:
@@ -350,7 +376,7 @@ def run_phase(
                 _count(metrics, "graphmine_degrades_total")
                 metrics.emit(
                     "degrade", stage=name, to=rung, depth=depth,
-                    kind="device", error=repr(e),
+                    kind="device", error=repr(e), **_degrade_extra(),
                 )
                 continue
             raise

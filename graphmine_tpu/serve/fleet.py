@@ -91,6 +91,11 @@ from urllib import request as urlrequest
 from urllib.parse import urlparse
 
 from graphmine_tpu.obs.histogram import Histogram
+from graphmine_tpu.obs.memmodel import (
+    export_memory_gauges,
+    host_memory,
+    serve_mem_budget_bytes,
+)
 from graphmine_tpu.obs.registry import Registry
 from graphmine_tpu.obs.sketch import QuantileSketch
 from graphmine_tpu.obs.spans import (
@@ -794,6 +799,11 @@ class FleetRouter:
         self.registry = registry if registry is not None else (
             sink.registry if sink is not None else Registry()
         )
+        # Memory budget (ISSUE 14): resolved ONCE at construction — the
+        # SnapshotServer discipline — so a malformed env override fails
+        # loudly here instead of 500ing every later /statusz scrape
+        # (and /proc/meminfo is not re-parsed per scrape).
+        self._mem_budget = serve_mem_budget_bytes()
         self.replica_set = ReplicaSet(
             replicas, writer=writer, config=self.config, sink=sink,
             registry=self.registry, standby=standby,
@@ -1673,6 +1683,10 @@ class FleetRouter:
             # fleet-merged result-quality view (ISSUE 13): counter-wise
             # sketch merge across replicas + per-replica firing counts
             "quality": self.quality_merged(),
+            # router-process memory plane (ISSUE 14): the router holds
+            # no snapshot, but its RSS/headroom ride the same section
+            # shape as the replicas' so one dashboard reads the fleet
+            "memory": self._memory_payload(),
         }
         if rs.standby_id is not None:
             sb = rs.replica(rs.standby_id).last_health
@@ -1682,7 +1696,20 @@ class FleetRouter:
             }
         return out
 
+    def _memory_payload(self) -> dict:
+        """Router-side memory section (ISSUE 14): RSS + headroom against
+        the process budget, exported as the same ``graphmine_memory_*``
+        gauges the replicas serve — the low-headroom alert rule reads
+        the identical metric name fleet-wide."""
+        out = host_memory(self._mem_budget)
+        export_memory_gauges(self.registry, out)
+        return out
+
     def metrics_text(self) -> str:
+        # refresh the router's graphmine_memory_* gauges on the scrape
+        # itself — a deployment that only reads /metrics must not see
+        # absent/stale RSS just because nobody opened /statusz
+        self._memory_payload()
         tracer = getattr(self.sink, "tracer", None)
         labels = {"run_id": tracer.run_id} if tracer is not None else None
         text = self.registry.render_textfile(labels=labels)

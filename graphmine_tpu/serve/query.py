@@ -163,6 +163,51 @@ class QueryEngine:
     def version(self) -> int:
         return self.snapshot.version
 
+    def memory_bytes(self) -> dict:
+        """Host-side byte accounting of this engine (ISSUE 14): the
+        snapshot's array payload vs the DERIVED query index (adjacency
+        CSR, census columns, per-vertex size map, the stacked gather
+        table) — the two components a serve process deliberately holds,
+        so a growing RSS decomposes into "the graph grew" vs "the index
+        grew" from /statusz alone. Engines are immutable, so the counts
+        are stable for this served version (the lazy /explain side
+        index is counted when built)."""
+        # np.asarray on an already-right-dtype snapshot array returns the
+        # SAME object (and cc_labels falls back to labels when absent):
+        # count each underlying buffer once, and never re-count a buffer
+        # the snapshot accounting already covers — otherwise a label-heavy
+        # snapshot reads 2-3x its real RSS contribution across the split.
+        seen = set()
+        for a in self.snapshot.arrays.values():
+            seen.add(id(a))
+            if getattr(a, "base", None) is not None:
+                seen.add(id(a.base))
+        idx = 0
+        arrays = []
+        for name in (
+            "labels", "cc_labels", "lof", "_nbr_ptr", "_nbr", "_present",
+            "_sizes", "_size_by_vertex", "_sizes_sorted", "_by_comm",
+            "_block_labels", "_block_starts", "_table", "_explain_idx",
+        ):
+            a = getattr(self, name, None)
+            if isinstance(a, tuple):  # the lazy /explain index is a pair
+                arrays.extend(a)
+            elif a is not None:
+                arrays.append(a)
+        for a in arrays:
+            if not hasattr(a, "nbytes"):
+                continue
+            base = a.base if getattr(a, "base", None) is not None else a
+            if id(a) in seen or id(base) in seen:
+                continue
+            seen.add(id(a))
+            seen.add(id(base))
+            idx += int(a.nbytes)
+        return {
+            "snapshot_bytes": self.snapshot.nbytes,
+            "index_bytes": idx,
+        }
+
     def quality_state(self, build: bool = True):
         """This snapshot's :class:`~graphmine_tpu.obs.quality
         .QualityState`, built ONCE on first read (engines are immutable

@@ -125,6 +125,11 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from graphmine_tpu.obs.alerts import AlertManager
+from graphmine_tpu.obs.memmodel import (
+    export_memory_gauges,
+    host_memory,
+    serve_mem_budget_bytes,
+)
 from graphmine_tpu.obs.registry import Registry
 from graphmine_tpu.obs.spans import (
     TRACE_HEADER,
@@ -414,6 +419,11 @@ class SnapshotServer:
             "GRAPHMINE_PROFILEZ_DIR"
         )
         self._profilez_lock = threading.Lock()
+        # Serve-process memory budget (ISSUE 14): resolved ONCE at
+        # construction so a malformed env override fails loudly here,
+        # not silently per scrape (env GRAPHMINE_SERVE_MEM_BUDGET_BYTES
+        # → host MemTotal → None = headroom unknown, rule never fires).
+        self._mem_budget = serve_mem_budget_bytes()
         self._export_metrics()
         # Startup replay: accepted-but-unapplied WAL entries re-enqueue
         # through the admission path (replay never sheds — the work was
@@ -1425,15 +1435,21 @@ class SnapshotServer:
         return out
 
     # -- on-demand device profiling (POST /profilez) ----------------------
-    def profilez(self, duration_ms: int = 1000) -> tuple[int, dict]:
-        """Capture an XLA profiler trace from this live replica, tagged
-        with the requesting trace. Returns ``(http_status, body)``:
-        403 when no capture directory is configured (the guard — an
-        open profiler endpoint burns device time and disk for anyone
-        who can reach the port), 501 when jax / the profiler is
-        unavailable (CPU-only or jax-less deployments degrade, never
-        crash), 409 when a capture is already running (the profiler is
-        process-global), 200 with the trace directory otherwise."""
+    def profilez(
+        self, duration_ms: int = 1000, kind: str = "trace",
+    ) -> tuple[int, dict]:
+        """Capture an XLA profiler trace — or, with ``kind="memory"``
+        (ISSUE 14 satellite), an on-demand
+        ``jax.profiler.device_memory_profile`` allocator snapshot —
+        from this live replica, tagged with the requesting trace.
+        Returns ``(http_status, body)``: 403 when no capture directory
+        is configured (the guard — an open profiler endpoint burns
+        device time and disk for anyone who can reach the port), 501
+        when jax / the profiler is unavailable (CPU-only or jax-less
+        deployments degrade, never crash), 409 when a capture is
+        already running (the profiler is process-global; BOTH kinds
+        share the one single-flight lock), 200 with the capture path
+        otherwise."""
         if not self.profilez_dir:
             return 403, {
                 "error": "profilez disabled: start the server with "
@@ -1444,6 +1460,8 @@ class SnapshotServer:
         trace_header = self._current_trace_header()
         ctx = TraceContext.from_header(trace_header)
         tag = ctx.trace_id if ctx is not None else secrets.token_hex(4)
+        if kind == "memory":
+            return self._profilez_memory(tag, ctx)
         out_dir = os.path.join(
             self.profilez_dir, f"profile-{int(time.time())}-{tag}"
         )
@@ -1492,6 +1510,52 @@ class SnapshotServer:
             "ok": True,
             "dir": out_dir,
             "duration_ms": duration_ms,
+            "trace_id": ctx.trace_id if ctx is not None else "",
+        }
+
+    def _profilez_memory(self, tag: str, ctx) -> tuple[int, dict]:
+        """``kind="memory"``: one ``device_memory_profile`` snapshot (a
+        pprof proto of live device allocations) written next to the
+        trace captures, under the same single-flight lock — the on-OOM
+        triage step after the watermark said WHICH phase blew the model
+        (docs/RUNBOOKS.md §14). 501 when the profiler (or jax) is
+        unavailable on this replica."""
+        os.makedirs(self.profilez_dir, exist_ok=True)
+        path = os.path.join(
+            self.profilez_dir, f"memprof-{int(time.time())}-{tag}.pb"
+        )
+        if not self._profilez_lock.acquire(blocking=False):
+            return 409, {"error": "a profile capture is already running"}
+        try:
+            try:
+                import jax
+
+                blob = jax.profiler.device_memory_profile()
+            except Exception as e:  # noqa: BLE001 — no jax / no profiler
+                if self.sink is not None:
+                    self.sink.emit(
+                        "profile_capture", dir=path, ok=False,
+                        kind="memory", error=repr(e),
+                    )
+                return 501, {
+                    "error": "jax device_memory_profile unavailable on "
+                    "this replica",
+                    "detail": repr(e),
+                }
+            with open(path, "wb") as f:
+                f.write(blob)
+        finally:
+            self._profilez_lock.release()
+        if self.sink is not None:
+            self.sink.emit(
+                "profile_capture", dir=path, ok=True, kind="memory",
+                bytes=len(blob),
+            )
+        return 200, {
+            "ok": True,
+            "path": path,
+            "kind": "memory",
+            "bytes": len(blob),
             "trace_id": ctx.trace_id if ctx is not None else "",
         }
 
@@ -1585,6 +1649,27 @@ class SnapshotServer:
         base = float(created) if created else self._t0_wall
         return round(max(0.0, time.time() - base), 3)
 
+    # -- memory plane ------------------------------------------------------
+    def memory_payload(self) -> dict:
+        """The ``/statusz`` "memory" section + ``graphmine_memory_*``
+        gauges (ISSUE 14, docs/OBSERVABILITY.md "Memory plane"): host
+        RSS and headroom against the process budget, the served
+        snapshot's array bytes vs the derived query index, and the
+        retained WAL segment bytes — byte accounting for everything this
+        process deliberately holds, so "RSS grew" decomposes into WHICH
+        plane grew. Updated on the cadences that already read it
+        (/statusz, and /healthz through the alert values — the prober
+        cadence); no new threads."""
+        out = host_memory(self._mem_budget)
+        eng = self._engine
+        out.update(eng.memory_bytes())
+        if self.wal is not None:
+            out["wal_segment_bytes"] = int(
+                self.wal.snapshot().get("segment_bytes", 0)
+            )
+        export_memory_gauges(self.registry, out)
+        return out
+
     # -- result quality & alerts ------------------------------------------
     def quality_payload(self) -> dict:
         """The "quality" section /statusz and /alertz serve: the
@@ -1615,6 +1700,14 @@ class SnapshotServer:
             "repair_debt_rows": debt["pending_rows"],
             "snapshot_age_s": self._snapshot_age_s(eng),
         }
+        # Memory headroom rides the same evaluation (ISSUE 14): the
+        # prober's /healthz cadence drives the low-headroom rule
+        # fleet-wide, and the read refreshes the graphmine_memory_*
+        # gauges as a side effect. Metric absent when no budget is
+        # resolvable — the rule then simply never fires.
+        headroom = self.memory_payload().get("headroom_frac")
+        if headroom is not None:
+            values["memory_headroom_frac"] = headroom
         rep = self._quality_report
         if rep is not None and rep.state.version == eng.version:
             values.update(rep.values())
@@ -1707,6 +1800,10 @@ class SnapshotServer:
             # the alert level view — the same payloads /alertz serves
             "quality": self.quality_payload(),
             "alerts": self.alerts.snapshot(),
+            # memory plane (ISSUE 14): RSS + headroom, snapshot vs index
+            # vs WAL byte accounting — the serve-side mirror of the
+            # driver's memory_watermark records
+            "memory": self.memory_payload(),
         }
         if self.wal is not None:
             payload["wal"] = self.wal.snapshot()
@@ -1725,7 +1822,11 @@ class SnapshotServer:
 
     def metrics_text(self) -> str:
         """Live Prometheus exposition — the same deterministic rendering
-        (and the same run_id labels) as the textfile path, served hot."""
+        (and the same run_id labels) as the textfile path, served hot.
+        Refreshes the graphmine_memory_* gauges on the scrape itself: a
+        deployment that only reads /metrics (no prober, nobody on
+        /statusz) must not see absent or stale memory accounting."""
+        self.memory_payload()
         return self.registry.render_textfile(labels=self._run_labels())
 
     # -- request middleware hooks -----------------------------------------
@@ -2122,7 +2223,13 @@ class _Handler(BaseHTTPRequestHandler):
             duration_ms = int(body.get("duration_ms", 1000))
         except TypeError as e:  # JSON null/list/object: bad input, not 500
             raise ValueError(f"duration_ms must be an integer: {e}") from e
-        status, payload = self.srv.profilez(duration_ms=duration_ms)
+        kind = body.get("kind", "trace")
+        if kind not in ("trace", "memory"):
+            raise ValueError(f"unknown profilez kind {kind!r} "
+                             "(use 'trace' or 'memory')")
+        status, payload = self.srv.profilez(
+            duration_ms=duration_ms, kind=kind,
+        )
         self._reply(status, payload)
 
     def _ep_reload(self, url) -> None:

@@ -103,6 +103,12 @@ class Snapshot:
         return self.meta["snapshot_id"]
 
     @property
+    def nbytes(self) -> int:
+        """Total array payload bytes — the serve memory plane's snapshot
+        accounting (ISSUE 14, ``graphmine_memory_snapshot_bytes``)."""
+        return int(sum(int(a.nbytes) for a in self.arrays.values()))
+
+    @property
     def parent(self) -> str:
         return self.meta.get("parent", "")
 

@@ -938,6 +938,15 @@ class WriteAheadLog:
             "graphmine_serve_wal_pending_entries",
             "WAL entries accepted but not yet in a published snapshot",
         ).set(snap["pending_entries"])
+        # memory plane (ISSUE 14): retained-segment bytes on the same
+        # scrape as the seq gauges — the WAL's share of the serve
+        # process's /statusz memory section (name/help owned by
+        # obs/memmodel.MEMORY_GAUGE_HELP like every memory gauge)
+        from graphmine_tpu.obs.memmodel import export_memory_gauges
+
+        export_memory_gauges(
+            reg, {"wal_segment_bytes": snap["segment_bytes"]}
+        )
 
     def close(self) -> None:
         with self._lock:

@@ -159,6 +159,17 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "mem: memory-plane observability suite (tests/test_memmodel.py: "
+        "the analytical HBM footprint inventory exact against "
+        "hand-computed tiny plans, the planner byte-constant "
+        "derivation, memory_watermark emission e2e + the fault-injected "
+        "OOM degrade join, serve /statusz + /profilez memory surfaces, "
+        "the obs_report memory waterfall and the bench_diff memory "
+        "gate); runs in the default CPU pass — select with -m mem or "
+        "tools/run_tier1.sh --mem-only",
+    )
+    config.addinivalue_line(
+        "markers",
         "slo: serving-SLO observability suite (tests/test_slo.py: "
         "bucket histograms + merge associativity, live /metrics and "
         "/statusz under the query hammer, quantile agreement vs the "

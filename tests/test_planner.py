@@ -178,7 +178,8 @@ def test_hbm_precedence_env_device_default(monkeypatch):
 
 
 def test_device_hbm_bytes_memory_stats_chain(monkeypatch):
-    """The driver's device query: bytes_limit when reported, None on CPU
+    """The driver's device query: bytes_limit when reported — the MIN
+    across all local devices since ISSUE 14 — None on CPU
     (memory_stats() -> None), None when the runtime raises."""
     import jax
 
@@ -193,21 +194,32 @@ def test_device_hbm_bytes_memory_stats_chain(monkeypatch):
                 raise RuntimeError("tunneled runtime")
             return self._stats
 
-    def fake_devices(dev):
-        return lambda *a, **k: [dev]
+    def fake_devices(*devs):
+        return lambda *a, **k: list(devs)
 
     # a v5p part reporting ~95 GiB
     monkeypatch.setattr(
-        jax, "devices", fake_devices(_Dev({"bytes_limit": 95 * GIB}))
+        jax, "local_devices", fake_devices(_Dev({"bytes_limit": 95 * GIB}))
     )
     assert driver.device_hbm_bytes() == 95 * GIB
+    # heterogeneous mesh: the smallest chip governs the budget
+    monkeypatch.setattr(
+        jax, "local_devices",
+        fake_devices(_Dev({"bytes_limit": 95 * GIB}),
+                     _Dev({"bytes_limit": 16 * GIB})),
+    )
+    assert driver.device_hbm_bytes() == 16 * GIB
     # CPU backend: memory_stats() is None (measured on this jax build)
-    monkeypatch.setattr(jax, "devices", fake_devices(_Dev(None)))
+    monkeypatch.setattr(jax, "local_devices", fake_devices(_Dev(None)))
     assert driver.device_hbm_bytes() is None
     # stats dict without the key, or a raising runtime -> None
-    monkeypatch.setattr(jax, "devices", fake_devices(_Dev({"other": 1})))
+    monkeypatch.setattr(
+        jax, "local_devices", fake_devices(_Dev({"other": 1}))
+    )
     assert driver.device_hbm_bytes() is None
-    monkeypatch.setattr(jax, "devices", fake_devices(_Dev(raise_=True)))
+    monkeypatch.setattr(
+        jax, "local_devices", fake_devices(_Dev(raise_=True))
+    )
     assert driver.device_hbm_bytes() is None
 
 
@@ -232,7 +244,7 @@ def test_pipeline_plan_uses_device_reported_hbm(monkeypatch, tmp_path):
         def memory_stats(self):
             return {"bytes_limit": 1 << 20}
 
-    monkeypatch.setattr(jax, "devices", lambda *a, **k: [_Tiny()])
+    monkeypatch.setattr(jax, "local_devices", lambda *a, **k: [_Tiny()])
     with pytest.raises(PlanError, match="no LPA schedule fits"):
         driver.run_pipeline(_tiny_config(
             data_path=str(path), data_format="edgelist", num_devices=1,

@@ -73,7 +73,11 @@ SUB_RECORDS = {
     "blocking": ("binned_vs_random_gather",),
     "stream": ("ivf_reuse",),
     "serve": ("write_load", "replicated_read", "writer_failover",
-              "latency_quantiles", "quality_pass"),
+              "latency_quantiles", "quality_pass", "memory"),
+    # the per-tier memory sub-record (ISSUE 14: model + measured child
+    # peak RSS) is tracked on the headline tier; every tier carries it,
+    # but one manifest row is the signal "this round recorded memory"
+    "chip": ("memory",),
 }
 
 # metric-name prefix -> tier, for records read from a tail where no
@@ -114,6 +118,11 @@ TIER_TOLERANCE = {
 
 # Units where DOWN is an improvement (everything else: up is better).
 LOWER_BETTER_UNITS = frozenset(("s", "seconds", "ms", "us"))
+
+# Per-tier memory sub-record gate (ISSUE 14): peak bytes regress UP.
+# Child RSS is noisier than kernel rates (allocator arenas, import
+# order), hence the looser default; override with --tolerance memory=F.
+MEMORY_TOLERANCE = 0.25
 
 
 class BenchLoadError(Exception):
@@ -348,6 +357,9 @@ def diff_captures(old: dict, new: dict, tolerances: dict | None = None):
             nv, (int, float)
         ) or ov == 0:
             rows.append(f"  {tier:<10} ?         {ov} -> {nv}")
+            # the memory gate is independent of headline-value validity:
+            # a tier with a broken headline can still regress its bytes
+            _memory_gate(tier, o, nw, tol_map, rows, regressions)
             continue
         unit = nw.get("unit") or o.get("unit") or ""
         lower_better = unit in LOWER_BETTER_UNITS
@@ -367,7 +379,42 @@ def diff_captures(old: dict, new: dict, tolerances: dict | None = None):
                 f"{nw.get('metric', tier)}: {ov} -> {nv} ({delta:+.1%} "
                 f"past the ±{tol:.0%} {tier} tolerance)"
             )
+        _memory_gate(tier, o, nw, tol_map, rows, regressions)
     return rows, regressions, capture_changes
+
+
+def _memory_gate(tier, o, nw, tol_map, rows, regressions) -> None:
+    """Memory sub-record gate (ISSUE 14): per-tier measured peak bytes
+    regress UP. Upper-bound samples (the child did not raise the
+    cumulative rusage max — another child's peak, not this tier's) are
+    not comparable and never gate. Runs for every tier whose BOTH
+    captures carry a comparable sample, independently of the headline
+    value's validity (callers skip it only where values are cross-
+    platform incomparable: err records, fallback-status mismatches)."""
+    om = (o.get("detail") or {}).get("memory") or {}
+    nm = (nw.get("detail") or {}).get("memory") or {}
+    opk, npk = om.get("peak_rss_bytes"), nm.get("peak_rss_bytes")
+    if not (
+        isinstance(opk, (int, float)) and isinstance(npk, (int, float))
+        and opk > 0
+        and not om.get("upper_bound") and not nm.get("upper_bound")
+    ):
+        return
+    mdelta = (npk - opk) / opk
+    mtol = tol_map.get("memory", MEMORY_TOLERANCE)
+    mworse = mdelta > mtol
+    verdict = "MEM-REGRESS" if mworse else "mem-ok"
+    rows.append(
+        f"  {tier:<10} {verdict:<9} peak "
+        f"{opk / (1 << 20):,.0f}MiB -> {npk / (1 << 20):,.0f}MiB"
+        f"  ({mdelta:+.1%}, tol ±{mtol:.0%}, lower=better)"
+    )
+    if mworse:
+        regressions.append(
+            f"{tier}.memory.peak_rss_bytes: {opk} -> {npk} "
+            f"({mdelta:+.1%} past the ±{mtol:.0%} memory "
+            "tolerance — bytes regress UP)"
+        )
 
 
 # ---- silicon-capture manifest ---------------------------------------------

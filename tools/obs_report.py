@@ -19,6 +19,12 @@ dir):
   per ``superstep_timing`` window, with an achieved-fraction column and
   loud flags on windows below ``--roofline-min-frac`` of model — the
   triage step RUNBOOKS §12 offers before "blame the device";
+- the **memory** section (ISSUE 14): the per-phase predicted-vs-peak
+  waterfall from ``memory_watermark`` records, flagged under-estimates,
+  a recalibration suggestion for the ``obs/memmodel.py`` byte seeds,
+  and every memory-attributed degrade (plan-time pre-degrades, reactive
+  OOMs with their last watermark) — RUNBOOKS §14's "read the waterfall
+  before shrinking the graph" view;
 - the **recovery timeline**: every retry / degrade / mesh_degrade /
   tripwire / watchdog_timeout / checkpoint rollback / resume, in causal
   order, each with its span path — *which* incident hit *which* phase on
@@ -339,6 +345,165 @@ def _roofline_section(records, min_frac: float):
         out.append(f"  model anchors: {anchors}")
         if roof.get("provenance"):
             out.append(f"  anchor provenance: {roof['provenance']}")
+    return out
+
+
+def _fmt_bytes(b) -> str:
+    if not isinstance(b, (int, float)):
+        return "-"
+    for unit, div in (("GiB", 1 << 30), ("MiB", 1 << 20), ("KiB", 1 << 10)):
+        if abs(b) >= div:
+            return f"{b / div:.1f}{unit}"
+    return f"{int(b)}B"
+
+
+def _memory_section(records, t0):
+    """Memory-plane triage (ISSUE 14, docs/OBSERVABILITY.md "Memory
+    plane"): the per-phase predicted-vs-peak waterfall from
+    ``memory_watermark`` records, flagged under-estimates, a concrete
+    recalibration suggestion for the ``obs/memmodel.py`` byte seeds
+    (the bench_diff crossover-suggestion pattern), and every
+    memory-attributed degrade — plan-time pre-degrades and reactive
+    OOMs with their attached last watermark, joinable back to the full
+    record by span path. Empty list = no memory-plane records
+    (pre-ISSUE-14 stream)."""
+    marks = [r for r in records if r.get("phase") == "memory_watermark"]
+    # device-loss degrades (kind="device") also carry the mem context —
+    # the driver attaches it to every degrade — but they belong to the
+    # elastic ladder's triage (§3), not the memory section: labeling a
+    # dead chip "OOM" would send the operator down the wrong runbook.
+    mem_degrades = [
+        r for r in records
+        if r.get("phase") == "degrade"
+        and r.get("kind") != "device"
+        and (isinstance(r.get("mem"), dict) or r.get("kind") == "mem_plan"
+             or isinstance(r.get("last_watermark"), dict))
+    ]
+    if not (marks or mem_degrades):
+        return []
+    out = []
+    def _num(v):
+        # non-numeric-tolerant (the r12 roofline discipline): schema
+        # validation checks key presence, not types — a malformed
+        # record must degrade to a hole in the table, never a crashed
+        # report (the exit-3 path still names the violation)
+        return int(v) if isinstance(v, (int, float)) else 0
+
+    if marks:
+        # grouped per (op, source): one transient rss-fallback sample
+        # mid-run must never contaminate a device group's peak/ratio —
+        # RSS vs HBM model is exactly the comparison the recalibration
+        # rule below refuses to make
+        groups: dict = {}
+        for r in marks:
+            key = (r.get("op", "?"), r.get("source", "?"))
+            g = groups.setdefault(key, {
+                "pred": 0, "peak": 0, "head": None, "n": 0,
+            })
+            g["pred"] = max(g["pred"], _num(r.get("predicted_bytes")))
+            g["peak"] = max(g["peak"], _num(r.get("achieved_bytes")))
+            h = r.get("headroom_frac")
+            if isinstance(h, (int, float)):
+                g["head"] = h if g["head"] is None else min(g["head"], h)
+            g["n"] += 1
+        out.append(
+            "  op               predicted       peak  peak/model"
+            "  headroom  src     marks"
+        )
+        peak_max = max(g["peak"] for g in groups.values()) or 1
+        worst = None  # (ratio, op) over device-sourced groups
+        for (op, src), g in sorted(groups.items()):
+            ratio = g["peak"] / g["pred"] if g["pred"] else 0.0
+            head = f"{g['head']:.2f}" if g["head"] is not None else "-"
+            flag = ""
+            if src == "device" and g["pred"] and ratio > 1.1:
+                flag = "  << model under-estimates"
+            if src == "device" and (worst is None or ratio > worst[0]):
+                worst = (ratio, op)
+            out.append(
+                f"  {op:<15} {_fmt_bytes(g['pred']):>10}"
+                f" {_fmt_bytes(g['peak']):>10}  {ratio:>9.2f}x"
+                f"  {head:>8}  {src:<6}  {g['n']:>4}"
+                f"  {_bar(g['peak'] / peak_max, 16)}{flag}"
+            )
+        # Recalibration suggestion (the bench_diff crossover-suggestion
+        # pattern): what the measured peaks mean for the byte seeds the
+        # planner AND the model read (one owner — obs/memmodel.py).
+        try:
+            from graphmine_tpu.obs.memmodel import BYTES_PER_EDGE
+        except Exception:  # pragma: no cover — report must still render
+            BYTES_PER_EDGE = None
+        cur = (
+            f"(current seed: BYTES_PER_EDGE={BYTES_PER_EDGE:.0f})"
+            if BYTES_PER_EDGE is not None else ""
+        )
+        if worst is None:
+            out.append(
+                "  recalibration: watermarks carry host-RSS only (no "
+                "device allocator on this backend) — RSS is not "
+                "comparable to the HBM model; re-run on silicon to "
+                f"recalibrate the obs/memmodel.py byte seeds {cur}"
+            )
+        elif worst[0] > 1.05:
+            scaled = (
+                f" (e.g. BYTES_PER_EDGE {BYTES_PER_EDGE:.0f} -> "
+                f"{BYTES_PER_EDGE * worst[0]:.0f})"
+                if BYTES_PER_EDGE is not None else ""
+            )
+            out.append(
+                f"  recalibration: measured peak is {worst[0]:.2f}x the "
+                f"modeled footprint for {worst[1]} — raise the "
+                f"obs/memmodel.py byte seeds{scaled} so the planner "
+                "stops accepting schedules the allocator rejects; the "
+                "planner moves with the model (one owner)"
+            )
+        elif worst[0] < 0.7:
+            out.append(
+                f"  recalibration: measured peak is only {worst[0]:.2f}x "
+                f"model for {worst[1]} — the seeds are conservative; "
+                "lowering them (obs/memmodel.py) would admit larger "
+                f"graphs per device {cur}"
+            )
+        else:
+            out.append(
+                f"  recalibration: measured peak within noise of model "
+                f"(worst {worst[0]:.2f}x at {worst[1]}) — keep the "
+                f"obs/memmodel.py byte seeds {cur}"
+            )
+    for r in mem_degrades:
+        kind = (
+            "PLAN PRE-DEGRADE" if r.get("kind") == "mem_plan"
+            else "OOM DEGRADE"
+        )
+        mem = r.get("mem") if isinstance(r.get("mem"), dict) else {}
+        line = (
+            f"  {_fmt_offset(r, t0)}  {kind}  stage={r.get('stage', '?')}"
+            f"  to={r.get('to', '?')}"
+        )
+        if mem:
+            line += (
+                f"  modeled={_fmt_bytes(mem.get('total_bytes'))}"
+                f" ({mem.get('family', '?')})"
+            )
+        out.append(line)
+        w = r.get("last_watermark")
+        if isinstance(w, dict):
+            out.append(
+                f"      last watermark: "
+                f"{_fmt_bytes(w.get('achieved_bytes'))} measured"
+                f" ({w.get('source', '?')}) vs "
+                f"{_fmt_bytes(w.get('predicted_bytes'))} model"
+                f"  headroom={w.get('headroom_frac', '?')}"
+                f"  @ {w.get('span_path', '?')}"
+            )
+        inv = mem.get("inventory")
+        if isinstance(inv, dict) and inv:
+            top = sorted(inv.items(), key=lambda kv: -_num(kv[1]))[:4]
+            out.append(
+                "      inventory: "
+                + ", ".join(f"{k}={_fmt_bytes(v)}" for k, v in top)
+                + (f", … ({len(inv)} components)" if len(inv) > 4 else "")
+            )
     return out
 
 
@@ -930,6 +1095,11 @@ def build_report(
         lines.append("")
         lines.append("-- roofline (achieved vs cost model) --")
         lines.extend(roofline)
+    memory = _memory_section(records, t0)
+    if memory:  # pre-ISSUE-14 streams carry no memory_watermark
+        lines.append("")
+        lines.append("-- memory (predicted vs peak) --")
+        lines.extend(memory)
     serving = _serving_table(records, t0)
     if serving:  # serving is opt-in; batch-only streams skip the section
         lines.append("")

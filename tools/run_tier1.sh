@@ -7,7 +7,15 @@
 # (-m faults: tests/test_resilience.py + the tripwire/reshard cases in
 # tests/test_sharded.py) is part of this default pass.
 #
-# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only|--slo-only|--blocking-only|--admission-only|--fleet-only|--wal-only|--trace-only|--perf-only|--quality-only] [extra pytest args...]
+# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only|--slo-only|--blocking-only|--admission-only|--fleet-only|--wal-only|--trace-only|--perf-only|--quality-only|--mem-only] [extra pytest args...]
+#   --mem-only     run just the `mem`-marked memory-plane suite
+#                  (tests/test_memmodel.py: the HBM footprint inventory
+#                  exact against hand-computed tiny plans, the planner
+#                  constant derivation, memory_watermark e2e + the
+#                  fault-injected OOM degrade join, /statusz + /profilez
+#                  memory surfaces, the obs_report memory section and
+#                  the bench_diff memory gate) — the fast slice when
+#                  iterating on obs/memmodel.py
 #   --quality-only run just the `quality`-marked result-quality suite
 #                  (tests/test_quality.py: sketch merge associativity,
 #                  PSI drift exactness, canary probe recall + injected
@@ -108,6 +116,9 @@ elif [ "${1:-}" = "--perf-only" ]; then
 elif [ "${1:-}" = "--quality-only" ]; then
     shift
     MARKER='quality and not slow'
+elif [ "${1:-}" = "--mem-only" ]; then
+    shift
+    MARKER='mem and not slow'
 fi
 
 LOG="${TIER1_LOG:-/tmp/_t1.log}"

@@ -56,6 +56,15 @@ _EMIT_RE = re.compile(
 _INLINE_COST_RE = re.compile(r"\bcost\s*=\s*(?:\{|dict\()")
 _COST_OWNER = os.path.join("graphmine_tpu", "obs", "costmodel.py")
 
+# Inline mem sub-record construction (ISSUE 14): the `mem` payload has
+# ONE builder — obs/memmodel.MemEstimate.record(), whose shape the
+# runtime validator pins against schema.MEM_KEYS. A hand-rolled
+# `mem={...}` at an emit site would drift from the memory-plane
+# tooling's expectations silently on cold paths — the cost-lint rot
+# class, applied to the memory plane.
+_INLINE_MEM_RE = re.compile(r"\bmem\s*=\s*(?:\{|dict\()")
+_MEM_OWNER = os.path.join("graphmine_tpu", "obs", "memmodel.py")
+
 # Inline sketch sub-record construction (ISSUE 13): `*_sketch` payloads
 # have ONE builder — obs/sketch.QuantileSketch.to_state(), whose shape
 # the runtime validator pins against schema.SKETCH_KEYS. A hand-rolled
@@ -121,6 +130,12 @@ def scan_inline_costs(root: str = PACKAGE_DIR) -> list:
     return _scan_inline(root, _INLINE_COST_RE, (_COST_OWNER,))
 
 
+def scan_inline_mems(root: str = PACKAGE_DIR) -> list:
+    """``(file, line)`` pairs of inline ``mem={...}``/``mem=dict(...)``
+    literals outside the single builder (obs/memmodel.py)."""
+    return _scan_inline(root, _INLINE_MEM_RE, (_MEM_OWNER,))
+
+
 def scan_inline_sketches(root: str = PACKAGE_DIR) -> list:
     """``(file, line)`` pairs of inline ``*_sketch={...}`` literals
     outside the sketch builders (obs/sketch.py, obs/quality.py)."""
@@ -142,6 +157,12 @@ def violations(root: str = PACKAGE_DIR) -> list:
         "with graphmine_tpu/obs/costmodel.py (CostEstimate.record()), the "
         "single shape owner"
         for path, line in scan_inline_costs(root)
+    )
+    out.extend(
+        f"{path}:{line}: inline mem=... literal — build mem sub-records "
+        "with graphmine_tpu/obs/memmodel.py (MemEstimate.record()), the "
+        "single shape owner"
+        for path, line in scan_inline_mems(root)
     )
     out.extend(
         f"{path}:{line}: inline *_sketch=... literal — build sketch "
