@@ -1760,6 +1760,162 @@ def main_blocking() -> None:
     )
 
 
+def main_exchange() -> None:
+    """Exchange micro-tier (ISSUE 15): bytes-on-the-wire and superstep
+    seconds for the one-all_gather label exchange vs the 2D
+    neighbor-only boundary exchange, at D ∈ {2, 4, 8}.
+
+    Each mesh size partitions the SAME power-law graph twice — the
+    blocked one-all_gather family and the 2D family
+    (``partition_graph(build_plan2d=True)``) — runs a fixed LPA
+    superstep count through each (bit-parity asserted), and reads the
+    modeled per-chip exchange bytes off the cost model
+    (``sharded_superstep_cost``: ``4·Vc·(D-1)`` vs
+    ``4·Σ_peer |boundary|``). The headline is the neighbor/all_gather
+    bytes fraction at the largest measured D; ``detail`` carries the
+    per-D seconds, bytes and boundary fractions the crossover policy
+    (``ops/blocking.SHARDED2D_MIN_*``) should eventually be re-seeded
+    from.
+
+    Honest-capture note: multi-device meshes need actual devices, so
+    the orchestrator runs this tier on an 8-virtual-CPU-device mesh
+    (CPU-fallback record shape — the modeled BYTES are exact either
+    way; only the seconds are CPU numbers) unless
+    ``GRAPHMINE_EXCHANGE_REAL_MESH=1`` marks a real multi-chip window
+    (the silicon capture ``--list-missing`` keeps pending until then).
+    """
+    import jax
+
+    _setup_jax_cache()
+
+    from graphmine_tpu.graph.container import build_graph
+    from graphmine_tpu.obs.costmodel import sharded_superstep_cost
+    from graphmine_tpu.parallel.mesh import make_mesh
+    from graphmine_tpu.parallel.sharded import (
+        partition_graph,
+        shard_graph_arrays,
+        sharded_label_propagation,
+    )
+
+    v, e, iters = 1 << 16, 1 << 17, 5
+    if _CPU_FALLBACK:
+        v, e = 1 << 14, 1 << 15
+    v = int(os.environ.get("GRAPHMINE_EXCHANGE_VERTICES", v))
+    e = int(os.environ.get("GRAPHMINE_EXCHANGE_EDGES", e))
+    iters = int(os.environ.get("GRAPHMINE_EXCHANGE_ITERS", iters))
+
+    src, dst = powerlaw_edges(v, e, seed=5)
+    host_g = build_graph(src, dst, num_vertices=v, to_device=False)
+    avail = len(jax.devices())
+
+    def timed(fn):
+        fetch = lambda r: np.asarray(r[:4])
+        fetch(fn())  # compile
+        t0 = time.perf_counter()
+        fetch(fn())
+        return time.perf_counter() - t0
+
+    per_d = {}
+    skipped = []
+    for d in (2, 4, 8):
+        if d > avail:
+            skipped.append(d)
+            continue
+        mesh = make_mesh(d)
+        sg_1d = shard_graph_arrays(
+            partition_graph(host_g, mesh=mesh, build_blocked_plan=True), mesh
+        )
+        sg_2d = shard_graph_arrays(
+            partition_graph(host_g, mesh=mesh, build_plan2d=True), mesh
+        )
+        lbl_1d = sharded_label_propagation(sg_1d, mesh, max_iter=iters)
+        lbl_2d = sharded_label_propagation(sg_2d, mesh, max_iter=iters)
+        agree = bool(np.array_equal(np.asarray(lbl_1d), np.asarray(lbl_2d)))
+        if not agree:
+            # a bytes-saving headline measured on a computation that no
+            # longer matches the oracle would be worse than no record
+            _print_error_record(
+                "exchange",
+                [f"2D labels diverged from the one-all_gather family at "
+                 f"D={d} — bit-parity contract broken; no rate published"],
+            )
+            return
+        t_1d = timed(
+            lambda: sharded_label_propagation(sg_1d, mesh, max_iter=iters)
+        )
+        t_2d = timed(
+            lambda: sharded_label_propagation(sg_2d, mesh, max_iter=iters)
+        )
+        from graphmine_tpu.obs.costmodel import neighbor_frontier_bytes
+
+        cost_1d = sharded_superstep_cost("lpa_superstep", sg_1d, e)
+        cost_2d = sharded_superstep_cost("lpa_superstep", sg_2d, e)
+        row = {
+            "allgather_seconds": round(t_1d, 4),
+            "neighbor_seconds": round(t_2d, 4),
+            "allgather_exchange_bytes": cost_1d.exchange_bytes,
+            # WIRE bytes: padded shared-width buffers, what ships
+            "neighbor_exchange_bytes": cost_2d.exchange_bytes,
+            # the unpadded boundary content (the frontier floor)
+            "neighbor_frontier_bytes": neighbor_frontier_bytes(sg_2d),
+            "bytes_frac": round(
+                cost_2d.exchange_bytes / max(cost_1d.exchange_bytes, 1), 4
+            ),
+            "boundary_slots": sg_2d.x2d_boundary_total,
+            "padded_boundary": sg_2d.x2d_boundary,
+            "agree": agree,
+        }
+        per_d[str(d)] = row
+        print(json.dumps({"progress": {f"exchange_d{d}": row}}),
+              file=sys.stderr, flush=True)
+
+    if not per_d:
+        _print_error_record(
+            "exchange",
+            [f"needs >= 2 devices (have {avail}); no mesh measured"],
+        )
+        return
+    d_max = max(per_d, key=int)
+    frac = per_d[d_max]["bytes_frac"]
+    virtual = jax.devices()[0].platform != "tpu"
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "exchange_neighbor_bytes_frac_cpu_fallback"
+                    if (_CPU_FALLBACK or virtual)
+                    else "exchange_neighbor_bytes_frac"
+                ),
+                # the headline: neighbor-exchange bytes as a fraction of
+                # the all_gather ladder at the largest measured D —
+                # LOWER is better; the modeled bytes are exact on any
+                # backend (only the seconds are CPU numbers on the
+                # virtual mesh)
+                "value": frac,
+                "unit": "frac",
+                "vs_baseline": 0.0,
+                "detail": {
+                    "num_vertices": v,
+                    "num_edges": e,
+                    "iters": iters,
+                    "per_devices": per_d,
+                    # tracked sub-record (tools/bench_diff.py manifest):
+                    # the neighbor/all_gather WALL ratio at the largest
+                    # D — the number a real-ICI window must capture to
+                    # re-seed exchange_bytes_per_sec and the crossover
+                    "neighbor_vs_allgather": round(
+                        per_d[d_max]["allgather_seconds"]
+                        / max(per_d[d_max]["neighbor_seconds"], 1e-9), 3
+                    ),
+                    "skipped_devices": skipped,
+                    "virtual_mesh": virtual,
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
 def main() -> None:
     _run_chip_tier(weighted=False)
 
@@ -2231,6 +2387,7 @@ _CHILD_TIMEOUT_S = {
     "blocking": 900.0,
     "northstar": 2700.0,
     "sharded": 1800.0,
+    "exchange": 900.0,
     "cc": 1800.0,
     "e2e": 2400.0,
     "lof": 1200.0,
@@ -2249,8 +2406,8 @@ _CHILD_TIMEOUT_S = {
 # roofline second (validates the hardware model right next to the chip
 # number), then the remaining tiers by evidence value.
 _TIER_ORDER = [
-    "chip", "roofline", "blocking", "northstar", "sharded", "cc", "e2e",
-    "lof", "snap", "quality", "weighted", "stream", "serve",
+    "chip", "roofline", "blocking", "northstar", "sharded", "exchange",
+    "cc", "e2e", "lof", "snap", "quality", "weighted", "stream", "serve",
 ]
 # Dead-tunnel fallback order: every tier has a reduced-scale CPU variant
 # except roofline (CPU primitive rates say nothing about the TPU model).
@@ -2258,12 +2415,28 @@ _TIER_ORDER = [
 # gather RATIO record shape, which the capture pipeline needs to exist
 # even when the rates themselves are CPU numbers.)
 _FALLBACK_TIERS = [
-    "chip", "northstar", "blocking", "sharded", "cc", "e2e", "lof", "snap",
-    "quality", "weighted", "stream", "serve",
+    "chip", "northstar", "blocking", "sharded", "exchange", "cc", "e2e",
+    "lof", "snap", "quality", "weighted", "stream", "serve",
 ]
 
 # Indirection so orchestration tests can stub the inter-probe wait.
 _sleep = time.sleep
+
+
+def _tier_child_env(tier, env):
+    """Per-tier child environment. The ``exchange`` tier measures D ∈
+    {2, 4, 8} meshes, which need actual devices: unless the operator
+    marks a real multi-chip window (``GRAPHMINE_EXCHANGE_REAL_MESH=1``),
+    its child runs on an 8-virtual-CPU-device mesh with the honest
+    CPU-fallback record shape (the modeled exchange BYTES are exact on
+    any backend; only the seconds are CPU numbers)."""
+    if (
+        tier == "exchange"
+        and os.environ.get("GRAPHMINE_EXCHANGE_REAL_MESH") != "1"
+    ):
+        env = _virtual_cpu_env(8)
+        env["GRAPHMINE_BENCH_CPU_FALLBACK"] = "1"
+    return env
 
 
 def _virtual_cpu_env(n_devices):
@@ -2650,7 +2823,7 @@ def orchestrate(tier):
                         break
                 attempts = attempt
                 record, err = _run_child(
-                    t, dict(os.environ),
+                    t, _tier_child_env(t, dict(os.environ)),
                     min(t_timeout, max(remaining(60.0), 60.0)),
                 )
                 if record is not None:
@@ -2719,7 +2892,8 @@ def orchestrate(tier):
             emit_error(t, ["skipped: budget exhausted"])
             continue
         record, err = _run_child(
-            t, env, min(t_timeout, max(remaining(), 120.0))
+            t, _tier_child_env(t, env),
+            min(t_timeout, max(remaining(), 120.0)),
         )
         if record is None:
             # A dead first fallback tier still must not abort the suite:
@@ -2776,8 +2950,8 @@ if __name__ == "__main__":
         "--tier",
         choices=[
             "all", "chip", "roofline", "blocking", "northstar", "sharded",
-            "cc", "e2e", "lof", "snap", "quality", "weighted", "stream",
-            "serve",
+            "exchange", "cc", "e2e", "lof", "snap", "quality", "weighted",
+            "stream", "serve",
         ],
         # No-args (the driver's invocation) = the full evidence suite: one
         # healthy TPU window turns every README performance claim into a
@@ -2803,6 +2977,7 @@ if __name__ == "__main__":
         "blocking": main_blocking,
         "northstar": main_northstar,
         "sharded": main_sharded,
+        "exchange": main_exchange,
         "cc": main_cc,
         "e2e": main_e2e,
         "lof": main_lof,

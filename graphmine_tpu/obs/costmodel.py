@@ -226,6 +226,54 @@ def _plan_weighted(plan) -> bool:
     return getattr(plan, "weight_mat", None) not in (None, ())
 
 
+def _sharded_family(sg) -> str:
+    """The plan family a built ``ShardedGraph`` runs — shapes only, no
+    jax import (``getattr`` because pre-r16 pickled/stub shard objects
+    lack the 2D fields). One owner for the cost, footprint and
+    shard_exchange consumers."""
+    if getattr(sg, "x2d_src_local", None) is not None:
+        return "sharded_2d"
+    if sg.blk_src is not None:
+        return "blocked"
+    if sg.bucket_send:
+        return "bucketed"
+    return "sort"
+
+
+def allgather_exchange_bytes(sg) -> int:
+    """The one-all_gather families' modeled per-chip exchange bytes per
+    superstep — every chip receives the other ``D-1`` chunks of the
+    padded label vector (``4·Vc·(D-1)``, the ROADMAP scaling ceiling).
+    This is the 2D family's comparison ladder, so it has one owner."""
+    return _I32 * int(sg.chunk_size) * max(int(sg.num_shards) - 1, 0)
+
+
+def neighbor_exchange_bytes(sg) -> int:
+    """The 2D family's modeled per-chip WIRE bytes per superstep: each
+    of the D-1 ppermute shifts ships one buffer of the shared padded
+    width B (SPMD needs one program, so every shard pays the max
+    boundary), i.e. ``4·(D-1)·B`` — what actually crosses the ICI with
+    the current shared-width implementation. On a skewed graph where
+    one (shard, peer) boundary approaches Vc this honestly approaches
+    the all_gather ladder; :func:`neighbor_frontier_bytes` is the
+    unpadded floor a per-pair-width (or frontier-masked) refinement
+    would approach."""
+    d = max(int(sg.num_shards), 1)
+    b = int(getattr(sg, "x2d_boundary", 0))
+    return _I32 * (d - 1) * b
+
+
+def neighbor_frontier_bytes(sg) -> int:
+    """The 2D family's exact UNPADDED per-chip boundary bytes per
+    superstep — ``4·Σ_peer |boundary(peer)|`` in the ISSUE's terms,
+    fleet total divided across chips (ceil): the information content of
+    the exchange, before the shared-SPMD-width padding
+    :func:`neighbor_exchange_bytes` charges for."""
+    d = max(int(sg.num_shards), 1)
+    total = int(getattr(sg, "x2d_boundary_total", 0))
+    return _I32 * -(-total // d)
+
+
 # ---- superstep families ----------------------------------------------------
 
 
@@ -351,9 +399,15 @@ def sharded_superstep_cost(
     # NOTE: shard_graph_arrays(lpa_only=True) trims the sort-body arrays
     # (msg_send may be None on a bucketed/blocked partition) — each
     # family reads its padded slot count off its OWN arrays.
-    if sg.blk_src is not None:
-        family = "blocked"
-        mp = int(sg.blk_src.shape[1])        # padded stream slots/shard
+    x2d = getattr(sg, "x2d_src_local", None)
+    if x2d is not None or sg.blk_src is not None:
+        # One compute model for both bin-group families — same bin
+        # tiles, same row reduce; the 2D family differs only in where
+        # the stream gathers from (the compact table) and in the
+        # exchange term set below.
+        family = "sharded_2d" if x2d is not None else "blocked"
+        stream = x2d if x2d is not None else sg.blk_src
+        mp = int(stream.shape[1])            # padded stream slots/shard
         row_slots = sum(
             int(r.shape[1]) * int(r.shape[2]) for r in sg.blk_row_idx
         )
@@ -382,7 +436,15 @@ def sharded_superstep_cost(
         else (mp if mp is not None else padded) * d
     )
     m_chip = max(m_total // max(d, 1), 1)    # real slots per chip (mean)
-    exchange_bytes = _I32 * int(sg.chunk_size) * max(d - 1, 0)
+    # Exchange term: the one-all_gather families ship the other D-1
+    # label chunks per chip; the 2D family ships one padded boundary
+    # buffer per peer — the honest WIRE bytes, padding included (r16 —
+    # the bytes drop the `exchange` bench tier and the acceptance pin
+    # assert; neighbor_frontier_bytes is the unpadded floor).
+    exchange_bytes = (
+        neighbor_exchange_bytes(sg) if family == "sharded_2d"
+        else allgather_exchange_bytes(sg)
+    )
     exchange = exchange_bytes / exch_rate
     predicted = compute + exchange
     return CostEstimate(
@@ -522,6 +584,52 @@ def emit_superstep_timing(
         devices=cost.devices,
         cold_compile=bool(cold_compile),
         cost=cost.record(),
+    )
+
+
+def emit_shard_exchange(sink, op: str, sg, **kv) -> dict | None:
+    """Emit one ``shard_exchange`` record: the modeled per-chip ICI bytes
+    of the shard family that actually ran next to the one-all_gather
+    ladder model (``4·Vc·(D-1)``), with the frontier fraction — what
+    share of a full label exchange the per-peer boundary tables actually
+    ship (1.0 for the one-all_gather families by construction). This is
+    the record's single emission point (the ``emit_memory_watermark``
+    contract); emitted at the existing telemetry cadence — once per
+    sharded repair apply on the serve path (the ``exchange`` bench tier
+    carries the same modeled numbers in its per-D ``detail`` rows
+    rather than a sink stream). No-op without a sink.
+
+    ``exchange_bytes`` is the WIRE model (padded shared-width buffers —
+    what actually ships); ``frontier_bytes`` the exact unpadded
+    boundary content, and ``frontier_frac`` its share of the ladder —
+    together with ``boundary_slots`` (fleet-total unpadded count) and
+    ``padded_boundary`` (the shared SPMD width B) they say how much of
+    the exchange is frontier vs padding (the 2D analog of
+    ``padding_overhead``)."""
+    if sink is None:
+        return None
+    family = _sharded_family(sg)
+    d = int(sg.num_shards)
+    ladder = allgather_exchange_bytes(sg)
+    if family == "sharded_2d":
+        modeled = neighbor_exchange_bytes(sg)
+        frontier = neighbor_frontier_bytes(sg)
+    else:
+        modeled = frontier = ladder
+    frac = frontier / ladder if ladder else 1.0
+    return sink.emit(
+        "shard_exchange",
+        op=op,
+        family=family,
+        devices=d,
+        peers=max(d - 1, 0),
+        exchange_bytes=int(modeled),
+        frontier_bytes=int(frontier),
+        ladder_bytes=int(ladder),
+        frontier_frac=round(frac, 4),
+        boundary_slots=int(getattr(sg, "x2d_boundary_total", 0)),
+        padded_boundary=int(getattr(sg, "x2d_boundary", 0)),
+        **kv,
     )
 
 

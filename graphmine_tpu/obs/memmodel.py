@@ -97,9 +97,14 @@ BLOCKED_TILE_SLOTS_EST = 1 << 18
 IVF_BALANCE_PAD = 2.0
 
 # The family ladder the plan-time pre-degrade walks — the same
-# blocked -> bucketed -> sort order as planner._SUPERSTEP_DEGRADE
-# (sort is the floor: None, nothing leaner exists).
-FAMILY_DEGRADE = {"blocked": "bucketed", "bucketed": "sort", "sort": None}
+# sharded_2d -> blocked -> bucketed -> sort order as
+# planner._SUPERSTEP_DEGRADE (sort is the floor: None, nothing leaner
+# exists; sharded_2d's rung drops the per-peer boundary tables back to
+# the one-all_gather exchange).
+FAMILY_DEGRADE = {
+    "sharded_2d": "blocked", "blocked": "bucketed", "bucketed": "sort",
+    "sort": None,
+}
 
 
 @dataclass(frozen=True)
@@ -236,6 +241,7 @@ def superstep_footprint(
     num_edges: int | None = None,
     plan=None,
     weighted: bool | None = None,
+    num_devices: int = 1,
 ) -> MemEstimate:
     """Footprint of ONE fused (single-device) superstep operating point.
 
@@ -256,6 +262,19 @@ def superstep_footprint(
     ``sort`` drops the plan-mats term (the planner's documented
     degradation saving), and ``blocked`` adds the stream pair + tile
     the 36 B/edge seed predates.
+
+    ``num_devices`` (r16): pre-build estimates for a SHARDED operating
+    point — the ``sharded_2d`` family (only meaningful there) models the
+    per-chip sharded edge arrays + stream/tile + SHARDED labels + the
+    per-peer boundary tables at their worst case (boundary = the whole
+    peer chunk: the pre-build view cannot know the real boundary, and an
+    over-estimate pre-degrades where an under-estimate OOMs); the
+    one-all_gather families with ``num_devices > 1`` model the
+    replicated schedule's per-chip twin (sharded edge terms + the
+    replicated label pair + exchange buffer) so a sharded_2d → blocked
+    pre-degrade walk compares per-chip against per-chip. Existing
+    single-device callers (``num_devices=1``) are bit-identical to the
+    pre-r16 arithmetic.
     """
     if plan is not None:
         family = _plan_family(plan)
@@ -265,20 +284,44 @@ def superstep_footprint(
     v = int(num_vertices)
     m = max(int(num_messages), 1)
     e = int(num_edges) if num_edges is not None else m // 2
-    if family not in ("sort", "bucketed", "blocked"):
+    d = max(int(num_devices), 1)
+    if family not in ("sort", "bucketed", "blocked", "sharded_2d"):
         raise ValueError(f"unknown superstep family {family!r}")
+    if family == "sharded_2d" and d < 2:
+        raise ValueError(
+            "family 'sharded_2d' needs num_devices >= 2 (its per-peer "
+            "exchange tables have no single-device meaning)"
+        )
+    if plan is None and family == "sharded_2d":
+        vc = -(-v // d)
+        mc = -(-m // d)
+        base = schedule_inventory("single", v, e, 1, weighted)
+        inv = {k: b // d for k, b in base.items() if k != "labels"}
+        inv["stream"] = 2 * _I32 * mc
+        inv["tile"] = _I32 * min(mc, BLOCKED_TILE_SLOTS_EST)
+        inv["labels_sharded"] = 2 * _I32 * vc
+        inv["exchange_send_tab"] = _I32 * vc * (d - 1)
+        inv["exchange_recv_bufs"] = _I32 * vc * (d - 1)
+        return MemEstimate(
+            op=op, family=family, devices=d, weighted=weighted,
+            inventory=inv, exact=False,
+        )
     if plan is None:
         # Seed-anchored estimates (see docstring): the bucketed path is
         # the measured schedule model verbatim, so an admitted run can
         # never pre-degrade off the family the planner just accepted.
-        inv = schedule_inventory("single", v, e, 1, weighted)
+        if d > 1:
+            inv = schedule_inventory("replicated", v, e, d, weighted)
+        else:
+            inv = schedule_inventory("single", v, e, 1, weighted)
         if family == "sort":
             del inv["plan_mats"]
         elif family == "blocked":
-            inv["stream"] = 2 * _I32 * m
-            inv["tile"] = _I32 * min(m, BLOCKED_TILE_SLOTS_EST)
+            mc = -(-m // d)
+            inv["stream"] = 2 * _I32 * mc
+            inv["tile"] = _I32 * min(mc, BLOCKED_TILE_SLOTS_EST)
         return MemEstimate(
-            op=op, family=family, devices=1, weighted=weighted,
+            op=op, family=family, devices=d, weighted=weighted,
             inventory=inv, exact=False,
         )
     inv = {
@@ -369,6 +412,33 @@ def sharded_superstep_footprint(
         inv["shard_messages"] = msgs
     if sg.msg_weight is not None:
         inv["msg_weights"] = _per_chip_bytes(sg.msg_weight)
+    if getattr(sg, "x2d_src_local", None) is not None:
+        family = "sharded_2d"
+        inv["stream"] = (
+            _per_chip_bytes(sg.x2d_src_local) + _per_chip_bytes(sg.blk_pos)
+        )
+        inv["tile"] = _I32 * int(sg.blk_tile_alloc)
+        rows = sum(_per_chip_bytes(r) for r in sg.blk_row_idx)
+        inv["reduce_rows"] = rows
+        inv["row_vertex"] = sum(
+            _per_chip_bytes(t) for t in sg.blk_row_target
+        )
+        if sg.blk_row_weight:
+            inv["weight_mats"] = sum(
+                _per_chip_bytes(w) for w in sg.blk_row_weight
+            )
+        inv["gather_transient"] = rows
+        # the per-peer boundary plan: one send table + one received
+        # buffer set per peer offset, both at the padded [D-1, B] shape
+        inv["exchange_send_tab"] = _per_chip_bytes(sg.x2d_send_tab)
+        inv["exchange_recv_bufs"] = _per_chip_bytes(sg.x2d_send_tab)
+        # labels stay SHARDED (current + updated chunk) — the whole
+        # point: no replicated V-term regardless of `schedule`
+        inv["labels_sharded"] = 2 * _I32 * vc
+        return MemEstimate(
+            op=op, family=family, devices=d, weighted=weighted,
+            inventory=inv, exact=True,
+        )
     if sg.blk_src is not None:
         family = "blocked"
         inv["stream"] = (
@@ -476,6 +546,7 @@ def predegrade_superstep(
     num_edges: int,
     weighted: bool,
     budget_bytes: int,
+    num_devices: int = 1,
 ):
     """Walk the family ladder at PLAN time until the modeled footprint
     fits ``budget_bytes`` — the proactive twin of the driver's reactive
@@ -488,13 +559,19 @@ def predegrade_superstep(
     (empty = the requested family fits). The sort floor is returned
     even when it does not fit: there is nothing leaner, and the
     planner's schedule model already accepted the run — the reactive
-    ladder (and the watermark trail) owns whatever happens next."""
+    ladder (and the watermark trail) owns whatever happens next.
+
+    ``num_devices`` (r16): a ``sharded_2d`` starting rung — whose NEW
+    plan-time terms are the per-peer boundary tables, modeled at their
+    worst case — walks back to the one-all_gather ``blocked`` family and
+    onward; every rung is then modeled per-chip on the same mesh."""
     budget = int(budget_bytes)
     steps = []
     while True:
         est = superstep_footprint(
             "lpa_superstep", family, num_vertices, num_messages,
             num_edges=num_edges, weighted=weighted,
+            num_devices=num_devices,
         )
         nxt = FAMILY_DEGRADE.get(family)
         if est.total_bytes <= budget or nxt is None:

@@ -96,6 +96,17 @@ register("superstep_timing", "op", "family", "variant", "iteration",
 register("memory_watermark", "op", "predicted_bytes", "achieved_bytes",
          "headroom_frac", "source", "mem")
 
+# shard_exchange (ISSUE 15): modeled per-chip ICI bytes of the shard
+# family that ran next to the one-all_gather ladder model (4·Vc·(D-1)),
+# with the frontier fraction — the share of a full label exchange the 2D
+# family's per-peer boundary tables actually ship. Single builder:
+# obs/costmodel.emit_shard_exchange, emitted once per sharded repair
+# apply (serve/delta.py); the `exchange` bench tier carries the same
+# modeled numbers in its per-D detail rows rather than a sink stream.
+register("shard_exchange", "op", "family", "devices", "peers",
+         "exchange_bytes", "frontier_bytes", "ladder_bytes",
+         "frontier_frac")
+
 # ---- serving records (docs/SERVING.md) ------------------------------------
 register("snapshot_publish", "version", "snapshot_id", "path", "bytes",
          "arrays", "seconds")

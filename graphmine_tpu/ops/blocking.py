@@ -94,13 +94,29 @@ BUCKETED_MIN_MESSAGES = 1 << 16
 BLOCKED_MIN_MESSAGES = 1 << 22
 BLOCKED_MIN_VERTICES = 1 << 21
 
+# 2D edge partition with neighbor-only frontier exchange (r16): on a
+# >= 2-device mesh the exchange term is the scaling ceiling ROADMAP
+# names — the one-all_gather families ship 4·Vc·(D-1) bytes per chip per
+# superstep regardless of how small the live frontier is, while the 2D
+# family ships 4·Σ_peer |boundary(peer)| (range-partitioned power-law
+# CSRs keep boundaries well under Vc; serve-path repair frontiers keep
+# them near empty). The message floor mirrors the bucketed crossover's
+# rationale: the per-peer boundary tables are one more O(M log M) host
+# pass (one sorted-unique per shard + a positional remap), which below
+# ~16K messages would dominate the run it plans. Unmeasured
+# on silicon yet (the `exchange` bench tier is the capture point — its
+# modeled-bytes record is honest on CPU); env overrides move the wall
+# without a code change.
+SHARDED2D_MIN_MESSAGES = 1 << 14
+SHARDED2D_MIN_DEVICES = 2
+
 #: One bin's message-tile budget (int32 slots). 2^18 slots = 1 MiB —
 #: small against the ~16 MB/core VMEM so the tile, its row matrices and
 #: the reduce transients co-reside on chip (docs/DESIGN.md "Propagation-
 #: blocking binned layout").
 DEFAULT_TILE_SLOTS = 1 << 18
 
-FAMILIES = ("blocked", "bucketed", "sort")
+FAMILIES = ("blocked", "bucketed", "sort", "sharded_2d")
 
 
 def crossover_thresholds() -> dict:
@@ -121,12 +137,22 @@ def crossover_thresholds() -> dict:
                 "GRAPHMINE_BLOCKED_MIN_VERTICES", BLOCKED_MIN_VERTICES
             )
         ),
+        "sharded2d_min_messages": int(
+            os.environ.get(
+                "GRAPHMINE_SHARDED2D_MIN_MESSAGES", SHARDED2D_MIN_MESSAGES
+            )
+        ),
+        "sharded2d_min_devices": int(
+            os.environ.get(
+                "GRAPHMINE_SHARDED2D_MIN_DEVICES", SHARDED2D_MIN_DEVICES
+            )
+        ),
     }
 
 
 def select_superstep_family(
     num_vertices: int, num_messages: int, requested: str = "auto",
-    weighted: bool = False,
+    weighted: bool = False, num_devices: int = 1,
 ) -> tuple[str, str]:
     """Resolve the superstep plan family — THE single policy owner behind
     ``plan="auto"`` in ``ops/lpa.py`` / ``ops/cc.py`` / ``ops/pagerank.py``
@@ -141,23 +167,50 @@ def select_superstep_family(
     family carries the slot-aligned weight payload, so weights never
     change the selection (the weighted contract is enforced at superstep
     time — see :func:`lpa_superstep_blocked`).
+
+    ``num_devices`` (r16) gates the ``sharded_2d`` family: on a >= 2
+    device mesh past ``SHARDED2D_MIN_MESSAGES`` the 2D edge partition's
+    neighbor-only exchange replaces the per-superstep label all_gather
+    (``parallel/sharded.py``: labels sharded, per-peer boundary
+    ``ppermute``). Single-device resolutions (every fused caller) never
+    see it; an explicit ``requested="sharded_2d"`` on fewer than 2
+    devices is a loud error, while the process-wide env override simply
+    does not apply there (it targets the sharded paths; raising would
+    break the fused ops under a global override).
     """
     del weighted
+    thr = crossover_thresholds()
+    d = int(num_devices)
     if requested != "auto":
         if requested not in FAMILIES:
             raise ValueError(
                 f"unknown superstep family {requested!r}; expected one of "
                 f"{FAMILIES} or 'auto'"
             )
+        if requested == "sharded_2d" and d < 2:
+            raise ValueError(
+                "superstep family 'sharded_2d' needs a >= 2-device mesh "
+                f"(num_devices={d}); its neighbor-only exchange has no "
+                "single-device meaning — use 'blocked' there"
+            )
         return requested, f"requested {requested!r}"
     env = os.environ.get("GRAPHMINE_SUPERSTEP_FAMILY")
-    if env:
+    if env and not (env == "sharded_2d" and d < 2):
         if env not in FAMILIES:
             raise ValueError(
                 f"GRAPHMINE_SUPERSTEP_FAMILY={env!r} is not one of {FAMILIES}"
             )
         return env, f"GRAPHMINE_SUPERSTEP_FAMILY={env} (env override)"
-    thr = crossover_thresholds()
+    if (
+        d >= thr["sharded2d_min_devices"]
+        and num_messages >= thr["sharded2d_min_messages"]
+    ):
+        return "sharded_2d", (
+            f"D={d} >= {thr['sharded2d_min_devices']} and "
+            f"M={num_messages} >= {thr['sharded2d_min_messages']}: 2D edge "
+            "partition — neighbor-only boundary exchange beats the "
+            "4·Vc·(D-1)-byte label all_gather (bench tier 'exchange')"
+        )
     min_m = thr["blocked_min_messages"]
     min_v = thr["blocked_min_vertices"]
     if num_messages >= min_m and num_vertices >= min_v:

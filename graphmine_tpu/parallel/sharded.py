@@ -316,6 +316,38 @@ class ShardedGraph:
     blk_tile_alloc: int = dataclasses.field(
         metadata=dict(static=True), default=0
     )
+    # 2D edge partition with neighbor-only frontier exchange (r16,
+    # ISSUE 15): the blocked bin groups above, with the in-edges of each
+    # shard additionally grouped by the OWNER shard of their sources.
+    # Labels stay vertex-range SHARDED (no replicated V-vector, no full
+    # all_gather); per superstep each shard ships to each peer exactly
+    # the label slots that peer's bins read, as one padded
+    # ``lax.ppermute`` shift per peer offset.
+    #
+    # x2d_send_tab : int32 [D, D-1, B] — LOCAL indices of this shard's
+    #                own chunk to ship at peer offset r (axis-1 index
+    #                r-1); padding slots = 0 (shipped but never read).
+    # x2d_src_local: int32 [D, Mp] — the blocked sender-major stream
+    #                remapped onto the COMPACT label table
+    #                ``[own (Vc) | peer bufs (D-1)*B | sentinel]``;
+    #                padding messages point at the sentinel slot.
+    # x2d_boundary : B, the padded per-peer boundary width (static —
+    #                one shared SPMD width across all (shard, peer)
+    #                pairs). x2d_boundary_total: the exact UNPADDED
+    #                boundary slot count summed over every (shard, peer)
+    #                pair — the cost model's exchanged-bytes numerator.
+    # A 2D partition drops ``blk_src`` (the replicated-gather stream ids
+    # it replaces); the remaining blk_* arrays are shared verbatim, so
+    # the bin tiles — and therefore the labels — are bit-identical to
+    # the blocked family's.
+    x2d_send_tab: jax.Array | None = None
+    x2d_src_local: jax.Array | None = None
+    x2d_boundary: int = dataclasses.field(
+        metadata=dict(static=True), default=0
+    )
+    x2d_boundary_total: int = dataclasses.field(
+        metadata=dict(static=True), default=0
+    )
 
     @property
     def padded_vertices(self) -> int:
@@ -332,6 +364,7 @@ def partition_graph(
     build_bucket_plan: bool = False,
     build_blocked_plan: bool = False,
     blocked_tile_slots: int | None = None,
+    build_plan2d: bool = False,
 ) -> ShardedGraph:
     """Partition a graph's message CSR into vertex-range shards (host-side).
 
@@ -346,11 +379,18 @@ def partition_graph(
     group of shard-local destination tiles (``ops/blocking.py``), used by
     the blocked LPA **and** CC shard bodies; ``blocked_tile_slots``
     overrides the per-bin tile budget (tests force multi-bin layouts).
+    ``build_plan2d`` (r16) extends the blocked bin groups with the
+    source axis: each shard's in-edges are additionally grouped by the
+    owner shard of their sources, yielding the per-peer boundary gather
+    tables of the ``sharded_2d`` family (labels sharded, neighbor-only
+    ``ppermute`` exchange instead of the full all_gather); the blocked
+    stream ids are remapped onto the compact per-shard label table and
+    ``blk_src`` is dropped.
     """
-    if build_bucket_plan and build_blocked_plan:
+    if build_bucket_plan and (build_blocked_plan or build_plan2d):
         raise ValueError(
-            "build_bucket_plan and build_blocked_plan are mutually "
-            "exclusive — one plan family per partition"
+            "build_bucket_plan and build_blocked_plan/build_plan2d are "
+            "mutually exclusive — one plan family per partition"
         )
     if mesh is not None and num_shards is None:
         num_shards = mesh.size
@@ -425,10 +465,12 @@ def partition_graph(
             deg, send_pad, counts, vc, d, w_pad
         )
     blk = {}
-    if build_blocked_plan:
+    if build_blocked_plan or build_plan2d:
         blk = _build_shard_blocked_plan(
             deg, send_pad, counts, vc, d, w_pad, blocked_tile_slots
         )
+    if build_plan2d:
+        blk.update(_build_shard_plan2d(blk.pop("blk_src"), vc, d, pad_multiple))
 
     # Fields stay host-side (NumPy): shard_graph_arrays does the one
     # device placement, directly to the mesh sharding — no staging copy
@@ -624,6 +666,72 @@ def _build_shard_blocked_plan(
     )
 
 
+def _build_shard_plan2d(blk_src, chunk_size, d, pad_multiple=8):
+    """Source-axis extension of the blocked bin groups (r16): per-peer
+    boundary gather tables + the compact-table stream remap.
+
+    For each shard ``s`` and peer offset ``r`` (1..D-1), the boundary
+    set ``need(s, r)`` is the sorted unique LOCAL indices (within the
+    owner's chunk) of the senders shard ``s``'s bins read from owner
+    ``(s - r) % D`` — exactly the label slots that must cross the ICI
+    for that (shard, peer) pair, however small the live frontier keeps
+    them. All sets pad to one shared width ``B`` (SPMD needs one
+    program), and ``send_tab[s, r-1]`` holds what shard ``s`` SHIPS at
+    shift ``r``: ``need((s + r) % D, r)`` — the ppermute at shift ``r``
+    delivers it to precisely the peer that reads it. The blocked
+    sender-major stream (global ids in ``blk_src``) is remapped onto the
+    compact per-shard table ``[own (Vc) | bufs (D-1)*B | sentinel]`` so
+    the bin phase never touches a replicated label vector; padding
+    messages point at the sentinel slot (the blocked plan's padding
+    contract, relocated)."""
+    mp = blk_src.shape[1]
+    # One sorted-unique pass per shard, not one masked unique per
+    # (shard, peer) pair: uniq is ascending, so owner ranges are
+    # contiguous slices found by searchsorted on the chunk boundaries —
+    # O(M log M) total host work (the same order as the blocked plan
+    # build this rides on), independent of D.
+    need: list[list] = [[] for _ in range(d)]
+    uniqs, bounds = [], []
+    for s in range(d):
+        uniq = np.unique(blk_src[s].astype(np.int64))     # incl. sentinel
+        uniqs.append(uniq)
+        bound = np.searchsorted(uniq, np.arange(d + 1) * chunk_size)
+        bounds.append(bound)
+        for r in range(1, d):
+            peer = (s - r) % d
+            ids = uniq[bound[peer]: bound[peer + 1]]
+            need[s].append(ids - peer * chunk_size)
+    b = max(
+        (len(ids) for row in need for ids in row), default=1
+    )
+    b = max(-(-max(b, 1) // pad_multiple) * pad_multiple, pad_multiple)
+    send_tab = np.zeros((d, max(d - 1, 0), b), dtype=np.int32)
+    for s in range(d):
+        for r in range(1, d):
+            ids = need[(s + r) % d][r - 1]
+            send_tab[s, r - 1, : len(ids)] = ids
+    sentinel_slot = chunk_size + (d - 1) * b
+    src_local = np.full((d, mp), sentinel_slot, dtype=np.int32)
+    for s in range(d):
+        g = blk_src[s].astype(np.int64)
+        owner = g // chunk_size                           # pad -> d
+        # one global position pass: index within need[s][r-1] is the
+        # position in uniq minus the owner range's start
+        pos = np.searchsorted(uniqs[s], g)
+        in_need = pos - bounds[s][np.minimum(owner, d - 1)]
+        r_of = (s - owner) % d
+        out = chunk_size + (r_of - 1) * b + in_need
+        out = np.where(owner == s, g - s * chunk_size, out)
+        src_local[s] = np.where(owner >= d, sentinel_slot, out)
+    total = sum(len(ids) for row in need for ids in row)
+    return dict(
+        x2d_send_tab=send_tab,
+        x2d_src_local=src_local,
+        x2d_boundary=int(b),
+        x2d_boundary_total=int(total),
+    )
+
+
 def shard_graph_arrays(sg: ShardedGraph, mesh, lpa_only: bool = False) -> ShardedGraph:
     """Place the per-shard arrays on the mesh (leading dim over the vertex axis).
 
@@ -637,10 +745,14 @@ def shard_graph_arrays(sg: ShardedGraph, mesh, lpa_only: bool = False) -> Sharde
     axes = _vertex_axes(mesh)
     spec = NamedSharding(mesh, P(axes, None))
     spec3 = NamedSharding(mesh, P(axes, None, None))
-    if lpa_only and not sg.bucket_send and sg.blk_src is None:
+    if (
+        lpa_only and not sg.bucket_send and sg.blk_src is None
+        and sg.x2d_src_local is None
+    ):
         raise ValueError(
-            "lpa_only requires partition_graph(build_bucket_plan=True) or "
-            "partition_graph(build_blocked_plan=True)"
+            "lpa_only requires partition_graph(build_bucket_plan=True), "
+            "partition_graph(build_blocked_plan=True) or "
+            "partition_graph(build_plan2d=True)"
         )
     place = (lambda a, s: None) if lpa_only else jax.device_put
     return ShardedGraph(
@@ -662,6 +774,16 @@ def shard_graph_arrays(sg: ShardedGraph, mesh, lpa_only: bool = False) -> Sharde
         blk_row_target=tuple(jax.device_put(t, spec) for t in sg.blk_row_target),
         blk_row_weight=tuple(jax.device_put(b, spec3) for b in sg.blk_row_weight),
         blk_tile_alloc=sg.blk_tile_alloc,
+        x2d_send_tab=(
+            None if sg.x2d_send_tab is None
+            else jax.device_put(sg.x2d_send_tab, spec3)
+        ),
+        x2d_src_local=(
+            None if sg.x2d_src_local is None
+            else jax.device_put(sg.x2d_src_local, spec)
+        ),
+        x2d_boundary=sg.x2d_boundary,
+        x2d_boundary_total=sg.x2d_boundary_total,
     )
 
 
@@ -811,6 +933,122 @@ def _cc_shard_body_blocked(
         own[:chunk_size].astype(jnp.int32), axes, tiled=True
     )
     return jnp.minimum(full, full[full])
+
+
+def _check_2d_mesh(mesh) -> None:
+    """The 2D family's neighbor exchange is a ring of ``ppermute`` shifts
+    over ONE mesh axis (the parallel/ring.py schedule's topology) —
+    reject multi-axis meshes with a real error instead of a cryptic
+    trace-time axis failure; the replicated schedules handle 2-D
+    ``("dcn", "ici")`` meshes."""
+    if len(tuple(mesh.axis_names)) != 1:
+        raise ValueError(
+            f"the sharded_2d family needs a 1-D mesh for its ppermute "
+            f"neighbor exchange (got axes {tuple(mesh.axis_names)}); use "
+            "the one-all_gather families on multi-slice meshes"
+        )
+
+
+def _exchange_2d(own, send_tab, *, axes, num_shards):
+    """Neighbor-only frontier exchange (r16): one ``lax.ppermute`` shift
+    per peer offset r, each carrying ONE padded boundary buffer — the
+    label slots the receiving peer's bins actually read
+    (``send_tab[r-1]``, host-computed by :func:`_build_shard_plan2d`) —
+    instead of one tiled all_gather of the full label chunk. Exchanged
+    bytes per chip drop from ``4·Vc·(D-1)`` to ``4·Σ_peer |boundary|``
+    (padded to B). Returns the D-1 received buffers in peer-offset
+    order, matching the compact-table layout the stream remap indexes."""
+    bufs = []
+    for r in range(1, num_shards):
+        perm = [(i, (i + r) % num_shards) for i in range(num_shards)]
+        bufs.append(lax.ppermute(own[send_tab[r - 1]], axes, perm))
+    return bufs
+
+
+def _table_2d(own, bufs, fill):
+    """The compact per-shard label table ``[own | peer bufs | sentinel]``
+    the 2D stream remap (``x2d_src_local``) gathers from — the
+    neighbor-exchange replacement for the replicated padded label
+    vector."""
+    return jnp.concatenate(
+        [own, *bufs, jnp.full((1,), fill, own.dtype)]
+    )
+
+
+def _lpa_shard_body_2d(
+    own, src_local, blk_pos, send_tab, row_idx, row_target, row_weight=None,
+    *, chunk_size, tile_alloc, axes, num_shards
+):
+    """2D LPA shard body: neighbor-only exchange into the compact label
+    table, then the blocked bin phase + bucketed row reduce with
+    tile-local indices. The tile contents are value-for-value identical
+    to :func:`_lpa_shard_body_blocked`'s (the stream remap points each
+    message at the same sender's label; padding at the same sentinel),
+    so the labels are bit-identical to the blocked family — and hence to
+    the sort oracle (the r8 order-independence contract). Labels stay
+    SHARDED: input and output are the shard's own ``[Vc]`` chunk; no
+    replicated V-vector exists anywhere in the superstep."""
+    from graphmine_tpu.ops.bucketed_mode import (
+        _SENTINEL,
+        _bucket_mode,
+        _bucket_wmode,
+    )
+
+    bufs = _exchange_2d(own, send_tab[0], axes=axes, num_shards=num_shards)
+    table = _table_2d(own, bufs, _SENTINEL)
+    vals = table[src_local[0]]
+    tile = jnp.full((tile_alloc,), _SENTINEL, jnp.int32).at[blk_pos[0]].set(
+        vals, unique_indices=True
+    )
+    n_max = max((t.shape[-1] for t in row_target), default=0)
+    out = jnp.concatenate([own, jnp.zeros((n_max,), own.dtype)])
+    wmats = row_weight or (None,) * len(row_idx)
+    for ridx, tgt, wmat in zip(row_idx, row_target, wmats):
+        mat = tile[ridx[0]]
+        vals_r = _bucket_mode(mat) if wmat is None else _bucket_wmode(mat, wmat[0])
+        out = out.at[tgt[0]].set(vals_r, unique_indices=True)
+    return out[:chunk_size].astype(jnp.int32)
+
+
+def _cc_shard_body_2d(
+    own, src_local, blk_pos, send_tab, row_idx, row_target, *,
+    chunk_size, tile_alloc, axes, num_shards
+):
+    """2D CC shard body: the min-reduce twin of
+    :func:`_lpa_shard_body_2d`, plus a CHUNK-LOCAL pointer jump. The
+    full-vector jump (``full[full]``) of the one-all_gather bodies needs
+    random access to arbitrary global label slots — exactly the O(V)
+    exchange this family removes — so compression only follows labels
+    that land in the shard's own range (sound: any label is a same-
+    component vertex id, so ``min(own, labels[label])`` over local
+    labels is monotone and component-preserving). Convergence trades
+    O(log V) supersteps for O(D + log Vc)-ish on range-clustered
+    components — up to O(diameter) when a chain's labels alternate
+    shards and the local jump never fires (the serve repair path grants
+    its 2D CC runs a D-scaled budget for exactly this) — and the
+    FIXPOINT — labels = component-min — is unchanged, so final labels
+    stay bit-identical to the oracle and a fixpoint stays a fixpoint
+    under one more superstep (the serve-path sampled-exact-check
+    predicate)."""
+    from graphmine_tpu.ops.bucketed_mode import _SENTINEL
+
+    bufs = _exchange_2d(own, send_tab[0], axes=axes, num_shards=num_shards)
+    table = _table_2d(own, bufs, _SENTINEL)
+    vals = table[src_local[0]]
+    tile = jnp.full((tile_alloc,), _SENTINEL, jnp.int32).at[blk_pos[0]].set(
+        vals, unique_indices=True
+    )
+    n_max = max((t.shape[-1] for t in row_target), default=0)
+    out = jnp.concatenate([own, jnp.zeros((n_max,), own.dtype)])
+    for ridx, tgt in zip(row_idx, row_target):
+        row_min = jnp.min(tile[ridx[0]], axis=1)
+        out = out.at[tgt[0]].min(row_min, unique_indices=True)
+    new = out[:chunk_size].astype(jnp.int32)
+    start = lax.axis_index(axes).astype(jnp.int32) * chunk_size
+    loc = new - start
+    in_chunk = (loc >= 0) & (loc < chunk_size)
+    jumped = new[jnp.clip(loc, 0, chunk_size - 1)]
+    return jnp.minimum(new, jnp.where(in_chunk, jumped, new))
 
 
 def _cc_shard_body(labels_full, recv_local, send, deg, *, chunk_size, axes):
@@ -993,6 +1231,36 @@ def _build_lpa_step(sg: ShardedGraph, mesh):
     repair entry (:func:`_sharded_lpa_fixpoint_jit`). Traced under jit."""
     axes = _vertex_axes(mesh)
     rep = P()
+    if sg.x2d_src_local is not None:
+        # 2D edge partition (r16): labels sharded, neighbor-only
+        # ppermute exchange (partition_graph(build_plan2d=True)). The
+        # step's carry is the SHARDED [D*Vc] label vector — the loop
+        # drivers and tripwires operate on the logical array unchanged.
+        _check_2d_mesh(mesh)
+        n = len(sg.blk_row_idx)
+        nw = len(sg.blk_row_weight)
+        body = shard_map(
+            partial(
+                _lpa_shard_body_2d, chunk_size=sg.chunk_size,
+                tile_alloc=sg.blk_tile_alloc, axes=axes,
+                num_shards=sg.num_shards,
+            ),
+            mesh=mesh,
+            in_specs=(
+                P(axes),
+                P(axes, None),
+                P(axes, None),
+                P(axes, None, None),
+                (P(axes, None, None),) * n,
+                (P(axes, None),) * n,
+                (P(axes, None, None),) * nw,
+            ),
+            out_specs=P(axes),
+        )
+        return lambda l: body(
+            l, sg.x2d_src_local, sg.blk_pos, sg.x2d_send_tab,
+            sg.blk_row_idx, sg.blk_row_target, sg.blk_row_weight,
+        )
     if sg.blk_src is not None:
         # Propagation-blocking path (r7): shard-local bin tiles, same
         # one-all_gather exchange (partition_graph(build_blocked_plan=True)).
@@ -1155,7 +1423,32 @@ def _sharded_cc_jit(
     _check_mesh(sg, mesh)
     in_specs, rep = _shard_specs(mesh)
     axes = _vertex_axes(mesh)
-    if sg.blk_src is not None:
+    if sg.x2d_src_local is not None:
+        # 2D neighbor-exchange CC (r16): sharded labels, chunk-local
+        # pointer jumping — see _cc_shard_body_2d for the convergence
+        # trade; the fixpoint (and thus every published label) is
+        # bit-identical to the one-all_gather families'.
+        _check_2d_mesh(mesh)
+        n = len(sg.blk_row_idx)
+        body = shard_map(
+            partial(
+                _cc_shard_body_2d, chunk_size=sg.chunk_size,
+                tile_alloc=sg.blk_tile_alloc, axes=axes,
+                num_shards=sg.num_shards,
+            ),
+            mesh=mesh,
+            in_specs=(
+                P(axes), P(axes, None), P(axes, None),
+                P(axes, None, None),
+                (P(axes, None, None),) * n, (P(axes, None),) * n,
+            ),
+            out_specs=P(axes),
+        )
+        step = lambda l: body(
+            l, sg.x2d_src_local, sg.blk_pos, sg.x2d_send_tab,
+            sg.blk_row_idx, sg.blk_row_target,
+        )
+    elif sg.blk_src is not None:
         # Blocked CC shard body (r7): shard-local bin tiles, same
         # fixpoint driver, bit-identical labels (virtual-mesh parity).
         n = len(sg.blk_row_idx)

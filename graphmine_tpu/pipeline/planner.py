@@ -211,23 +211,28 @@ def elastic_device_ladder(schedule: str, num_devices: int) -> list[int]:
 class SuperstepPlan:
     """Resolved superstep plan family for one graph (r7).
 
-    ``family`` is the selected layout (``"blocked"`` / ``"bucketed"`` /
-    ``"sort"``); ``degrade_to`` is the family a resource failure steps
-    down to — blocked degrades to bucketed (drop the tile + stream
-    arrays, keep dense rows), bucketed to sort (drop all padded plan
-    matrices), sort has nowhere leaner to go."""
+    ``family`` is the selected layout (``"sharded_2d"`` / ``"blocked"``
+    / ``"bucketed"`` / ``"sort"``); ``degrade_to`` is the family a
+    resource failure steps down to — sharded_2d degrades to blocked
+    (drop the per-peer boundary tables, fall back to the one-all_gather
+    exchange), blocked to bucketed (drop the tile + stream arrays, keep
+    dense rows), bucketed to sort (drop all padded plan matrices), sort
+    has nowhere leaner to go."""
 
-    family: str        # "blocked" | "bucketed" | "sort"
+    family: str        # "sharded_2d" | "blocked" | "bucketed" | "sort"
     degrade_to: str    # next rung's family
     reason: str        # one-line selection rationale (measured provenance)
 
 
-_SUPERSTEP_DEGRADE = {"blocked": "bucketed", "bucketed": "sort", "sort": "sort"}
+_SUPERSTEP_DEGRADE = {
+    "sharded_2d": "blocked", "blocked": "bucketed", "bucketed": "sort",
+    "sort": "sort",
+}
 
 
 def plan_superstep(
     num_vertices: int, num_messages: int, requested: str = "auto",
-    weighted: bool = False,
+    weighted: bool = False, num_devices: int = 1,
 ) -> SuperstepPlan:
     """Resolve the LPA/CC superstep plan family at plan time.
 
@@ -236,13 +241,18 @@ def plan_superstep(
     single crossover-policy owner, with the measured-provenance table)
     so the driver's single-device dispatch AND its blocked→bucketed
     degradation rung come from one plan-time decision — the same
-    treatment :func:`plan_lof` gives the IVF flip. NOTE: imports the ops
-    layer (hence jax) lazily, like ``plan_lof``.
+    treatment :func:`plan_lof` gives the IVF flip. ``num_devices`` (r16)
+    gates the ``sharded_2d`` family: >= 2-device callers (the serve
+    sharded repair path, the exchange bench tier) resolve the
+    neighbor-exchange family here, with its degradation rung back to the
+    one-all_gather ``blocked`` family. NOTE: imports the ops layer
+    (hence jax) lazily, like ``plan_lof``.
     """
     from graphmine_tpu.ops.blocking import select_superstep_family
 
     family, reason = select_superstep_family(
-        num_vertices, num_messages, requested=requested, weighted=weighted
+        num_vertices, num_messages, requested=requested, weighted=weighted,
+        num_devices=num_devices,
     )
     return SuperstepPlan(
         family=family, degrade_to=_SUPERSTEP_DEGRADE[family], reason=reason

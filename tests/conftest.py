@@ -159,6 +159,19 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "sharded2d: 2D-edge-partition neighbor-exchange suite "
+        "(tests/test_sharded2d.py: LPA/CC bit-parity vs the sort oracle "
+        "over power-law/ring/self-loop/isolated/duplicate-edge graphs "
+        "fused + virtual-mesh sharded (weighted included), per-peer "
+        "boundary index-table exactness on hand-built 3-shard graphs, "
+        "the planner ladder + env-override policy pins, costmodel/"
+        "memmodel exact-arithmetic pins, plan-time per-peer-buffer "
+        "pre-degrade, the serve warm-repair 2D e2e and the exchange "
+        "bench-tier smoke); runs in the default CPU pass — select with "
+        "-m sharded2d or tools/run_tier1.sh --sharded2d-only",
+    )
+    config.addinivalue_line(
+        "markers",
         "mem: memory-plane observability suite (tests/test_memmodel.py: "
         "the analytical HBM footprint inventory exact against "
         "hand-computed tiny plans, the planner byte-constant "

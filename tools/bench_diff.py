@@ -62,8 +62,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # tests/test_costmodel.py so the two can never drift; bench.py imports
 # numpy at module load, which this stdlib-only tool must not).
 ALL_TIERS = (
-    "chip", "roofline", "blocking", "northstar", "sharded", "cc", "e2e",
-    "lof", "snap", "quality", "weighted", "stream", "serve",
+    "chip", "roofline", "blocking", "northstar", "sharded", "exchange",
+    "cc", "e2e", "lof", "snap", "quality", "weighted", "stream", "serve",
 )
 
 # Detail sub-records the manifest tracks per tier: each ships inside its
@@ -71,6 +71,10 @@ ALL_TIERS = (
 # a NON-fallback record (the ROADMAP backlog named exactly these).
 SUB_RECORDS = {
     "blocking": ("binned_vs_random_gather",),
+    # the neighbor-exchange vs all_gather WALL ratio needs a real
+    # multi-chip ICI window (the committed records are virtual-mesh CPU
+    # fallbacks whose modeled bytes are exact but whose seconds are not)
+    "exchange": ("neighbor_vs_allgather",),
     "stream": ("ivf_reuse",),
     "serve": ("write_load", "replicated_read", "writer_failover",
               "latency_quantiles", "quality_pass", "memory"),
@@ -88,6 +92,7 @@ _METRIC_TIER_PREFIXES = (
     ("roofline_", "roofline"),
     ("blocking_", "blocking"),
     ("sharded_lpa", "sharded"),
+    ("exchange_", "exchange"),
     ("cc_", "cc"),
     ("e2e_", "e2e"),
     ("lof_", "lof"),
@@ -117,7 +122,9 @@ TIER_TOLERANCE = {
 }
 
 # Units where DOWN is an improvement (everything else: up is better).
-LOWER_BETTER_UNITS = frozenset(("s", "seconds", "ms", "us"))
+# "frac" is the exchange tier's neighbor/all_gather bytes fraction —
+# fewer bytes on the wire is the whole point of the 2D family.
+LOWER_BETTER_UNITS = frozenset(("s", "seconds", "ms", "us", "frac"))
 
 # Per-tier memory sub-record gate (ISSUE 14): peak bytes regress UP.
 # Child RSS is noisier than kernel rates (allocator arenas, import

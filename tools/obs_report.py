@@ -287,9 +287,10 @@ def _roofline_section(records, min_frac: float):
         return []
     out = [
         "  op               it  win  family/variant     "
-        "achieved/chip      model/chip   frac"
+        "achieved/chip      model/chip   frac  exch%"
     ]
     flagged = 0
+    exchange_windows = 0
     for r in timings:
         frac = float(r.get("achieved_fraction", 0.0) or 0.0)
         # a window that paid an XLA trace+compile (the ops seams mark
@@ -306,23 +307,31 @@ def _roofline_section(records, min_frac: float):
             note = f"  << below {min_frac:g}x model"
         elif cold and frac < min_frac:
             note = "  (window includes XLA compile — not flagged)"
+        # exchange column (ISSUE 15): the model's exchange share of the
+        # window — the "is this superstep exchange-bound" number the §15
+        # runbook reads before blaming the ICI
+        cost = r.get("cost")
+        exch_col, split = "    -", None
+        if isinstance(cost, dict) and cost.get("exchange_bytes"):
+            cs = float(cost.get("compute_seconds", 0.0) or 0.0)
+            es = float(cost.get("exchange_seconds", 0.0) or 0.0)
+            tot = (cs + es) or 1.0
+            exch_col = f"{100 * es / tot:>4.0f}%"
+            split = (
+                f"      model split: compute {100 * cs / tot:.0f}% / "
+                f"exchange {100 * es / tot:.0f}% "
+                f"({cost['exchange_bytes']:,} B ICI per superstep)"
+            )
+            exchange_windows += 1
         out.append(
             f"  {str(r.get('op', '?')):<15} {r.get('iteration', '?'):>3}"
             f"  {r.get('window', '?'):>3}  {fam:<17}"
             f"  {int(r.get('edges_per_sec_per_chip', 0) or 0):>13,}"
             f"  {int(r.get('predicted_edges_per_sec_per_chip', 0) or 0):>14,}"
-            f"  {frac:>5.2f}{note}"
+            f"  {frac:>5.2f}  {exch_col}{note}"
         )
-        cost = r.get("cost")
-        if isinstance(cost, dict) and cost.get("exchange_bytes"):
-            cs = float(cost.get("compute_seconds", 0.0) or 0.0)
-            es = float(cost.get("exchange_seconds", 0.0) or 0.0)
-            tot = (cs + es) or 1.0
-            out.append(
-                f"      model split: compute {100 * cs / tot:.0f}% / "
-                f"exchange {100 * es / tot:.0f}% "
-                f"({cost['exchange_bytes']:,} B ICI per superstep)"
-            )
+        if split:
+            out.append(split)
     if flagged:
         out.append(
             f"  {flagged} window(s) below {min_frac:g}x of model — read "
@@ -345,6 +354,23 @@ def _roofline_section(records, min_frac: float):
         out.append(f"  model anchors: {anchors}")
         if roof.get("provenance"):
             out.append(f"  anchor provenance: {roof['provenance']}")
+        # Exchange-anchor provenance flag (ISSUE 15 small fix): the
+        # exchange split above divides by `exchange_bytes_per_sec`,
+        # which has never been measured on silicon — a window reading
+        # below model because of an optimistic exchange seed is a model
+        # problem, not a device problem, and the verdict must say so
+        # instead of letting a below-model flag rest silently on an
+        # unmeasured anchor.
+        prov = str(roof.get("provenance") or "")
+        if exchange_windows and "exchange_bytes_per_sec: model seed" in prov:
+            out.append(
+                f"  !! {exchange_windows} window(s) carry an exchange "
+                "split anchored to the UNMEASURED exchange_bytes_per_sec "
+                "model seed — capture the sharded/exchange bench tiers "
+                "(and re-seed via GRAPHMINE_ROOFLINE_FILE) before "
+                "trusting a below-model exchange verdict "
+                "(docs/RUNBOOKS.md §15)"
+            )
     return out
 
 

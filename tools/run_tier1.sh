@@ -7,7 +7,15 @@
 # (-m faults: tests/test_resilience.py + the tripwire/reshard cases in
 # tests/test_sharded.py) is part of this default pass.
 #
-# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only|--slo-only|--blocking-only|--admission-only|--fleet-only|--wal-only|--trace-only|--perf-only|--quality-only|--mem-only] [extra pytest args...]
+# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only|--slo-only|--blocking-only|--admission-only|--fleet-only|--wal-only|--trace-only|--perf-only|--quality-only|--mem-only|--sharded2d-only] [extra pytest args...]
+#   --sharded2d-only run just the `sharded2d`-marked 2D-edge-partition
+#                  suite (tests/test_sharded2d.py: neighbor-exchange
+#                  bit-parity vs the sort oracle, per-peer boundary
+#                  index tables, the crossover/env policy pins,
+#                  cost/memmodel exact pins, plan-time pre-degrade, the
+#                  serve warm-repair 2D e2e and the exchange bench-tier
+#                  smoke) — the fast slice when iterating on the 2D
+#                  partition or its exchange plan
 #   --mem-only     run just the `mem`-marked memory-plane suite
 #                  (tests/test_memmodel.py: the HBM footprint inventory
 #                  exact against hand-computed tiny plans, the planner
@@ -119,6 +127,9 @@ elif [ "${1:-}" = "--quality-only" ]; then
 elif [ "${1:-}" = "--mem-only" ]; then
     shift
     MARKER='mem and not slow'
+elif [ "${1:-}" = "--sharded2d-only" ]; then
+    shift
+    MARKER='sharded2d and not slow'
 fi
 
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
