@@ -894,6 +894,119 @@ def _serve_write_load(tmp, src, dst, labels, cc, lof, fp, v):
     return out
 
 
+def _serve_multi_tenant(tmp, arrays, fp, v):
+    """The serve tier's multi-tenant isolation sub-record (ISSUE 16,
+    docs/SERVING.md "Multi-tenant serving"): three namespaces behind ONE
+    server, one tenant firing an order of magnitude more rows than the
+    two victims under a tight per-tenant quota — the record is the
+    noisy-neighbor bound itself: the abuser's shed mix, the victims'
+    zero-shed apply counts and their read p99 measured DURING the
+    flood."""
+    import threading
+
+    from graphmine_tpu.serve.server import SnapshotServer
+    from graphmine_tpu.serve.snapshot import SnapshotStore
+    from graphmine_tpu.testing import faults as _faults
+
+    root = os.path.join(tmp, "mt")
+    store = SnapshotStore(root)
+    store.publish(arrays, fingerprint=fp)
+    tenants = ("abuser", "victim_b", "victim_c")
+    for t in tenants:
+        store.for_tenant(t).publish(arrays, fingerprint=fp)
+    abuse = (20, 120) if _CPU_FALLBACK else (40, 400)
+    quiet = (6, 24) if _CPU_FALLBACK else (12, 80)
+    server = SnapshotServer(store)
+    # per-tenant quota: the abuser's pending-row budget is a fraction of
+    # its own burst, so ITS overflow sheds; the victims' budgets clear
+    # their bursts whole
+    server.tenancy.set_overrides(
+        "abuser", max_pending_rows=abuse[1] * 4, max_queue_depth=4,
+        deadline_s=120.0,
+    )
+    for t in tenants[1:]:
+        server.tenancy.set_overrides(
+            t, max_pending_rows=quiet[0] * quiet[1] * 2,
+            max_queue_depth=max(8, quiet[0]), deadline_s=120.0,
+        )
+    bursts = {
+        "abuser": _faults.delta_burst(
+            v, batches=abuse[0], rows_per_batch=abuse[1], seed=21
+        ),
+        "victim_b": _faults.delta_burst(
+            v, batches=quiet[0], rows_per_batch=quiet[1], seed=22
+        ),
+        "victim_c": _faults.delta_burst(
+            v, batches=quiet[0], rows_per_batch=quiet[1], seed=23
+        ),
+    }
+    results = {t: [] for t in tenants}
+    read_lat = {t: [] for t in tenants[1:]}
+    stop = threading.Event()
+
+    def _reader(tenant):
+        while not stop.is_set():
+            t_op = time.perf_counter()
+            server.engine_for(tenant).membership(0)
+            read_lat[tenant].append(time.perf_counter() - t_op)
+            time.sleep(0.002)
+
+    readers = [
+        threading.Thread(target=_reader, args=(t,)) for t in tenants[1:]
+    ]
+    t0 = time.perf_counter()
+    for r in readers:
+        r.start()
+    threads = []
+    for t in tenants:
+        for p in bursts[t]:
+            th = threading.Thread(
+                target=lambda pl=p, tn=t: results[tn].append(
+                    server.apply_delta(pl, tenant=tn)
+                )
+            )
+            th.start()
+            threads.append(th)
+            time.sleep(0.001)
+    for th in threads:
+        th.join()
+    server.wait_applied(timeout=120.0)
+    elapsed = time.perf_counter() - t0
+    stop.set()
+    for r in readers:
+        r.join()
+    per_tenant = {}
+    for t in tenants:
+        shed = sum(1 for r in results[t] if r.get("verdict") == "shed")
+        adm = server._tenants[t].admission.snapshot()
+        per_tenant[t] = {
+            "submitted": len(bursts[t]),
+            "accepted_batches": len(results[t]) - shed,
+            "shed_batches": shed,
+            "verdicts": adm["verdicts"],
+            "version": server.engine_for(t).version,
+        }
+    server.stop()
+
+    def _p99_us(lat):
+        if not lat:
+            return None
+        return round(float(np.percentile(np.array(lat), 99)) * 1e6, 2)
+
+    return {
+        "seconds": round(elapsed, 3),
+        "fair_quantum_rows": server._fair_quantum_rows,
+        "tenants": per_tenant,
+        "victim_read_p99_us": {t: _p99_us(read_lat[t]) for t in read_lat},
+        # the isolation verdicts bench_diff watches: victims shed
+        # nothing and kept publishing while the abuser was throttled
+        "victims_shed_batches": sum(
+            per_tenant[t]["shed_batches"] for t in tenants[1:]
+        ),
+        "abuser_shed_batches": per_tenant["abuser"]["shed_batches"],
+    }
+
+
 def _serve_replicated_read(tmp, arrays, fp, v):
     """The serve tier's replicated-read sub-record (r10): hammer the
     SAME batched-query workload through the fleet router at 1 vs 3
@@ -1324,6 +1437,12 @@ def main_serve() -> None:
         # bounded-cost claim for the per-publish quality pass, tracked
         # by bench_diff's manifest + regression gate.
         quality_pass = _serve_quality_pass(rng)
+
+        # tenant isolation under an abusive co-tenant (ISSUE 16): three
+        # namespaces on one server, per-tenant quotas + weighted-fair
+        # apply — the victims' read p99 and zero-shed apply counts ARE
+        # the noisy-neighbor bound the manifest tracks.
+        multi_tenant = _serve_multi_tenant(tmp, arrays, fp, v)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -1371,6 +1490,8 @@ def main_serve() -> None:
                     "writer_failover": writer_failover,
                     # per-publish quality-pass cost ladder (ISSUE 13)
                     "quality_pass": quality_pass,
+                    # noisy-neighbor isolation bound (ISSUE 16)
+                    "multi_tenant": multi_tenant,
                     "device": str(jax.devices()[0]),
                 },
             }

@@ -191,13 +191,22 @@ class AlertManager:
     the fleet prober all drive :meth:`evaluate` concurrently.
     """
 
-    def __init__(self, rules=None, sink=None, registry=None, clock=None):
+    def __init__(
+        self, rules=None, sink=None, registry=None, clock=None,
+        tenant: str = "",
+    ):
         self.rules = list(default_rules() if rules is None else rules)
         names = [r.name for r in self.rules]
         if len(names) != len(set(names)):
             raise ValueError(f"duplicate alert rule names in {names}")
         self.sink = sink
         self.registry = registry
+        # Tenant-scoped manager (ISSUE 16): a non-empty tenant stamps
+        # every alert record with the owner — tenant A's canary page
+        # names A — and skips the unlabelled firing gauge (per-tenant
+        # managers racing one gauge would be last-writer-wins noise;
+        # the default manager, tenant="", keeps the fleet-facing gauge).
+        self.tenant = tenant
         self._clock = clock if clock is not None else time.monotonic
         self._states = {r.name: _RuleState(r) for r in self.rules}
         self._lock = threading.Lock()
@@ -259,6 +268,9 @@ class AlertManager:
         if self.sink is None:
             return
         r = st.rule
+        kv = {}
+        if self.tenant:
+            kv["tenant"] = self.tenant
         self.sink.emit(
             "alert",
             name=r.name,
@@ -271,10 +283,11 @@ class AlertManager:
             for_s=r.for_s,
             times_fired=times_fired,
             description=r.description,
+            **kv,
         )
 
     def _export(self) -> None:
-        if self.registry is None:
+        if self.registry is None or self.tenant:
             return
         self.registry.gauge(
             "graphmine_alerts_firing", "alert rules currently firing"

@@ -22,6 +22,8 @@ records) — registration is the contract, not a fixed builtin list.
 
 from __future__ import annotations
 
+import re
+
 _TRACE_KEYS = ("run_id", "trace_id", "span_id", "span_path")
 
 # phase name -> frozenset of required keys (beyond phase/t).
@@ -215,6 +217,26 @@ register("checkpoint_save", "iteration", "format", "path")
 register("checkpoint_rollback", "path", "error")
 register("checkpoint_rollback_ok", "path", "iteration")
 
+# ---- multi-tenant serving (ISSUE 16, docs/SERVING.md "Multi-tenant
+# serving") -----------------------------------------------------------------
+# Records on these phases MAY carry an optional `tenant` key naming the
+# owning tenant (serve/tenancy.py grammar). ABSENT means the default
+# tenant — the back-compat contract that keeps every pre-tenancy record
+# valid — so the key is never required; when present it must be a valid
+# tenant id (a malformed value would leak into per-tenant groupings as a
+# phantom tenant). obs_report groups admission/quality/alert timelines
+# by it.
+TENANT_PHASES = frozenset((
+    "admission", "delta_coalesce", "delta_shed", "delta_apply",
+    "delta_stages", "snapshot_publish", "snapshot_load", "access_log",
+    "alert", "quality_snapshot", "quality_drift", "canary_score",
+    "wal_append", "wal_replay", "repair_fallback",
+))
+
+# Mirrors serve/tenancy.py TENANT_RE — duplicated by design: obs/ stays
+# importable without serve/ (the JSONL consumers are stdlib-only tools).
+_TENANT_VALUE_RE = re.compile(r"[a-z0-9_-]{1,64}")
+
 # The recovery phases obs_report joins into the causal timeline.
 RECOVERY_PHASES = frozenset((
     "retry", "retries_exhausted", "degrade", "mesh_degrade", "tripwire",
@@ -284,6 +306,13 @@ def validate_record(rec) -> list:
         problems.append(
             f"{phase}: partial trace identity (has {present}, lacks {absent})"
         )
+    if "tenant" in rec:
+        tval = rec["tenant"]
+        if not isinstance(tval, str) or not _TENANT_VALUE_RE.fullmatch(tval):
+            problems.append(
+                f"{phase}: tenant key {tval!r} does not match the tenant-id "
+                "grammar [a-z0-9_-]{1,64} (serve/tenancy.py)"
+            )
     for key in rec:
         if not key.endswith("_sketch"):
             continue

@@ -58,6 +58,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from graphmine_tpu.serve.delta import EdgeDelta
+from graphmine_tpu.serve.tenancy import DEFAULT_TENANT
 
 # Defaults sized for the CPU-fallback container this repo develops in; a
 # real deployment tunes via env. pending-rows bounds the repair backlog
@@ -167,6 +168,16 @@ class AdmissionController:
     ``delta_shed`` record per :meth:`record_shed`; ``registry`` mirrors
     verdict totals into scrapeable counters and the live queue-depth /
     overloaded gauges.
+
+    ``tenant`` (ISSUE 16): a multi-tenant server runs ONE controller per
+    tenant — each with its own bounds ladder (per-tenant overrides via
+    the :class:`~graphmine_tpu.serve.tenancy.TenantRegistry`) and its
+    own verdict counters, so tenant A saturating its debt bound sheds
+    only A. Records carry the tenant id (absent = default tenant); the
+    shared registry gauges are exported by the DEFAULT tenant's
+    controller only — per-tenant controllers writing one unlabelled
+    gauge would race each other into a meaningless last-writer value,
+    so per-tenant admission state lives on ``/statusz`` instead.
     """
 
     def __init__(
@@ -174,13 +185,23 @@ class AdmissionController:
         bounds: AdmissionBounds | None = None,
         sink=None,
         registry=None,
+        tenant: str = DEFAULT_TENANT,
     ):
         self.bounds = bounds if bounds is not None else AdmissionBounds.from_env()
         self.sink = sink
         self.registry = registry
+        self.tenant = tenant or DEFAULT_TENANT
         self._lock = threading.Lock()
         self._verdicts = {v: 0 for v in VERDICTS}
         self._deferred_lof = 0
+
+    def _tenant_kv(self) -> dict:
+        """The record tag: present only for non-default tenants (the
+        schema contract — an absent key reads as the default tenant, so
+        every pre-tenancy record stays valid)."""
+        if self.tenant != DEFAULT_TENANT:
+            return {"tenant": self.tenant}
+        return {}
 
     # -- the ladder --------------------------------------------------------
     def _shed_reason(self, rows: int, queue_depth: int, debt: dict) -> str | None:
@@ -281,6 +302,7 @@ class AdmissionController:
                 rows=decision.rows,
                 lof_mode=decision.lof_mode,
                 repair_debt=dict(debt),
+                **self._tenant_kv(),
             )
 
     def _lof_mode_reason(self, rows: int, debt: dict) -> tuple[str, str]:
@@ -340,6 +362,7 @@ class AdmissionController:
                 queue_depth=int(queue_depth),
                 retry_after_s=self.bounds.retry_after_s,
                 repair_debt=dict(debt),
+                **self._tenant_kv(),
             )
 
     def record_coalesce(self, info: dict, debt: dict) -> None:
@@ -350,11 +373,20 @@ class AdmissionController:
                 "delta batches merged into a coalesced apply",
             ).inc(int(info.get("batches", 0)))
         if self.sink is not None:
-            self.sink.emit("delta_coalesce", repair_debt=dict(debt), **info)
+            self.sink.emit(
+                "delta_coalesce", repair_debt=dict(debt),
+                **self._tenant_kv(), **info,
+            )
 
     def _export(self, queue_depth: int, debt: dict) -> None:
         reg = self.registry
         if reg is None:
+            return
+        if self.tenant != DEFAULT_TENANT:
+            # Per-tenant controllers would race each other into one
+            # unlabelled gauge (last writer wins = noise); the default
+            # tenant's controller keeps the fleet-facing gauges and
+            # per-tenant state is served on /statusz.
             return
         with self._lock:
             counts = dict(self._verdicts)
@@ -383,6 +415,7 @@ class AdmissionController:
             counts = dict(self._verdicts)
             deferred = self._deferred_lof
         return {
+            "tenant": self.tenant,
             "verdicts": counts,
             "lof_deferred": deferred,
             "bounds": self.bounds.snapshot(),

@@ -748,7 +748,7 @@ def cold_recompute(graph, budget: int = 0, shards=None):
 def _verify_or_fallback(
     graph, labels, cc, conv_l, conv_c, delta: EdgeDelta, budget: int,
     iterations: int, check_samples: int, sink, num_shards: int = 1,
-    seed: int = 0, shards=None,
+    seed: int = 0, shards=None, tenant: str = "",
 ) -> RepairResult:
     """The shared tail of BOTH repair paths (single-device and sharded):
     fault seam → sampled exact check → accept or fall back. One owner so
@@ -762,7 +762,12 @@ def _verify_or_fallback(
     silent damage and the fallback republishes exact labels.
     """
     state = {"labels": labels, "cc_labels": cc}
-    resilience.fault_point("delta_repair", state=state, num_shards=num_shards)
+    # tenant rides the ctx (ISSUE 16): a tenant-targeted injector
+    # (noisy_neighbor_burst's staller) fires only on the abusive
+    # tenant's applies, leaving its co-tenants' repairs untouched.
+    resilience.fault_point(
+        "delta_repair", state=state, num_shards=num_shards, tenant=tenant,
+    )
     labels, cc = state["labels"], state["cc_labels"]
 
     v = graph.num_vertices
@@ -812,6 +817,7 @@ def repair_labels(
     check_samples: int = 64,
     sink=None,
     seed: int = 0,
+    tenant: str = "",
 ) -> RepairResult:
     """Warm-start repair of community + CC labels on the spliced graph.
 
@@ -834,7 +840,7 @@ def repair_labels(
     )
     return _verify_or_fallback(
         graph, labels, cc, conv_l, conv_c, delta, budget, it_l + it_c,
-        check_samples, sink, seed=seed,
+        check_samples, sink, seed=seed, tenant=tenant,
     )
 
 
@@ -972,11 +978,12 @@ class DeltaIngestor:
         # "random" vertices on every delta, gutting the tripwire's
         # long-run coverage of silent corruption outside the frontier.
         seed = self.snapshot.version
+        tenant = getattr(self.store, "tenant", "")
         if self.num_shards <= 1:
             return repair_labels(
                 graph, self.labels, self.cc_labels, delta,
                 check_samples=self.check_samples, sink=self.sink,
-                seed=seed,
+                seed=seed, tenant=tenant,
             )
         return self._repair_sharded(graph, delta, seed)
 
@@ -1114,6 +1121,7 @@ class DeltaIngestor:
             delta, budget, int(it_l) + int(tele.iterations),
             self.check_samples, self.sink, num_shards=self.num_shards,
             seed=seed, shards=(sg, mesh),
+            tenant=getattr(self.store, "tenant", ""),
         )
 
     def _refresh_lof(self, graph, labels: np.ndarray, aff: np.ndarray):

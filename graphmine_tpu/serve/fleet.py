@@ -750,6 +750,8 @@ class ReplicaSet:
                     "snapshot_age_s": r.last_health.get("snapshot_age_s"),
                     "overloaded": r.last_health.get("overloaded"),
                     "lof_stale": r.last_health.get("lof_stale"),
+                    "tenants": r.last_health.get("tenants"),
+                    "tenant_versions": r.last_health.get("tenant_versions"),
                 }
                 for r in self.replicas()
             ],
@@ -1194,6 +1196,10 @@ class FleetRouter:
                             {"X-Request-Id": headers["X-Request-Id"]}
                             if headers.get("X-Request-Id") else {}
                         ),
+                        **(
+                            {"X-Tenant-Id": headers["X-Tenant-Id"]}
+                            if headers.get("X-Tenant-Id") else {}
+                        ),
                     },
                 )
             except Exception as e:  # noqa: BLE001 — timeout/refused/reset
@@ -1352,8 +1358,11 @@ class FleetRouter:
         # X-Delta-Id / X-Delta-Ack ride through: the idempotency key and
         # the WAL-durable 202 contract are writer semantics the router
         # must not strip (r11, docs/SERVING.md "Replicated writers").
+        # X-Tenant-Id too (ISSUE 16): tenant routing is writer
+        # semantics — stripping it would land the delta on the default
+        # namespace, a silent cross-tenant write.
         for name in ("X-Deadline-Ms", "X-Request-Id", "X-Delta-Id",
-                     "X-Delta-Ack"):
+                     "X-Delta-Ack", "X-Tenant-Id"):
             if headers.get(name):
                 fwd_headers[name] = headers[name]
         t0 = time.monotonic()
@@ -1468,14 +1477,34 @@ class FleetRouter:
                     "ok": False, "rolled": rolled,
                     "aborted": f"reload of {rep.spec.id} failed: {e!r}",
                 }
+            # Per-tenant committed rule (ISSUE 16): /reload answers with
+            # the default tenant's new version, but a multi-tenant
+            # replica can come back caught up on that namespace and
+            # STALE on another it also serves. Snapshot its pre-drain
+            # tenant_versions and refuse rejoin until it is at-or-past
+            # every one of them — behind on ANY tenant is catch-up-stale.
+            before_tv = rep.last_health.get("tenant_versions")
+            before_tv = dict(before_tv) if isinstance(before_tv, dict) else {}
             ok = False
             rejoin_deadline = time.monotonic() + cfg.rejoin_timeout_s
             while time.monotonic() < rejoin_deadline:
                 health = self._probe_replica(rep, cfg.probe_timeout_s)
+                tenants_ok = True
+                if health is not None and before_tv:
+                    after_tv = health.get("tenant_versions")
+                    after_tv = after_tv if isinstance(after_tv, dict) else {}
+                    try:
+                        tenants_ok = all(
+                            int(after_tv.get(t, -1)) >= int(v)
+                            for t, v in before_tv.items()
+                        )
+                    except (TypeError, ValueError):
+                        tenants_ok = False
                 if (
                     health is not None
                     and bool(health.get("ready", True))
                     and int(health.get("version", 0)) == new_version
+                    and tenants_ok
                 ):
                     rep.version = new_version
                     rep.last_health = health
@@ -1858,8 +1887,11 @@ class _FleetHandler(BaseHTTPRequestHandler):
         self._send(status, resp, headers)
 
     def _ep_write(self, url) -> None:
+        # keep the query string: ?tenant= is the header-less tenant
+        # spelling and must survive the router hop like X-Tenant-Id does
+        path_qs = url.path + (f"?{url.query}" if url.query else "")
         status, resp, headers = self.rtr.forward_write(
-            url.path, self._body(), self.headers
+            path_qs, self._body(), self.headers
         )
         self._send(status, resp, headers)
 

@@ -148,6 +148,11 @@ from graphmine_tpu.serve.delta import (
 )
 from graphmine_tpu.serve.query import QueryEngine
 from graphmine_tpu.serve.snapshot import PublishFencedError, SnapshotStore
+from graphmine_tpu.serve.tenancy import (
+    DEFAULT_TENANT,
+    TenantRegistry,
+    UnknownTenantError,
+)
 from graphmine_tpu.serve.wal import LogShipper, WriteAheadLog
 
 # Client-supplied request ids are echoed into headers, records and logs:
@@ -207,7 +212,7 @@ class _PendingDelta:
     __slots__ = ("delta", "rows", "deadline", "deadline_s", "status",
                  "result", "error", "event", "shed_reason", "seq",
                  "delta_id", "async_ack", "trace", "t_accept",
-                 "t_durable")
+                 "t_durable", "tenant")
 
     def __init__(
         self, delta: EdgeDelta, rows: int, deadline: float,
@@ -241,6 +246,61 @@ class _PendingDelta:
         self.seq: int | None = None
         self.delta_id = ""
         self.async_ack = False
+        # Tenant ownership (ISSUE 16): which tenant's sub-queue this
+        # batch parks on — its debt, sheds and apply all charge HERE,
+        # never to another tenant's ledger.
+        self.tenant = DEFAULT_TENANT
+
+
+class _TenantSink:
+    """Sink proxy for one non-default tenant's ingest/alert plane: every
+    record emitted through it carries ``tenant=<id>`` (the obs-schema
+    contract — an ABSENT key reads as the default tenant, so the default
+    tenant's path never pays the proxy and every pre-tenancy record
+    stays valid). Spans, the registry and tracer identity pass through
+    to the real sink untouched."""
+
+    __slots__ = ("_sink", "_tenant")
+
+    def __init__(self, sink, tenant: str):
+        self._sink = sink
+        self._tenant = tenant
+
+    def emit(self, phase: str, **kv):
+        kv.setdefault("tenant", self._tenant)
+        return self._sink.emit(phase, **kv)
+
+    def __getattr__(self, name):
+        return getattr(self._sink, name)
+
+
+class _TenantState:
+    """Everything ONE tenant owns on this server (ISSUE 16): its
+    namespaced store and double-buffered engine, its own admission
+    ladder + repair-debt ledger (so a tenant saturating its bounds
+    sheds only itself), its apply sub-queue — the unit the
+    weighted-fair worker dequeues, with its deficit-round-robin
+    balance — and its quality report + alert plane. The default
+    tenant's state IS the legacy single-tenant server state, aliased
+    through :class:`SnapshotServer` properties so every pre-tenancy
+    call site (and test) reads and writes the same objects."""
+
+    __slots__ = ("tenant", "store", "engine", "ingestor", "admission",
+                 "debt", "alerts", "queue", "reserved", "deficit",
+                 "quality_report")
+
+    def __init__(self, tenant: str, store: SnapshotStore):
+        self.tenant = tenant
+        self.store = store
+        self.engine: QueryEngine | None = None
+        self.ingestor: DeltaIngestor | None = None
+        self.admission: AdmissionController | None = None
+        self.debt: RepairDebt | None = None
+        self.alerts: AlertManager | None = None
+        self.queue: deque = deque()
+        self.reserved = 0        # queue slots promised mid-WAL-append
+        self.deficit = 0.0       # DRR balance, in rows
+        self.quality_report = None
 
 
 class SnapshotServer:
@@ -297,6 +357,27 @@ class SnapshotServer:
         self.registry: Registry = (
             sink.registry if sink is not None else Registry()
         )
+        # Multi-tenant state (ISSUE 16, serve/tenancy.py): one
+        # _TenantState per tenant. The default tenant's is created here
+        # and the legacy single-tenant attributes (engine, admission,
+        # debt, alerts, queue) are property-aliased into it, so every
+        # assignment below this point lands on the default state. _rr is
+        # the weighted-fair dequeue's rotation of tenants with queued
+        # work; the quantum is the per-visit row grant of the deficit
+        # round-robin.
+        self.tenancy = TenantRegistry()
+        self._tenants: dict[str, _TenantState] = {
+            DEFAULT_TENANT: _TenantState(DEFAULT_TENANT, store),
+        }
+        self._tenants_lock = threading.Lock()
+        self._rr: deque = deque()
+        raw_q = os.environ.get("GRAPHMINE_FAIR_QUANTUM_ROWS", "4096")
+        try:
+            self._fair_quantum_rows = max(1, int(raw_q))
+        except ValueError as e:
+            raise ValueError(
+                f"GRAPHMINE_FAIR_QUANTUM_ROWS={raw_q!r} is not an int"
+            ) from e
         self.debt = RepairDebt(registry=self.registry)
         # Result-quality alerting (ISSUE 13, obs/alerts.py): evaluated
         # on the EXISTING cadences — every /healthz (the fleet prober's
@@ -377,13 +458,14 @@ class SnapshotServer:
         # the ingestor's host state) assume it. Held by the apply worker
         # around each apply+swap, and by /reload.
         self._delta_lock = threading.Lock()
-        # The bounded apply queue (admission gates its depth) + the one
-        # background worker that drains/coalesces it. _reserved counts
-        # slots promised to batches that are mid-WAL-append (between the
-        # admission verdict and the enqueue) so concurrent submitters
-        # can't overshoot max_queue_depth through that window.
-        self._queue: deque = deque()
-        self._reserved = 0
+        # The bounded apply queues (one sub-queue per tenant, each gated
+        # by that tenant's admission bounds) + the one background worker
+        # that drains them weighted-fair. Each tenant's `reserved`
+        # counts slots promised to batches that are mid-WAL-append
+        # (between the admission verdict and the enqueue) so concurrent
+        # submitters can't overshoot max_queue_depth through that
+        # window. ONE condition guards every sub-queue: the worker waits
+        # on work from any tenant.
         self._queue_cv = threading.Condition()
         self._applying = False
         self._worker: threading.Thread | None = None
@@ -482,8 +564,11 @@ class SnapshotServer:
         # never-durable entries (no WAL) shed with the shutdown verdict.
         with self._queue_cv:
             self._worker_stop = True
-            leftovers = list(self._queue)
-            self._queue.clear()
+            leftovers = []
+            for ts in list(self._tenants.values()):
+                leftovers.extend(ts.queue)
+                ts.queue.clear()
+            self._rr.clear()
             for p in leftovers:
                 if p.seq is not None:
                     p.status = "accepted"
@@ -495,11 +580,12 @@ class SnapshotServer:
                     p.shed_reason = "server shutting down"
             self._queue_cv.notify_all()
         for p in leftovers:
-            self.debt.abandoned()
+            ts = self._tenants[p.tenant]
+            ts.debt.abandoned()
             if p.status == "shed":
-                self.debt.shed(p.rows)
-                self.admission.record_shed(
-                    p.shed_reason, p.rows, 0, self.debt.snapshot(),
+                ts.debt.shed(p.rows)
+                ts.admission.record_shed(
+                    p.shed_reason, p.rows, 0, ts.debt.snapshot(),
                     stage="shutdown",
                 )
             p.event.set()
@@ -530,13 +616,153 @@ class SnapshotServer:
             )
             self._worker.start()
 
+    # -- default-tenant aliases -------------------------------------------
+    # The pre-tenancy single-tenant attributes now live on the default
+    # tenant's _TenantState; these properties keep every existing call
+    # site (and test) reading and writing the same objects, so a
+    # single-tenant deployment never sees the tenancy layer.
+    @property
+    def _default(self) -> _TenantState:
+        return self._tenants[DEFAULT_TENANT]
+
+    @property
+    def _engine(self) -> QueryEngine:
+        return self._tenants[DEFAULT_TENANT].engine
+
+    @_engine.setter
+    def _engine(self, value: QueryEngine) -> None:
+        self._tenants[DEFAULT_TENANT].engine = value
+
+    @property
+    def _ingestor(self):
+        return self._tenants[DEFAULT_TENANT].ingestor
+
+    @_ingestor.setter
+    def _ingestor(self, value) -> None:
+        self._tenants[DEFAULT_TENANT].ingestor = value
+
+    @property
+    def admission(self) -> AdmissionController:
+        return self._tenants[DEFAULT_TENANT].admission
+
+    @admission.setter
+    def admission(self, value: AdmissionController) -> None:
+        self._tenants[DEFAULT_TENANT].admission = value
+
+    @property
+    def debt(self) -> RepairDebt:
+        return self._tenants[DEFAULT_TENANT].debt
+
+    @debt.setter
+    def debt(self, value: RepairDebt) -> None:
+        self._tenants[DEFAULT_TENANT].debt = value
+
+    @property
+    def alerts(self) -> AlertManager:
+        return self._tenants[DEFAULT_TENANT].alerts
+
+    @alerts.setter
+    def alerts(self, value: AlertManager) -> None:
+        self._tenants[DEFAULT_TENANT].alerts = value
+
+    @property
+    def _quality_report(self):
+        return self._tenants[DEFAULT_TENANT].quality_report
+
+    @_quality_report.setter
+    def _quality_report(self, value) -> None:
+        self._tenants[DEFAULT_TENANT].quality_report = value
+
+    @property
+    def _queue(self) -> deque:
+        return self._tenants[DEFAULT_TENANT].queue
+
+    @property
+    def _reserved(self) -> int:
+        return self._tenants[DEFAULT_TENANT].reserved
+
+    @_reserved.setter
+    def _reserved(self, value: int) -> None:
+        self._tenants[DEFAULT_TENANT].reserved = value
+
+    # -- tenant plumbing ---------------------------------------------------
+    def _tenant_state(self, tenant: str, create: bool = True) -> _TenantState:
+        """The tenant's state, admitting it lazily on first touch when
+        its store namespace already holds a published snapshot. A
+        malformed id raises ``ValueError`` (HTTP 400, before any path is
+        built); a valid id with no namespace behind it raises
+        :class:`UnknownTenantError` (HTTP 404)."""
+        ts = self._tenants.get(tenant)
+        if ts is not None:
+            return ts
+        # validates the id (ValueError -> 400) before touching the disk
+        store = self.store.for_tenant(tenant)
+        if not create:
+            raise UnknownTenantError(tenant)
+        snap = store.load(sink=self.sink)
+        if snap is None:
+            raise UnknownTenantError(tenant)
+        ts = self._make_tenant_state(tenant, store, snap)
+        with self._tenants_lock:
+            ts = self._tenants.setdefault(tenant, ts)
+        self.tenancy.note(tenant)
+        self.tenancy.note_bytes(tenant, ts.engine.snapshot.nbytes)
+        return ts
+
+    def _make_tenant_state(
+        self, tenant: str, store: SnapshotStore, snap,
+    ) -> _TenantState:
+        ts = _TenantState(tenant, store)
+        sink = self._tenant_sink(tenant)
+        # registry=None on the ledger and the alert manager: per-tenant
+        # instances writing the one unlabelled gauge each would race
+        # last-writer-wins; the default tenant keeps the fleet-facing
+        # gauges, per-tenant state is served on /statusz and /alertz.
+        ts.debt = RepairDebt()
+        ts.admission = AdmissionController(
+            bounds=self.tenancy.bounds_for(tenant), sink=self.sink,
+            registry=self.registry, tenant=tenant,
+        )
+        ts.alerts = AlertManager(sink=sink, tenant=tenant)
+        ts.engine = QueryEngine(snap)
+        if self.standby_of is None:
+            # A writer's lazily-admitted namespace inherits the process
+            # fence: without this, a deposed writer could keep
+            # publishing into tenant stores the promotion never touched.
+            try:
+                store.fence_epoch(self.writer_epoch)
+            except (OSError, ValueError):
+                pass  # equal/lower epochs are already fenced
+        return ts
+
+    def _tenant_sink(self, tenant: str):
+        """The sink a tenant's ingest/alert plane emits through: the
+        real sink for the default tenant, the tagging proxy otherwise."""
+        if self.sink is None or tenant == DEFAULT_TENANT:
+            return self.sink
+        return _TenantSink(self.sink, tenant)
+
+    def engine_for(self, tenant: str) -> QueryEngine:
+        """The tenant's double-buffered engine — the read path's router.
+        Every handler binds it ONCE per request, so a concurrent swap
+        (of any tenant) never mixes two versions inside one response."""
+        if not tenant or tenant == DEFAULT_TENANT:
+            return self._engine
+        return self._tenant_state(tenant).engine
+
     # -- snapshot swap ----------------------------------------------------
     @property
     def engine(self) -> QueryEngine:
         return self._engine
 
-    def _swap(self, engine: QueryEngine) -> None:
-        self._engine = engine  # atomic ref swap: the double-buffer flip
+    def _swap(self, engine: QueryEngine, tenant: str = DEFAULT_TENANT) -> None:
+        self._tenants[tenant].engine = engine  # atomic ref: the flip
+        self.tenancy.note_bytes(tenant, engine.snapshot.nbytes)
+        if tenant != DEFAULT_TENANT:
+            # the fleet-facing gauges and the standby compaction guard
+            # track the default tenant's chain; per-tenant versions and
+            # bytes are served on /healthz + /statusz
+            return
         if self.standby_of is not None and self.wal is not None:
             # a standby that reload-followed to a newer store version
             # may release its WAL retention up to that version's floor
@@ -571,31 +797,34 @@ class SnapshotServer:
             except OSError:
                 pass  # metrics export must never take queries down
 
-    def reload(self) -> dict:
-        """Load the store's newest snapshot; swap if it is newer than the
-        one serving (another process may have published). Serialized with
-        delta ingest, and a swap drops the ingestor: its host edge/label
-        state derives from the snapshot it last published, and applying a
-        delta on top of the STALE state would silently discard the
-        externally published snapshot's edges (its next publish would
-        still chain version numbers from the store's manifest)."""
+    def reload(self, tenant: str = DEFAULT_TENANT) -> dict:
+        """Load the tenant's newest store snapshot; swap if it is newer
+        than the one serving (another process may have published).
+        Serialized with delta ingest, and a swap drops the ingestor: its
+        host edge/label state derives from the snapshot it last
+        published, and applying a delta on top of the STALE state would
+        silently discard the externally published snapshot's edges (its
+        next publish would still chain version numbers from the store's
+        manifest)."""
+        ts = self._tenant_state(tenant)
         if self.chaos_hold_version:
             # replica_stale injector: this replica never advances
             return {
-                "version": self._engine.version, "swapped": False,
+                "version": ts.engine.version, "swapped": False,
                 "held": True,
             }
         with self._delta_lock:
-            snap = self.store.load(sink=self.sink)
-            swapped = snap is not None and snap.version != self._engine.version
+            snap = ts.store.load(sink=self.sink)
+            swapped = snap is not None and snap.version != ts.engine.version
             if swapped:
-                self._swap(QueryEngine(snap))
-                self._ingestor = None
-            return {"version": self._engine.version, "swapped": swapped}
+                self._swap(QueryEngine(snap), tenant=ts.tenant)
+                ts.ingestor = None
+            return {"version": ts.engine.version, "swapped": swapped}
 
     def apply_delta(
         self, payload: dict, deadline_s: float | None = None,
         delta_id: str | None = None, ack: str | None = None,
+        tenant: str = DEFAULT_TENANT,
     ) -> dict:
         """Ingest one delta batch (the POST /delta body) through
         admission control. Returns the publish result — or, on a shed,
@@ -621,7 +850,18 @@ class SnapshotServer:
         (HTTP **202**) right after the fsync: the batch applies in the
         background, and survives a writer kill via startup replay —
         durable acknowledgements are never deadline-shed.
+
+        **Tenancy** (ISSUE 16): the batch charges ``tenant``'s ledger
+        end to end — ITS admission bounds decide the verdict against ITS
+        queue depth and debt, the batch parks on ITS sub-queue, and the
+        WAL frame carries the tenant id durably so replay and the
+        idempotency dedupe stay tenant-scoped. One tenant saturating its
+        bounds sheds only itself.
         """
+        # Resolve the tenant FIRST: an unknown tenant must 404 before
+        # any admission/WAL side effect, and a malformed id must 400.
+        ts = self._tenant_state(tenant)
+        tenant = ts.tenant
         if self.standby_of is not None:
             # A standby is not a writer: it tails the primary's WAL and
             # waits for /promote. Accepting a delta here would be the
@@ -629,7 +869,7 @@ class SnapshotServer:
             return self._shed_payload(
                 f"standby of {self.standby_of}: writes go to the primary "
                 "(or POST /promote to make this replica the writer)",
-                self.admission.bounds.retry_after_s,
+                ts.admission.bounds.retry_after_s,
             )
         if self._fenced is not None:
             # Deposed writer: a publish already refused with
@@ -642,7 +882,7 @@ class SnapshotServer:
                 f"writer fenced ({self._fenced}): a newer writer owns "
                 "the store; send writes to the promoted writer or POST "
                 "/promote here to take ownership back",
-                self.admission.bounds.retry_after_s,
+                ts.admission.bounds.retry_after_s,
             )
         if ack not in (None, "wal"):
             raise ValueError(f"unknown ack mode {ack!r} (use 'wal')")
@@ -651,23 +891,24 @@ class SnapshotServer:
                 "X-Delta-Ack: wal needs a server running with a "
                 "write-ahead log (serve --wal)"
             )
-        bound = self.admission.bounds.deadline_s
+        bound = ts.admission.bounds.deadline_s
         deadline_s = bound if deadline_s is None else max(
             0.001, min(float(deadline_s), bound)
         )
         # Fast-path dedupe: a retry of an id this WAL already holds maps
         # onto the original accept — applied or still pending, never a
-        # second apply (the duplicate-submit parity pin).
+        # second apply (the duplicate-submit parity pin). Tenant-scoped:
+        # two tenants reusing the same id are distinct batches.
         if delta_id and self.wal is not None:
-            seq = self.wal.lookup(delta_id)
+            seq = self.wal.lookup(delta_id, tenant=tenant)
             if seq is not None:
-                return self._duplicate_payload(delta_id, seq)
+                return self._duplicate_payload(delta_id, seq, tenant=tenant)
         delta = EdgeDelta.from_pairs(
             insert=payload.get("insert", ()), delete=payload.get("delete", ())
         )
         if (
             delta.insert_weight is not None
-            and self._engine.snapshot.get("weights") is None
+            and ts.engine.snapshot.get("weights") is None
         ):
             # Refuse HERE, before the batch can queue: merged into a
             # coalesced group, this splice-time error would fail every
@@ -692,21 +933,21 @@ class SnapshotServer:
                 # wait on a worker that is exiting
                 return self._shed_payload(
                     "server shutting down",
-                    self.admission.bounds.retry_after_s,
+                    ts.admission.bounds.retry_after_s,
                 )
-            debt_at_resolve = self.debt.snapshot()
-            decision = self.admission.resolve(
-                rows=rows, queue_depth=len(self._queue) + self._reserved,
+            debt_at_resolve = ts.debt.snapshot()
+            decision = ts.admission.resolve(
+                rows=rows, queue_depth=len(ts.queue) + ts.reserved,
                 debt=debt_at_resolve, applying=self._applying, emit=False,
             )
             if decision.verdict != "shed":
-                self._reserved += 1
+                ts.reserved += 1
         if decision.verdict == "shed":
-            self.admission.emit_admission(decision, debt_at_resolve)
-            self.debt.shed(rows)
-            self.admission.record_shed(
+            ts.admission.emit_admission(decision, debt_at_resolve)
+            ts.debt.shed(rows)
+            ts.admission.record_shed(
                 decision.reason, rows, decision.queue_depth,
-                self.debt.snapshot(),
+                ts.debt.snapshot(),
             )
             return self._shed_payload(decision.reason, decision.retry_after_s)
         # Durability point: the fsync'd append happens BEFORE the batch
@@ -716,24 +957,27 @@ class SnapshotServer:
         pending.delta_id = delta_id or ""
         pending.async_ack = ack == "wal"
         pending.trace = self._current_trace_header()
+        pending.tenant = tenant
         try:
             if self.wal is not None:
                 seq, dup = self.wal.append(
                     payload, delta_id=delta_id or "", deadline_s=deadline_s,
-                    trace=pending.trace,
+                    trace=pending.trace, tenant=tenant,
                 )
                 if dup:
                     # the resolve still happened — one admission record
                     # per resolve, duplicate outcome or not (the finally
                     # below releases this batch's reserved queue slot)
-                    self.admission.emit_admission(decision, debt_at_resolve)
-                    return self._duplicate_payload(delta_id or "", seq)
+                    ts.admission.emit_admission(decision, debt_at_resolve)
+                    return self._duplicate_payload(
+                        delta_id or "", seq, tenant=tenant,
+                    )
                 pending.seq = seq
                 pending.t_durable = time.monotonic()
         finally:
             enqueued = False
             with self._queue_cv:
-                self._reserved = max(0, self._reserved - 1)
+                ts.reserved = max(0, ts.reserved - 1)
                 if not self._worker_stop and (
                     pending.seq is not None or self.wal is None
                 ):
@@ -748,8 +992,10 @@ class SnapshotServer:
                         # the apply queue are pending work the ledger
                         # (and /healthz) must already see — it is
                         # exactly what the shed bound reads.
-                        self.debt.submitted(rows)
-                        self._queue.append(pending)
+                        ts.debt.submitted(rows)
+                        ts.queue.append(pending)
+                        if tenant not in self._rr:
+                            self._rr.append(tenant)
                         self._queue_cv.notify_all()
                         enqueued = True
                 elif self._worker_stop and pending.seq is not None:
@@ -761,12 +1007,12 @@ class SnapshotServer:
                         pending,
                         note="server stopping; replays on restart",
                     )
-        self.admission.emit_admission(decision, debt_at_resolve)
+        ts.admission.emit_admission(decision, debt_at_resolve)
         if not enqueued:
             if pending.status == "accepted":
                 return pending.result
             return self._shed_payload(
-                "server shutting down", self.admission.bounds.retry_after_s
+                "server shutting down", ts.admission.bounds.retry_after_s
             )
         self._ensure_worker()
         if pending.async_ack:
@@ -784,7 +1030,7 @@ class SnapshotServer:
         with self._queue_cv:
             if pending.status == "queued" and pending.deadline <= time.monotonic():
                 try:
-                    self._queue.remove(pending)
+                    ts.queue.remove(pending)
                 except ValueError:
                     pass  # the worker popped it between wait and lock
                 else:
@@ -796,11 +1042,11 @@ class SnapshotServer:
                     shed_now = True
         if shed_now:
             self._skip_walled(pending)
-            self.debt.abandoned()
-            self.debt.shed(pending.rows)
-            self.admission.record_shed(
-                pending.shed_reason, pending.rows, len(self._queue),
-                self.debt.snapshot(), stage="deadline",
+            ts.debt.abandoned()
+            ts.debt.shed(pending.rows)
+            ts.admission.record_shed(
+                pending.shed_reason, pending.rows, len(ts.queue),
+                ts.debt.snapshot(), stage="deadline",
             )
             pending.event.set()
         # Second leg: unbounded-by-deadline — once APPLYING, the apply
@@ -811,7 +1057,7 @@ class SnapshotServer:
             return pending.result
         if pending.status == "shed":
             return self._shed_payload(
-                pending.shed_reason, self.admission.bounds.retry_after_s
+                pending.shed_reason, ts.admission.bounds.retry_after_s
             )
         raise pending.error
 
@@ -836,7 +1082,9 @@ class SnapshotServer:
             out["note"] = note
         return out
 
-    def _duplicate_payload(self, delta_id: str, seq: int) -> dict:
+    def _duplicate_payload(
+        self, delta_id: str, seq: int, tenant: str = DEFAULT_TENANT,
+    ) -> dict:
         """A retried idempotency key maps onto its original accept."""
         applied = self.wal.seq_applied(seq)
         out = {
@@ -846,7 +1094,7 @@ class SnapshotServer:
             "applied": applied,
         }
         if applied:
-            out["version"] = self._engine.version
+            out["version"] = self.engine_for(tenant).version
             out["applied_version"] = self.wal.applied_version
         return out
 
@@ -879,32 +1127,50 @@ class SnapshotServer:
                 )
             except ValueError:
                 continue  # the accept path parsed it once; be defensive
+            # Route the entry back to the tenant whose frame it is — the
+            # durable tenant id is what keeps replay from applying one
+            # tenant's acknowledged rows into another's graph. A frame
+            # naming a tenant whose namespace vanished (operator rm) is
+            # skipped loudly rather than misapplied.
+            entry_tenant = e.get("tenant") or DEFAULT_TENANT
+            try:
+                ts = self._tenant_state(entry_tenant)
+            except (UnknownTenantError, ValueError):
+                self._warn(
+                    f"wal replay ({source}): seq {e.get('seq')} names "
+                    f"tenant {entry_tenant!r} with no store namespace — "
+                    "skipping (the tenant's snapshot chain is gone)"
+                )
+                continue
             rows = delta.num_inserts + delta.num_deletes
             with self._queue_cv:
                 if self._worker_stop:
                     break
-                debt_at = self.debt.snapshot()
-                decision = self.admission.resolve(
+                debt_at = ts.debt.snapshot()
+                decision = ts.admission.resolve(
                     rows=rows,
-                    queue_depth=len(self._queue) + self._reserved,
+                    queue_depth=len(ts.queue) + ts.reserved,
                     debt=debt_at, applying=self._applying, emit=False,
                     replay=True,
                 )
-                self.debt.submitted(rows)
+                ts.debt.submitted(rows)
                 p = _PendingDelta(delta, rows, math.inf, float(
-                    e.get("deadline_s") or self.admission.bounds.deadline_s
+                    e.get("deadline_s") or ts.admission.bounds.deadline_s
                 ))
                 p.seq = int(e["seq"])
                 p.delta_id = e.get("id", "")
                 p.async_ack = True
+                p.tenant = ts.tenant
                 # replayed entries keep their originating request's
                 # trace: the durable header re-adopts across the kill
                 # (or across a promotion, via the shipped copy)
                 p.trace = e.get("trace", "")
                 p.t_durable = p.t_accept
-                self._queue.append(p)
+                ts.queue.append(p)
+                if ts.tenant not in self._rr:
+                    self._rr.append(ts.tenant)
                 self._queue_cv.notify_all()
-            self.admission.emit_admission(decision, debt_at)
+            ts.admission.emit_admission(decision, debt_at)
             n += 1
         if self.sink is not None:
             self.sink.emit(
@@ -939,11 +1205,16 @@ class SnapshotServer:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._queue_cv:
-                idle = not self._queue and not self._applying
+                idle = not self._any_queued_locked() and not self._applying
             if idle:
                 return True
             time.sleep(0.02)
         return False
+
+    def _any_queued_locked(self) -> bool:
+        """Under the queue condition's lock: is ANY tenant's sub-queue
+        non-empty? (The worker's wake predicate.)"""
+        return any(ts.queue for ts in list(self._tenants.values()))
 
     def _warn(self, message: str) -> None:
         """Loud in both channels: a ``warnings.warn`` (the ann.py /
@@ -987,21 +1258,52 @@ class SnapshotServer:
         cursor would park fresh appends below the watermark."""
         if self.wal.last_seq == 0:
             return
+        # Publish-time vouchers from EVERY tenant namespace (ISSUE 16):
+        # the watermark is ONE cursor over an interleaved multi-tenant
+        # log, advanced by whichever tenant published last — so the
+        # default manifest's voucher alone can LAG a later non-default
+        # publish, and trusting it would rewind into (and double-apply)
+        # entries that tenant's snapshot already absorbed. The max
+        # voucher wins; every manifest's absorbed-above list is excluded
+        # from replay. Single-tenant stores gather exactly one voucher —
+        # the pre-tenancy behavior, byte for byte.
+        vouchers = []  # (seq, version-at-that-publish, absorbed-above)
         voucher = snap.meta.get("wal_applied_seq")
         if voucher is not None:
-            voucher = int(voucher)
-            if voucher > self.wal.applied_seq:
+            vouchers.append((
+                int(voucher), snap.version,
+                tuple(snap.meta.get("wal_applied_above") or ()),
+            ))
+        for tid in self.store.list_tenants():
+            if tid == self.store.tenant:
+                continue  # the adopted snap already vouched above
+            man = self.store.for_tenant(tid)._peek_manifest()
+            if not man:
+                continue
+            mv = man.get("wal_applied_seq")
+            if mv is None:
+                continue
+            try:
+                ver = int(man.get("version", 0))
+            except (TypeError, ValueError):
+                ver = 0
+            vouchers.append((
+                int(mv), ver, tuple(man.get("wal_applied_above") or ()),
+            ))
+        if vouchers:
+            best_seq, best_ver, _ = max(vouchers)
+            if best_seq > self.wal.applied_seq:
                 # publish landed, its wal.commit was lost to the crash:
                 # move the cursor forward so replay can't double-apply
-                self.wal.commit(voucher, snap.version)
-            elif voucher < self.wal.applied_seq:
-                self._rewind_wal(voucher, snap, context)
-            above = snap.meta.get("wal_applied_above") or ()
-            if above:
-                # entries this snapshot absorbed above the contiguous
-                # floor (published over a then-unresolved gap): exclude
-                # them from replay the same crash-safe way
-                self.wal.commit_applied(above, snap.version)
+                self.wal.commit(best_seq, best_ver)
+            elif best_seq < self.wal.applied_seq:
+                self._rewind_wal(best_seq, snap, context)
+            for _, ver, above in vouchers:
+                if above:
+                    # entries a snapshot absorbed above the contiguous
+                    # floor (published over a then-unresolved gap):
+                    # exclude them from replay the same crash-safe way
+                    self.wal.commit_applied(above, ver)
             return
         if self.wal.applied_version > snap.version:
             floor = self.wal.replay_floor(snap.version)
@@ -1102,13 +1404,24 @@ class SnapshotServer:
         # The fence is now in OUR favor: a previously-deposed writer
         # taking ownership back resumes accepting writes.
         self._fenced = None
+        # Every tenant namespace inherits the new fence and adopts its
+        # newest published snapshot — the deposed writer must lose ALL
+        # tenants at once, not just the default (a half-fenced server
+        # would split-brain per tenant). Namespaces this process never
+        # served are fenced lazily on first touch (_make_tenant_state).
         with self._delta_lock:
-            fresh = self.store.load(sink=self.sink)
-            if fresh is not None and fresh.version != self._engine.version:
-                self._swap(QueryEngine(fresh))
-            self._ingestor = None
-        if fresh is not None:
-            self._reconcile_wal_cursor(fresh, "promotion")
+            for ts in list(self._tenants.values()):
+                if ts.tenant != DEFAULT_TENANT:
+                    try:
+                        ts.store.fence_epoch(new_epoch)
+                    except (OSError, ValueError):
+                        pass  # already at/above: fence holds
+                fresh_t = ts.store.load(sink=self.sink)
+                if fresh_t is not None and fresh_t.version != ts.engine.version:
+                    self._swap(QueryEngine(fresh_t), tenant=ts.tenant)
+                ts.ingestor = None
+            fresh = self._engine.snapshot
+        self._reconcile_wal_cursor(fresh, "promotion")
         replayed = self._replay_wal(source="promotion")
         # Now the primary: local commits describe THIS store, so the
         # standby-era compaction guard lifts.
@@ -1132,26 +1445,75 @@ class SnapshotServer:
         }
 
     # -- the apply worker --------------------------------------------------
-    def _pop_group(self) -> tuple[list, list]:
-        """Under the queue lock: pop everything waiting (bounded by
-        max_queue_depth — the queue never exceeds it by construction),
-        splitting expired-deadline batches out for shedding."""
+    def _pop_group(self) -> tuple[str, list, list]:
+        """Under the queue lock: pick the next tenant by deficit
+        round-robin and pop ITS waiting batches (coalescing never
+        crosses a tenant — one publish builds on exactly one tenant's
+        store and ingestor), splitting expired-deadline batches out for
+        shedding (all tenants — a deadline is a deadline regardless of
+        whose turn it is). Returns ``(tenant, group, expired)``.
+
+        **Weighted fairness (ISSUE 16):** each tenant in the rotation
+        earns ``_fair_quantum_rows`` of deficit per visit and spends it
+        on its queued rows; leftover deficit carries to its next turn,
+        so a tenant of many small batches and a tenant of few huge ones
+        converge on the same row share. A group always carries at least
+        one batch (a batch larger than the quantum must still make
+        progress). With at most ONE tenant holding queued work the
+        quantum is infinite — the pre-tenancy pop-everything behavior,
+        coalescing counts and all."""
         group, expired = [], []
         now = time.monotonic()
-        while self._queue:
-            p = self._queue.popleft()
-            if p.status != "queued":
-                continue  # a handler-side deadline shed won the race
-            if p.deadline <= now:
-                p.status = "shed"
-                p.shed_reason = (
-                    f"deadline {p.deadline_s:g}s passed while queued"
-                )
-                expired.append(p)
-            else:
+        # list(): a lazy tenant admit can grow the dict mid-iteration
+        for ts in list(self._tenants.values()):
+            n = len(ts.queue)
+            for _ in range(n):
+                p = ts.queue.popleft()
+                if p.status != "queued":
+                    continue  # a handler-side deadline shed won the race
+                if p.deadline <= now:
+                    p.status = "shed"
+                    p.shed_reason = (
+                        f"deadline {p.deadline_s:g}s passed while queued"
+                    )
+                    expired.append(p)
+                else:
+                    ts.queue.append(p)
+        active = sum(1 for ts in self._tenants.values() if ts.queue)
+        quantum = (
+            math.inf if active <= 1 else float(self._fair_quantum_rows)
+        )
+        tenant = DEFAULT_TENANT
+        for _ in range(len(self._rr)):
+            tid = self._rr[0]
+            ts = self._tenants.get(tid)
+            if ts is None or not ts.queue:
+                # drained (or shed empty) since it joined the rotation:
+                # a fresh enqueue re-adds it with a clean balance
+                self._rr.popleft()
+                if ts is not None:
+                    ts.deficit = 0.0
+                continue
+            tenant = tid
+            ts.deficit += quantum
+            rows = 0
+            while ts.queue and (
+                not group or rows + ts.queue[0].rows <= ts.deficit
+            ):
+                p = ts.queue.popleft()
                 p.status = "applying"
                 group.append(p)
-        return group, expired
+                rows += p.rows
+            self._rr.popleft()
+            if ts.queue:
+                # unfinished backlog: spend the popped rows, keep the
+                # remainder, go to the back of the rotation
+                ts.deficit = max(0.0, ts.deficit - rows)
+                self._rr.append(tid)
+            else:
+                ts.deficit = 0.0
+            break
+        return tenant, group, expired
 
     def _apply_worker(self) -> None:
         """Drain the apply queue: one iteration = one coalesced publish.
@@ -1161,11 +1523,11 @@ class SnapshotServer:
         ``pending.event`` without a liveness caveat."""
         while True:
             with self._queue_cv:
-                while not self._queue and not self._worker_stop:
+                while not self._any_queued_locked() and not self._worker_stop:
                     self._queue_cv.wait(timeout=0.5)
-                if self._worker_stop and not self._queue:
+                if self._worker_stop and not self._any_queued_locked():
                     return
-                group, expired = self._pop_group()
+                tenant, group, expired = self._pop_group()
                 self._applying = bool(group)
             for p in expired:
                 try:
@@ -1173,12 +1535,13 @@ class SnapshotServer:
                     # disk killing the sink's JSONL write would strand
                     # every already-popped 'applying' batch on an event
                     # that nobody will ever set.
+                    pts = self._tenants[p.tenant]
                     self._skip_walled(p)
-                    self.debt.abandoned()
-                    self.debt.shed(p.rows)
-                    self.admission.record_shed(
-                        p.shed_reason, p.rows, len(self._queue),
-                        self.debt.snapshot(), stage="deadline",
+                    pts.debt.abandoned()
+                    pts.debt.shed(p.rows)
+                    pts.admission.record_shed(
+                        p.shed_reason, p.rows, len(pts.queue),
+                        pts.debt.snapshot(), stage="deadline",
                     )
                 except Exception:  # noqa: BLE001 — bookkeeping only
                     pass
@@ -1187,7 +1550,7 @@ class SnapshotServer:
             if not group:
                 continue
             try:
-                result = self._apply_group(group)
+                result = self._apply_group(tenant, group)
                 for p in group:
                     p.status, p.result = "done", result
             except BaseException as e:  # resolve, then keep serving
@@ -1209,11 +1572,11 @@ class SnapshotServer:
                 for p in group:
                     p.event.set()
 
-    def _apply_group(self, group: list) -> dict:
-        """Apply one popped group as a single publish: validate each
-        batch, coalesce when more than one waited, re-resolve the LOF
-        rung at apply time (pressure may have moved while they sat
-        queued), swap the fresh engine in.
+    def _apply_group(self, tenant: str, group: list) -> dict:
+        """Apply one popped group — all batches of ONE tenant — as a
+        single publish: validate each batch, coalesce when more than one
+        waited, re-resolve the LOF rung at apply time (pressure may have
+        moved while they sat queued), swap the tenant's fresh engine in.
 
         REBASE GUARD (the /reload-vs-inflight-delta contract, pinned
         under the fleet prober's reload cadence in tests/test_fleet.py):
@@ -1235,6 +1598,7 @@ class SnapshotServer:
         in its OWN trace — so a coalesced group's non-leader batches
         still stitch end-to-end."""
         t_apply_start = time.monotonic()
+        ts = self._tenants[tenant]
         leader_ctx = None
         if self.sink is not None:
             for p in group:
@@ -1250,12 +1614,12 @@ class SnapshotServer:
             else contextlib.nullcontext()
         )
         with span, self._delta_lock:
-            newest = self.store.peek_version()
-            if newest is not None and newest != self._engine.version:
-                fresh = self.store.load(sink=self.sink)
-                if fresh is not None and fresh.version != self._engine.version:
-                    self._swap(QueryEngine(fresh))
-                    self._ingestor = None
+            newest = ts.store.peek_version()
+            if newest is not None and newest != ts.engine.version:
+                fresh = ts.store.load(sink=self.sink)
+                if fresh is not None and fresh.version != ts.engine.version:
+                    self._swap(QueryEngine(fresh), tenant=tenant)
+                    ts.ingestor = None
             # Applies settle the ledger inside apply(); the worker is the
             # only applier, so an unchanged applies_total at a raise
             # means THIS group never settled — drop its pending entries.
@@ -1265,16 +1629,16 @@ class SnapshotServer:
             # after settling — or a failing engine build on the
             # already-published snapshot — must NOT drain entries
             # belonging to batches queued behind us.)
-            settled_before = self.debt.applies_total
+            settled_before = ts.debt.applies_total
             try:
-                if self._ingestor is None:
-                    self._ingestor = DeltaIngestor(
-                        self.store, sink=self.sink,
+                if ts.ingestor is None:
+                    ts.ingestor = DeltaIngestor(
+                        ts.store, sink=self._tenant_sink(tenant),
                         num_shards=self.num_shards,
-                        snapshot=self._engine.snapshot, debt=self.debt,
+                        snapshot=ts.engine.snapshot, debt=ts.debt,
                         epoch=self.writer_epoch,
                     )
-                ing = self._ingestor
+                ing = ts.ingestor
                 if len(group) > 1:
                     cleans, quarantined = [], 0
                     # Validate each batch against the vertex space AS
@@ -1297,10 +1661,10 @@ class SnapshotServer:
                             )
                     merged, info = coalesce_deltas(cleans, ing.src, ing.dst)
                     info["quarantined_rows"] = quarantined
-                    self.admission.record_coalesce(info, self.debt.snapshot())
+                    ts.admission.record_coalesce(info, ts.debt.snapshot())
                 else:
                     merged = group[0].delta
-                lof_mode = self.admission.lof_mode(self.debt.snapshot())
+                lof_mode = ts.admission.lof_mode(ts.debt.snapshot())
                 # The manifest voucher must survive a crash between
                 # this publish and the wal.commit below (restart replay
                 # of absorbed entries = double apply). It CANNOT be the
@@ -1326,15 +1690,15 @@ class SnapshotServer:
                     extra_meta=extra,
                 )
             except BaseException:
-                if self.debt.applies_total == settled_before:
+                if ts.debt.applies_total == settled_before:
                     for _ in group:
-                        self.debt.abandoned()
+                        ts.debt.abandoned()
                 raise
-            self._swap(QueryEngine(snap))
+            self._swap(QueryEngine(snap), tenant=tenant)
             # Adopt the ingestor's quality pass (drift + canary) for
             # /statusz, /alertz and the alert rules — the served engine
             # and the report now describe the same version.
-            self._quality_report = ing.last_quality
+            ts.quality_report = ing.last_quality
             if self.wal is not None and seqs:
                 # Compaction keyed to the published snapshot version:
                 # the durable watermark says "everything up to this seq
@@ -1599,9 +1963,26 @@ class SnapshotServer:
         that decide the shed verdict."""
         eng = self._engine
         debt = self.debt.snapshot()
+        tenants = list(self._tenants.values())
         with self._queue_cv:
-            depth = len(self._queue)
-        overloaded, why = self.admission.overloaded(depth, debt)
+            depths = {ts.tenant: len(ts.queue) for ts in tenants}
+        depth = sum(depths.values())
+        overloaded, why = self.admission.overloaded(
+            depths.get(DEFAULT_TENANT, 0), debt
+        )
+        if not overloaded:
+            # any tenant saturating ITS OWN bounds flips the fleet-level
+            # drain signal (the replica is a shared process), with the
+            # culprit named — the per-tenant sections say who
+            for ts in tenants:
+                if ts.tenant == DEFAULT_TENANT:
+                    continue
+                over_t, why_t = ts.admission.overloaded(
+                    depths.get(ts.tenant, 0), ts.debt.snapshot()
+                )
+                if over_t:
+                    overloaded, why = True, f"tenant {ts.tenant}: {why_t}"
+                    break
         ready, not_ready_why = self._ready(eng)
         # The prober cadence IS the alert-evaluation cadence (ISSUE 13):
         # the fleet prober polls /healthz, so firing→resolved transitions
@@ -1609,7 +1990,7 @@ class SnapshotServer:
         self.evaluate_alerts()
         out = {
             "ok": True,
-            "alerts_firing": len(self.alerts.firing()),
+            "alerts_firing": sum(len(ts.alerts.firing()) for ts in tenants),
             "ready": ready,
             "draining": self._draining,
             "version": eng.version,
@@ -1622,6 +2003,18 @@ class SnapshotServer:
             "delta_queue_depth": depth,
             "lof_stale": eng.lof_stale,
             "writer_epoch": self.writer_epoch,
+            # Tenancy (ISSUE 16): count + per-tenant snapshot age and
+            # version maps. The fleet router's rolling reload reads
+            # tenant_versions to call a replica caught up only when it
+            # is caught up on EVERY tenant, and serve_cli --tenant
+            # health checks read tenant_snapshot_age_s.
+            "tenants": len(tenants),
+            "tenant_snapshot_age_s": {
+                ts.tenant: self._snapshot_age_s(ts.engine) for ts in tenants
+            },
+            "tenant_versions": {
+                ts.tenant: ts.engine.version for ts in tenants
+            },
         }
         if self._fenced is not None:
             # deposed writer: reads serve, writes refuse 503 — the
@@ -1671,14 +2064,16 @@ class SnapshotServer:
         return out
 
     # -- result quality & alerts ------------------------------------------
-    def quality_payload(self) -> dict:
+    def quality_payload(self, tenant: str = DEFAULT_TENANT) -> dict:
         """The "quality" section /statusz and /alertz serve: the
         writer's last full pass (state + drift + canary) when it is
         still the served version, else the engine's own lazily-built
         state — a replica that only reloads still exposes its sketches
-        for the router's fleet merge."""
-        eng = self._engine
-        rep = self._quality_report
+        for the router's fleet merge. Tenant-scoped: each tenant's
+        sketches and canary describe ITS graph only."""
+        ts = self._tenant_state(tenant)
+        eng = ts.engine
+        rep = ts.quality_report
         if rep is not None and rep.state.version == eng.version:
             return rep.payload()
         if not self.quality_enabled:
@@ -1686,29 +2081,39 @@ class SnapshotServer:
         from graphmine_tpu.obs.quality import export_gauges
 
         state = eng.quality_state()
-        export_gauges(self.registry, state)
+        if tenant == DEFAULT_TENANT:
+            # unlabelled quality gauges track the default tenant only
+            # (the per-tenant race rule — see _make_tenant_state)
+            export_gauges(self.registry, state)
         return {"state": state.payload()}
 
-    def _alert_values(self) -> dict:
+    def _alert_values(self, tenant: str = DEFAULT_TENANT) -> dict:
         """The flat metric dict the alert rules evaluate over: quality
         numbers from the freshest source plus the serving-side gauges
-        the default ingest-lag rule reads."""
-        debt = self.debt.snapshot()
-        eng = self._engine
+        the default ingest-lag rule reads. Per tenant — a canary
+        regression in tenant A's graph must page naming A and never
+        trip B's rules."""
+        ts = self._tenant_state(tenant)
+        debt = ts.debt.snapshot()
+        eng = ts.engine
         values = {
             "ingest_lag_s": debt["ingest_lag_s"],
             "repair_debt_rows": debt["pending_rows"],
             "snapshot_age_s": self._snapshot_age_s(eng),
         }
-        # Memory headroom rides the same evaluation (ISSUE 14): the
-        # prober's /healthz cadence drives the low-headroom rule
-        # fleet-wide, and the read refreshes the graphmine_memory_*
-        # gauges as a side effect. Metric absent when no budget is
-        # resolvable — the rule then simply never fires.
-        headroom = self.memory_payload().get("headroom_frac")
-        if headroom is not None:
-            values["memory_headroom_frac"] = headroom
-        rep = self._quality_report
+        if tenant == DEFAULT_TENANT:
+            # Memory headroom rides the same evaluation (ISSUE 14): the
+            # prober's /healthz cadence drives the low-headroom rule
+            # fleet-wide, and the read refreshes the graphmine_memory_*
+            # gauges as a side effect. Metric absent when no budget is
+            # resolvable — the rule then simply never fires. The budget
+            # (and RSS) is the PROCESS's, so only the default tenant's
+            # rule set carries it — one page per replica, not one per
+            # tenant.
+            headroom = self.memory_payload().get("headroom_frac")
+            if headroom is not None:
+                values["memory_headroom_frac"] = headroom
+        rep = ts.quality_report
         if rep is not None and rep.state.version == eng.version:
             values.update(rep.values())
         elif self.quality_enabled:
@@ -1724,24 +2129,33 @@ class SnapshotServer:
         return values
 
     def evaluate_alerts(self) -> list:
-        """One alert-rule evaluation pass; returns the transitions.
-        Never raises into a caller — /healthz answering 500 because a
-        quality pass hiccuped would fail the prober over telemetry."""
-        try:
-            return self.alerts.evaluate(self._alert_values())
-        except Exception:  # noqa: BLE001 — alerting must not break serving
-            return []
+        """One alert-rule evaluation pass over EVERY tenant's rule set;
+        returns the transitions. Never raises into a caller — /healthz
+        answering 500 because a quality pass hiccuped would fail the
+        prober over telemetry."""
+        out = []
+        for ts in list(self._tenants.values()):
+            try:
+                out.extend(ts.alerts.evaluate(self._alert_values(ts.tenant)))
+            except Exception:  # noqa: BLE001 — alerting must not break serving
+                pass
+        return out
 
-    def alertz(self) -> dict:
+    def alertz(self, tenant: str = DEFAULT_TENANT) -> dict:
         """The ``/alertz`` body: alert level state + the quality section
         (evaluated at read time, so a drained-and-idle server still
-        transitions rules whose conditions cleared)."""
+        transitions rules whose conditions cleared). ``?tenant=`` or
+        ``X-Tenant-Id`` scopes the page to that tenant's rule set."""
         self.evaluate_alerts()
-        return {
-            "version": self._engine.version,
-            **self.alerts.snapshot(),
-            "quality": self.quality_payload(),
+        ts = self._tenant_state(tenant)
+        out = {
+            "version": ts.engine.version,
+            **ts.alerts.snapshot(),
+            "quality": self.quality_payload(tenant),
         }
+        if tenant != DEFAULT_TENANT:
+            out["tenant"] = tenant
+        return out
 
     def endpoint_latency(self) -> dict:
         """Per-endpoint latency/error summary from the request histogram
@@ -1774,10 +2188,12 @@ class SnapshotServer:
         ``slo_rollup`` record per read, so the offline JSONL carries
         periodic rollup checkpoints a scrape-less run can still plot."""
         eng = self._engine
+        tenants = list(self._tenants.values())
         with self._req_lock:
             inflight = self._inflight
         with self._queue_cv:
-            depth, applying = len(self._queue), self._applying
+            depths = {ts.tenant: len(ts.queue) for ts in tenants}
+            depth, applying = sum(depths.values()), self._applying
         payload = {
             "version": eng.version,
             "snapshot_id": eng.snapshot.snapshot_id,
@@ -1804,6 +2220,25 @@ class SnapshotServer:
             # vs WAL byte accounting — the serve-side mirror of the
             # driver's memory_watermark records
             "memory": self.memory_payload(),
+            # tenancy (ISSUE 16): registry view (known tenants +
+            # overrides), the packing-oracle memory map (per-tenant
+            # snapshot bytes vs the ONE fleet-wide budget), and each
+            # tenant's own admission/queue/debt section — the page that
+            # names the noisy neighbor
+            "tenancy": {
+                **self.tenancy.snapshot(),
+                "memory": self.tenancy.memory_payload(self._mem_budget),
+                "fair_quantum_rows": self._fair_quantum_rows,
+                "per_tenant": {
+                    ts.tenant: {
+                        **ts.admission.snapshot(),
+                        "queue_depth": depths.get(ts.tenant, 0),
+                        "repair_debt": ts.debt.snapshot(),
+                        "version": ts.engine.version,
+                    }
+                    for ts in tenants
+                },
+            },
         }
         if self.wal is not None:
             payload["wal"] = self.wal.snapshot()
@@ -1846,7 +2281,7 @@ class SnapshotServer:
 
     def request_finished(
         self, method: str, endpoint: str, status: int, seconds: float,
-        request_id: str, body: bytes = b"",
+        request_id: str, body: bytes = b"", tenant: str = "",
     ) -> None:
         """The middleware tail: histogram observe + counters +
         ``access_log`` record. Runs on every request, including errored
@@ -1883,6 +2318,10 @@ class SnapshotServer:
             "seconds": round(seconds, 6),
             "request_id": request_id,
         }
+        if tenant and tenant != DEFAULT_TENANT:
+            # explicit non-default routing only: pre-tenancy access_log
+            # consumers keep seeing exactly the records they always did
+            kv["tenant"] = tenant
         if seconds >= self.slow_request_s:
             # Identify the offending payload without logging it: the
             # digest joins a client-side replay to this exact request.
@@ -1983,6 +2422,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._request_id = rid
         self._status = 500
         self._raw_body = b""
+        self._tenant = ""
+        self._tenant_explicit = False
         self.srv.request_started()
         chaos = self.srv.chaos_delay_s
         if chaos > 0:
@@ -2009,10 +2450,33 @@ class _Handler(BaseHTTPRequestHandler):
                     self._error(404, f"unknown path {url.path!r}")
                 else:
                     getattr(self, handler)(url)
+            except UnknownTenantError:
+                # valid-but-unknown tenant id: 404, with the SAME body a
+                # wrong-tenant vertex miss gets below — the existence of
+                # other tenants' data must not be probeable from status
+                # or message differences (malformed ids stay 400 via
+                # the ValueError arm).
+                try:
+                    self._error(404, "not found")
+                except OSError:
+                    self._status = 499
             except (KeyError, ValueError, IndexError) as e:
+                code = 400
+                if self._tenant_explicit and isinstance(
+                    e, (KeyError, IndexError)
+                ):
+                    # Explicitly tenant-routed lookup miss (a vertex id
+                    # that exists in another tenant's graph, or in
+                    # none): 404 "not found", indistinguishable from an
+                    # unknown tenant. Bad input (ValueError) keeps 400.
+                    code = 404
                 try:
                     # KeyError.__str__ repr-quotes its message; unwrap it
-                    self._error(400, str(e.args[0]) if e.args else str(e))
+                    msg = (
+                        "not found" if code == 404
+                        else (str(e.args[0]) if e.args else str(e))
+                    )
+                    self._error(code, msg)
                 except OSError:
                     self._status = 499  # socket died while sending the 400
             except OSError:
@@ -2026,6 +2490,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self.srv.request_finished(
                     method, endpoint, self._status,
                     time.perf_counter() - t0, rid, body=self._raw_body,
+                    tenant=self._tenant,
                 )
 
     def do_GET(self) -> None:  # noqa: N802
@@ -2035,9 +2500,25 @@ class _Handler(BaseHTTPRequestHandler):
         self._serve("POST", _POST_ROUTES)
 
     # -- GET routes --------------------------------------------------------
-    # Handlers that read result state bind `eng = self.srv.engine` ONCE:
-    # a concurrent snapshot swap must not mix two versions inside one
+    # Handlers that read result state bind `eng = ...` ONCE: a
+    # concurrent snapshot swap must not mix two versions inside one
     # response.
+
+    def _tenant_of(self, url) -> str:
+        """The request's tenant routing: ``X-Tenant-Id`` header first
+        (what the fleet router forwards), ``?tenant=`` as the curl-able
+        fallback. Absent = the default tenant — the pre-tenancy
+        contract. The raw value is NOT validated here: the server's
+        tenant resolution 400s malformed ids and 404s unknown ones."""
+        raw = self.headers.get("X-Tenant-Id", "").strip()
+        if not raw:
+            vals = parse_qs(url.query).get("tenant")
+            raw = vals[0].strip() if vals else ""
+        if raw:
+            self._tenant_explicit = True
+            self._tenant = raw
+            return raw
+        return DEFAULT_TENANT
 
     def _pin_ok(self, eng) -> bool:
         """The fleet router's consistency pin: an ``X-Serve-Version``
@@ -2076,13 +2557,13 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
     def _ep_snapshot(self, url) -> None:
-        eng = self.srv.engine
+        eng = self.srv.engine_for(self._tenant_of(url))
         if not self._pin_ok(eng):
             return
         self._reply(200, eng.snapshot.meta)
 
     def _ep_vertex(self, url) -> None:
-        eng = self.srv.engine
+        eng = self.srv.engine_for(self._tenant_of(url))
         if not self._pin_ok(eng):
             return
         t0 = time.perf_counter()
@@ -2092,7 +2573,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(200, row)
 
     def _ep_explain(self, url) -> None:
-        eng = self.srv.engine
+        eng = self.srv.engine_for(self._tenant_of(url))
         if not self._pin_ok(eng):
             return
         t0 = time.perf_counter()
@@ -2105,10 +2586,10 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(200, row)
 
     def _ep_alertz(self, url) -> None:
-        self._reply(200, self.srv.alertz())
+        self._reply(200, self.srv.alertz(self._tenant_of(url)))
 
     def _ep_neighbors(self, url) -> None:
-        eng = self.srv.engine
+        eng = self.srv.engine_for(self._tenant_of(url))
         if not self._pin_ok(eng):
             return
         t0 = time.perf_counter()
@@ -2118,7 +2599,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(200, {"vertex": v, "neighbors": nbrs})
 
     def _ep_topk(self, url) -> None:
-        eng = self.srv.engine
+        eng = self.srv.engine_for(self._tenant_of(url))
         if not self._pin_ok(eng):
             return
         t0 = time.perf_counter()
@@ -2134,7 +2615,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- POST routes -------------------------------------------------------
     def _ep_query(self, url) -> None:
-        eng = self.srv.engine
+        eng = self.srv.engine_for(self._tenant_of(url))
         if not self._pin_ok(eng):
             return
         t0 = time.perf_counter()
@@ -2177,10 +2658,11 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
         ack = raw_ack or None
+        tenant = self._tenant_of(url)
         try:
             out = self.srv.apply_delta(
                 self._body(), deadline_s=deadline_s,
-                delta_id=delta_id or None, ack=ack,
+                delta_id=delta_id or None, ack=ack, tenant=tenant,
             )
         except PublishFencedError as e:
             # The FIRST fenced sync publish surfaces here (the worker
@@ -2233,7 +2715,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(status, payload)
 
     def _ep_reload(self, url) -> None:
-        self._reply(200, self.srv.reload())
+        self._reply(200, self.srv.reload(self._tenant_of(url)))
 
     def _ep_drain(self, url) -> None:
         self._reply(200, self.srv.drain())

@@ -51,6 +51,11 @@ except ImportError:  # non-POSIX: single-process stores only
 import numpy as np
 
 from graphmine_tpu.pipeline import resilience
+from graphmine_tpu.serve.tenancy import (
+    DEFAULT_TENANT,
+    TENANT_RE,
+    validate_tenant_id,
+)
 from graphmine_tpu.pipeline.checkpoint import (
     CheckpointCorruptionError,
     FingerprintMismatch,
@@ -65,6 +70,7 @@ from graphmine_tpu.pipeline.checkpoint import (
 
 MANIFEST_NAME = "manifest.json"
 EPOCH_NAME = "EPOCH"
+TENANTS_DIRNAME = "tenants"
 _FORMAT_VERSION = 1
 
 
@@ -142,10 +148,57 @@ class SnapshotStore:
     ``load`` returns the newest intact generation. One publisher per root
     is the concurrency contract (same as the checkpoint generation
     rotation); any number of concurrent readers may load.
+
+    **Tenant namespace** (ISSUE 16): a store optionally belongs to one
+    tenant. The default tenant lives at the bare ``root`` — byte-for-byte
+    the pre-tenancy layout, so every existing deployment IS a default-
+    tenant store — while tenant ``t`` lives at ``<root>/tenants/<t>/``
+    with its own version chain, ``.prev`` rotation, ``EPOCH`` fence,
+    fence lock, canary arrays and ``lof_centers``: complete blast-radius
+    isolation at the filesystem layer (one tenant's corrupt generation
+    rolls back alone; one tenant's fence fences only its own writer).
+    Tenant ids are validated before any path is built.
     """
 
-    def __init__(self, root: str):
-        self.root = root
+    def __init__(self, root: str, tenant: str = DEFAULT_TENANT):
+        self.base_root = root
+        self.tenant = validate_tenant_id(tenant)
+        if self.tenant == DEFAULT_TENANT:
+            self.root = root
+        else:
+            self.root = os.path.join(root, TENANTS_DIRNAME, self.tenant)
+
+    # -- tenancy -----------------------------------------------------------
+    def for_tenant(self, tenant: str) -> SnapshotStore:
+        """The sibling store for ``tenant`` under the same base root
+        (``self`` when already that tenant's store). Hostile ids raise
+        ``ValueError`` here, before any filesystem path exists."""
+        tenant = validate_tenant_id(tenant)
+        if tenant == self.tenant:
+            return self
+        return SnapshotStore(self.base_root, tenant=tenant)
+
+    def list_tenants(self) -> list[str]:
+        """Every tenant with a store directory under this base root:
+        the default tenant whenever the bare root has published (or is
+        an empty-but-created store), plus each valid id under
+        ``tenants/``. Non-conforming directory names are ignored rather
+        than surfaced — they cannot have been created through this API."""
+        out = []
+        base = SnapshotStore(self.base_root)
+        if base._peek_manifest() is not None:
+            out.append(DEFAULT_TENANT)
+        tdir = os.path.join(self.base_root, TENANTS_DIRNAME)
+        try:
+            names = sorted(os.listdir(tdir))
+        except OSError:
+            names = []
+        for name in names:
+            if TENANT_RE.fullmatch(name) and os.path.isdir(
+                os.path.join(tdir, name)
+            ):
+                out.append(name)
+        return out
 
     # -- paths ------------------------------------------------------------
     def _gen(self) -> str:

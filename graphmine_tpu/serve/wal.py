@@ -68,6 +68,7 @@ import time
 from urllib import request as urlrequest
 
 from graphmine_tpu.pipeline.checkpoint import _fsync_dir, _fsync_file
+from graphmine_tpu.serve.tenancy import DEFAULT_TENANT
 
 # Segment framing. Each segment starts with the magic; each record is
 #   <8-byte seq little-endian> <4-byte payload length> <32-byte sha256> <payload>
@@ -176,6 +177,14 @@ class WriteAheadLog:
          "payload": {...the POST /delta body...},
          "deadline_s": float | None, "t": epoch-seconds}
 
+    Entries for a non-default tenant additionally carry ``"tenant"``
+    (ISSUE 16): the tenant id rides the durable frame so standby replay
+    routes each entry back to ITS tenant's apply queue, and idempotency
+    dedupe is scoped ``(tenant, delta_id)`` — two tenants may reuse the
+    same client-side id without colliding, and a retry can never be
+    answered with another tenant's seq. An absent key is the default
+    tenant (every pre-tenancy frame), so existing logs replay unchanged.
+
     ``skip`` entries are tombstones: a WAL-durable batch that was shed
     off the queue (deadline expiry) before applying — replay excludes
     the skipped seq, and the shed entry's id leaves the dedupe map so
@@ -224,7 +233,9 @@ class WriteAheadLog:
         # (applied_seq, snapshot_version) pairs, ascending by seq — the
         # version→cursor map replay_floor answers from.
         self._history: list[tuple[int, int]] = []
-        self._ids: dict[str, int] = {}   # delta_id -> seq (process lifetime)
+        # (tenant, delta_id) -> seq (process lifetime): the idempotency
+        # map is tenant-scoped so ids never collide across tenants
+        self._ids: dict[tuple[str, str], int] = {}
         self._skipped: set[int] = set()
         # The watermark is a CONTIGUOUS floor: every seq at or below it
         # is resolved (published, or a tombstone). Concurrent accepts
@@ -355,11 +366,12 @@ class WriteAheadLog:
             # must re-accept as a fresh entry — answering "duplicate"
             # against a tombstoned seq would swallow the very retry the
             # server asked for (silent acknowledged loss)
-            for did, s in list(self._ids.items()):
+            for key, s in list(self._ids.items()):
                 if s == skipped:
-                    del self._ids[did]
+                    del self._ids[key]
         elif entry.get("id"):
-            self._ids.setdefault(entry["id"], seq)
+            tenant = entry.get("tenant") or DEFAULT_TENANT
+            self._ids.setdefault((tenant, entry["id"]), seq)
 
     # -- append ------------------------------------------------------------
     def _open_active(self) -> None:
@@ -392,6 +404,7 @@ class WriteAheadLog:
         seq: int | None = None,
         t: float | None = None,
         trace: str = "",
+        tenant: str = DEFAULT_TENANT,
     ) -> tuple[int, bool]:
         """Durably append one accepted delta batch; returns
         ``(seq, duplicate)``.
@@ -413,13 +426,17 @@ class WriteAheadLog:
         the durable entry so the trace survives fsync → ship → standby
         replay, and a promoted writer's apply of a shipped entry still
         lands in the ORIGINATING request's trace.
+
+        ``tenant``: the owning tenant (ISSUE 16) — durable in the frame
+        for non-default tenants, and the dedupe scope for ``delta_id``.
         """
         t0 = time.perf_counter()
         with self._lock:
             if seq is not None and int(seq) <= self._last_seq:
                 return int(seq), True   # shipped retry: already copied
-            if seq is None and delta_id and delta_id in self._ids:
-                return self._ids[delta_id], True
+            dedupe_key = (tenant or DEFAULT_TENANT, delta_id)
+            if seq is None and delta_id and dedupe_key in self._ids:
+                return self._ids[dedupe_key], True
             use_seq = int(seq) if seq is not None else self._last_seq + 1
             entry = {
                 "seq": use_seq,
@@ -431,6 +448,8 @@ class WriteAheadLog:
             }
             if trace:
                 entry["trace"] = trace
+            if tenant and tenant != DEFAULT_TENANT:
+                entry["tenant"] = tenant
             written = self._write_locked(entry)
             self._index(entry)
             self._refresh_snap_locked()
@@ -867,14 +886,17 @@ class WriteAheadLog:
                 seq=int(entry["seq"]),
                 t=entry.get("t"),
                 trace=entry.get("trace", ""),
+                tenant=entry.get("tenant") or DEFAULT_TENANT,
             )
             if not dup:
                 copied += 1
         return copied
 
-    def lookup(self, delta_id: str) -> int | None:
+    def lookup(
+        self, delta_id: str, tenant: str = DEFAULT_TENANT,
+    ) -> int | None:
         with self._lock:
-            return self._ids.get(delta_id)
+            return self._ids.get((tenant or DEFAULT_TENANT, delta_id))
 
     # The seq properties and snapshot() are deliberately LOCK-FREE:
     # append() holds the log's lock across its fsyncs, and /healthz (the

@@ -253,6 +253,49 @@ def delta_burst(
     return payloads
 
 
+def noisy_neighbor_burst(
+    tenant: str,
+    num_vertices: int,
+    batches: int,
+    rows_per_batch: int,
+    seed: int = 0,
+    stall_s: float = 0.0,
+):
+    """The multi-tenant abuse kit (ISSUE 16): one tenant hammering a
+    shared server while its co-tenants must stay within SLO. Returns
+    ``(payloads, staller)``:
+
+    - ``payloads``: a :func:`delta_burst` aimed at ``tenant`` (POST each
+      with ``X-Tenant-Id: <tenant>``);
+    - ``staller``: a ``delta_repair``-seam injector that stalls
+      ``stall_s`` **only when the apply belongs to** ``tenant`` — the
+      ctx's ``tenant`` key, threaded from the ingestor's store — so the
+      abusive tenant's applies become expensive while B's and C's stay
+      fast. ``None`` when ``stall_s`` is 0 (pure volume abuse).
+
+    Install the staller with ``repeat=`` covering the burst; the
+    acceptance test (tests/test_tenancy.py) asserts from live endpoints
+    that the victims' reads hold p99, their deltas keep publishing with
+    zero sheds charged to the abuser's debt, and only the abuser's
+    alert plane fires."""
+    payloads = delta_burst(
+        num_vertices, batches, rows_per_batch, seed=seed,
+    )
+
+    staller = None
+    if stall_s > 0:
+
+        def _tenant_stall(**ctx):
+            if ctx.get("tenant") == tenant:
+                _parked_sleep(stall_s)
+            return None
+
+        _tenant_stall.wants_ctx = True
+        _tenant_stall.is_slow_repair = True
+        staller = _tenant_stall
+    return payloads, staller
+
+
 def slow_client_post(
     host: str,
     port: int,

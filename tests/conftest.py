@@ -183,6 +183,16 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "tenancy: multi-tenant serving suite (tests/test_tenancy.py: "
+        "namespaced snapshot store round-trip, hostile tenant-id "
+        "refusal, per-tenant admission bounds + weighted-fair apply, "
+        "tenant-scoped WAL replay/dedupe, per-tenant alert planes and "
+        "the noisy-neighbor chaos acceptance); runs in the default CPU "
+        "pass — select with -m tenancy or tools/run_tier1.sh "
+        "--tenancy-only",
+    )
+    config.addinivalue_line(
+        "markers",
         "slo: serving-SLO observability suite (tests/test_slo.py: "
         "bucket histograms + merge associativity, live /metrics and "
         "/statusz under the query hammer, quantile agreement vs the "

@@ -1,0 +1,572 @@
+"""Multi-tenant serving suite (marker ``tenancy``): the ISSUE 16
+isolation contract — tools/run_tier1.sh --tenancy-only.
+
+The acceptance pins:
+- the snapshot store namespaces tenants under ``<root>/tenants/<id>/``
+  with the default tenant on the bare root (full back-compat), hostile
+  ids refused before any path exists;
+- each tenant gets its own admission ladder (``GRAPHMINE_TENANT_BOUNDS``
+  / ``set_overrides``) and the apply worker dequeues weighted-fair by
+  deficit round-robin — one tenant's backlog cannot starve another's;
+- WAL frames carry the tenant id durably: replay and the idempotency
+  dedupe are tenant-scoped (the same ``delta_id`` under two tenants is
+  two applies);
+- every read/alert endpoint routes by ``X-Tenant-Id`` / ``?tenant=``; a
+  valid vertex under the wrong tenant 404s exactly like an unknown
+  tenant (no namespace-existence oracle);
+- the noisy-neighbor chaos tier: with tenant A abusing a live 3-tenant
+  server (``faults.noisy_neighbor_burst``), B's and C's reads hold p99,
+  their deltas keep flowing with zero sheds, zero cross-tenant reads
+  leak, and only A's alert plane fires.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from graphmine_tpu.graph.container import build_graph
+from graphmine_tpu.obs.schema import validate_records
+from graphmine_tpu.obs.spans import Tracer
+from graphmine_tpu.pipeline.checkpoint import graph_fingerprint
+from graphmine_tpu.pipeline.metrics import MetricsSink
+from graphmine_tpu.serve import SnapshotStore
+from graphmine_tpu.serve.delta import EdgeDelta, cold_recompute
+from graphmine_tpu.serve.server import SnapshotServer, _PendingDelta
+from graphmine_tpu.serve.tenancy import (
+    DEFAULT_TENANT,
+    TenantRegistry,
+    UnknownTenantError,
+    validate_tenant_id,
+)
+from graphmine_tpu.testing import faults
+
+pytestmark = pytest.mark.tenancy
+
+
+# ---- fixtures -------------------------------------------------------------
+
+
+def _clique(lo, hi):
+    ids = np.arange(lo, hi)
+    s, d = np.meshgrid(ids, ids)
+    m = s.ravel() < d.ravel()
+    return s.ravel()[m], d.ravel()[m]
+
+
+def _cliques(spans):
+    """Disjoint cliques over ``spans`` — per-tenant graphs of different
+    shapes, so a cross-namespace read is detectable (degree and vertex
+    range differ, not just labels)."""
+    parts = [_clique(lo, hi) for lo, hi in spans]
+    src = np.concatenate([p[0] for p in parts]).astype(np.int32)
+    dst = np.concatenate([p[1] for p in parts]).astype(np.int32)
+    return src, dst, max(hi for _, hi in spans)
+
+
+def _sink():
+    return MetricsSink(tracer=Tracer())
+
+
+def _publish(store, src, dst, v, sink=None):
+    g = build_graph(src, dst, num_vertices=v)
+    labels, cc, _ = cold_recompute(g)
+    store.publish(
+        {
+            "src": src, "dst": dst, "labels": labels, "cc_labels": cc,
+            # all below the 1.5 anomaly threshold: a healthy tenant's
+            # quality rules must stay quiet unless a test trips them
+            "lof": np.linspace(0.5, 1.2, v).astype(np.float32),
+        },
+        fingerprint=graph_fingerprint(src, dst),
+        sink=sink,
+    )
+    return store
+
+
+def _three_tenant_root(tmp_path, sink=None):
+    """Bare-root default plus tenants ``ta`` (30 vertices, two cliques
+    of 15) and ``tb`` (20 vertices, two cliques of 10)."""
+    src, dst, v = _cliques([(0, 12), (12, 26), (26, 40)])
+    store = SnapshotStore(str(tmp_path / "snap"))
+    _publish(store, src, dst, v, sink=sink)
+    sa, da, va = _cliques([(0, 15), (15, 30)])
+    _publish(store.for_tenant("ta"), sa, da, va, sink=sink)
+    sb, db, vb = _cliques([(0, 10), (10, 20)])
+    _publish(store.for_tenant("tb"), sb, db, vb, sink=sink)
+    return store
+
+
+def _get(host, port, path, headers=None):
+    req = urllib.request.Request(
+        f"http://{host}:{port}{path}", headers=headers or {}
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _post(host, port, path, payload, headers=None):
+    req = urllib.request.Request(
+        f"http://{host}:{port}{path}", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+# ---- namespaced snapshot store --------------------------------------------
+
+
+def test_store_namespace_roundtrip(tmp_path):
+    """Per-tenant stores live under ``<root>/tenants/<id>/``, the
+    default tenant on the bare root; version chains are independent."""
+    src, dst, v = _cliques([(0, 12), (12, 26), (26, 40)])
+    store = SnapshotStore(str(tmp_path / "snap"))
+    _publish(store, src, dst, v)
+
+    ta = store.for_tenant("ta")
+    assert ta.root == os.path.join(store.base_root, "tenants", "ta")
+    assert ta.base_root == store.base_root
+    assert ta.for_tenant("ta") is ta
+    assert store.for_tenant(DEFAULT_TENANT) is store
+
+    sa, da, va = _cliques([(0, 15), (15, 30)])
+    _publish(ta, sa, da, va)
+    tb = store.for_tenant("tb")
+    sb, db, vb = _cliques([(0, 10), (10, 20)])
+    _publish(tb, sb, db, vb)
+    _publish(tb, sb, db, vb)  # second publish: tb's own chain advances
+
+    assert store.list_tenants() == [DEFAULT_TENANT, "ta", "tb"]
+    assert store.load().version == 1
+    assert ta.load().version == 1
+    assert tb.load().version == 2
+    # namespaces hold different graphs, not views of one
+    assert store.load()["src"].size != ta.load()["src"].size
+    assert ta.load()["src"].size != tb.load()["src"].size
+
+
+def test_hostile_tenant_ids_refused(tmp_path):
+    """A hostile id raises ``ValueError`` before any filesystem path is
+    built — no directory appears, nothing escapes the root."""
+    src, dst, v = _cliques([(0, 12), (12, 26), (26, 40)])
+    store = SnapshotStore(str(tmp_path / "snap"))
+    _publish(store, src, dst, v)
+    before = sorted(os.listdir(store.base_root))
+
+    for bad in (
+        "", "A", "Ta", "a/b", "../evil", "a b", "a.b", "ü",
+        "x" * 65, "tenants/../../evil",
+    ):
+        with pytest.raises(ValueError):
+            validate_tenant_id(bad)
+        with pytest.raises(ValueError):
+            store.for_tenant(bad)
+
+    assert sorted(os.listdir(store.base_root)) == before
+    assert not (tmp_path / "evil").exists()
+
+    for good in ("a", "0", "a-b_c9", "x" * 64, DEFAULT_TENANT):
+        assert validate_tenant_id(good) == good
+
+
+def test_tenant_registry_bounds_and_memory(monkeypatch):
+    """``GRAPHMINE_TENANT_BOUNDS`` seeds per-tenant admission overrides,
+    ``set_overrides`` layers on top, and the packing oracle sums
+    per-tenant snapshot bytes against the serve budget."""
+    monkeypatch.setenv(
+        "GRAPHMINE_TENANT_BOUNDS",
+        json.dumps({"ta": {"max_pending_rows": 7, "deadline_s": 3.5}}),
+    )
+    reg = TenantRegistry()
+    assert reg.bounds_for("ta").max_pending_rows == 7
+    assert reg.bounds_for("ta").deadline_s == 3.5
+    baseline = reg.bounds_for("tb")
+    assert baseline.max_pending_rows != 7
+
+    reg.set_overrides("tb", max_queue_depth=2)
+    assert reg.bounds_for("tb").max_queue_depth == 2
+    # overrides never bleed across tenants
+    assert reg.bounds_for("ta").max_queue_depth == baseline.max_queue_depth
+    assert set(reg.snapshot()["overrides"]) >= {"ta", "tb"}
+
+    reg.note_bytes("ta", 100)
+    reg.note_bytes("tb", 60)
+    mp = reg.memory_payload(200)
+    assert mp["total_snapshot_bytes"] == 160
+    assert mp["headroom_bytes"] == 40
+    assert mp["fits"] is True
+    assert reg.memory_payload(100)["fits"] is False
+    assert "budget_bytes" not in reg.memory_payload(None)  # unknown budget
+
+    monkeypatch.setenv("GRAPHMINE_TENANT_BOUNDS", "{not json")
+    with pytest.raises(ValueError):
+        TenantRegistry()
+
+
+# ---- HTTP routing + read-plane blast radius -------------------------------
+
+
+def test_http_tenant_routing_and_wrong_tenant_404(tmp_path):
+    """``X-Tenant-Id`` and ``?tenant=`` route every endpoint to that
+    tenant's engine; a valid vertex under the wrong tenant 404s exactly
+    like an unknown tenant; malformed ids 400."""
+    store = _three_tenant_root(tmp_path)
+    server = SnapshotServer(store)
+    host, port = server.start()
+    try:
+        # same vertex, three namespaces, three different degrees
+        # (default: clique of 12 -> 11; ta: 15 -> 14; tb: 10 -> 9)
+        deg = lambda hdr=None, qs="": len(_get(  # noqa: E731
+            host, port, f"/neighbors?v=5{qs}", headers=hdr
+        )["neighbors"])
+        assert deg() == 11
+        assert deg(hdr={"X-Tenant-Id": "ta"}) == 14
+        assert deg(qs="&tenant=ta") == 14
+        assert deg(hdr={"X-Tenant-Id": "tb"}) == 9
+
+        # vertex 25 exists under default and ta, not under tb (v=20):
+        # wrong tenant answers 404 "not found", same as an unknown
+        # tenant — a prober can't learn which tenants exist
+        assert _get(host, port, "/vertex?v=25&tenant=ta")["vertex"] == 25
+        bodies = []
+        for path in ("/vertex?v=25&tenant=tb", "/vertex?v=25&tenant=ghost"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(host, port, path)
+            assert e.value.code == 404
+            bodies.append(json.loads(e.value.read())["error"])
+        assert bodies[0] == bodies[1]
+
+        for hdr in ("../evil", "TA", "a b"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(host, port, "/vertex?v=5",
+                     headers={"X-Tenant-Id": hdr})
+            assert e.value.code == 400
+
+        hz = _get(host, port, "/healthz")
+        assert hz["tenants"] == 3
+        assert set(hz["tenant_versions"]) == {DEFAULT_TENANT, "ta", "tb"}
+        assert set(hz["tenant_snapshot_age_s"]) == set(hz["tenant_versions"])
+        assert all(a >= 0 for a in hz["tenant_snapshot_age_s"].values())
+
+        st = _get(host, port, "/statusz")["tenancy"]
+        assert set(st["per_tenant"]) == {DEFAULT_TENANT, "ta", "tb"}
+        assert st["per_tenant"]["ta"]["version"] == 1
+        assert {"ta", "tb"} <= set(st["memory"]["tenants"])
+    finally:
+        server.stop()
+
+
+# ---- tenant-scoped durability ---------------------------------------------
+
+
+def test_wal_dedupe_and_replay_are_tenant_scoped(tmp_path):
+    """The same ``delta_id`` under two tenants is two distinct applies;
+    the retry under the original tenant dedupes; a restart preserves
+    each tenant's version chain and the dedupe table."""
+    store = _three_tenant_root(tmp_path)
+    server = SnapshotServer(store, wal=True)
+    payload = {"insert": [[1, 16]], "delete": []}
+    try:
+        r1 = server.apply_delta(payload, delta_id="d1", tenant="ta")
+        assert r1["version"] == 2
+        r2 = server.apply_delta(payload, delta_id="d1", tenant="tb")
+        assert r2.get("verdict") != "duplicate"
+        assert r2["version"] == 2
+        r3 = server.apply_delta(payload, delta_id="d1", tenant="ta")
+        assert r3["verdict"] == "duplicate" and r3["applied"] is True
+        assert server.engine_for("ta").version == 2
+        assert server.engine_for("tb").version == 2
+        assert server.engine_for(DEFAULT_TENANT).version == 1
+    finally:
+        server.stop()
+
+    server2 = SnapshotServer(store, wal=True)
+    try:
+        assert server2.engine_for("ta").version == 2
+        assert server2.engine_for("tb").version == 2
+        r4 = server2.apply_delta(payload, delta_id="d1", tenant="ta")
+        assert r4["verdict"] == "duplicate"
+        r5 = server2.apply_delta(payload, delta_id="d2", tenant="ta")
+        assert r5["version"] == 3
+    finally:
+        server2.stop()
+
+
+def test_unknown_tenant_rejected_before_side_effects(tmp_path):
+    """A write naming an unknown (or malformed) tenant fails before any
+    admission/WAL side effect — nothing lands in anyone's ledger."""
+    store = _three_tenant_root(tmp_path)
+    server = SnapshotServer(store, wal=True)
+    try:
+        wal_before = server.wal.snapshot()["last_seq"]
+        with pytest.raises(UnknownTenantError):
+            server.apply_delta({"insert": [[0, 1]]}, tenant="ghost")
+        with pytest.raises(ValueError):
+            server.apply_delta({"insert": [[0, 1]]}, tenant="../evil")
+        assert server.wal.snapshot()["last_seq"] == wal_before
+        assert server.engine_for(DEFAULT_TENANT).version == 1
+    finally:
+        server.stop()
+
+
+# ---- weighted-fair dequeue ------------------------------------------------
+
+
+def _enqueue(server, tenant, rows, n=1, deadline_s=300.0):
+    ts = server._tenant_state(tenant)
+    for _ in range(n):
+        pd = _PendingDelta(
+            EdgeDelta(), rows, time.monotonic() + deadline_s, deadline_s
+        )
+        pd.tenant = tenant
+        ts.queue.append(pd)
+    if tenant not in server._rr:
+        server._rr.append(tenant)
+
+
+def test_deficit_round_robin_interleaves_tenants(tmp_path):
+    """With two tenants backed up, the worker's dequeue alternates by
+    row quantum — the abuser's queue depth never buys it consecutive
+    turns. (No delta ever enters through apply_delta here, so the lazy
+    apply worker never starts and popping by hand is race-free.)"""
+    server = SnapshotServer(_three_tenant_root(tmp_path))
+    server._fair_quantum_rows = 4
+    _enqueue(server, "ta", rows=4, n=3)
+    _enqueue(server, "tb", rows=4, n=3)
+
+    pops = [server._pop_group() for _ in range(6)]
+    assert [t for t, _, _ in pops] == ["ta", "tb", "ta", "tb", "ta", "tb"]
+    assert all(len(g) == 1 and e == [] for _, g, e in pops)
+
+    # a batch larger than the quantum still makes progress (>=1 per turn)
+    _enqueue(server, "ta", rows=1000)
+    _enqueue(server, "tb", rows=4)
+    t1, g1, _ = server._pop_group()
+    assert (t1, g1[0].rows) == ("ta", 1000)
+    assert server._pop_group()[0] == "tb"
+
+    # one active tenant = infinite quantum: the pre-tenancy
+    # pop-everything (and coalesce-everything) behavior
+    _enqueue(server, "ta", rows=4, n=3)
+    t2, g2, _ = server._pop_group()
+    assert t2 == "ta" and len(g2) == 3
+
+    # expired deadlines are split out for shedding whoever's turn it is
+    _enqueue(server, "ta", rows=4, deadline_s=300.0)
+    _enqueue(server, "tb", rows=4)
+    ts = server._tenant_state("ta")
+    ts.queue[0].deadline = time.monotonic() - 1.0
+    _, _, expired = server._pop_group()
+    assert [p.tenant for p in expired] == ["ta"]
+
+
+# ---- per-tenant alert planes ----------------------------------------------
+
+
+def test_alert_planes_are_tenant_scoped(tmp_path):
+    """Tenant A's canary page fires naming A — records tenant-stamped,
+    ``/alertz?tenant=A`` firing — while B's rule set stays clean."""
+    sink = _sink()
+    server = SnapshotServer(_three_tenant_root(tmp_path), sink=sink)
+    ts_a = server._tenant_state("ta")
+    server._tenant_state("tb")
+
+    # drive A's canary rule directly through its own manager (for_s
+    # honored by spacing the evaluations far apart)
+    ts_a.alerts.evaluate({"canary_recall": 0.1}, now=1000.0)
+    ts_a.alerts.evaluate({"canary_recall": 0.1}, now=2000.0)
+
+    page_a = server.alertz("ta")
+    assert page_a["tenant"] == "ta"
+    assert page_a["firing"] >= 1
+    rule = next(
+        r for r in page_a["rules"] if r["name"] == "canary_recall_low"
+    )
+    assert rule["state"] == "firing"
+
+    page_b = server.alertz("tb")
+    assert page_b["tenant"] == "tb" and page_b["firing"] == 0
+    assert server.alertz()["firing"] == 0  # default untouched too
+
+    alert_recs = [r for r in sink.records if r.get("phase") == "alert"]
+    assert any(
+        r.get("tenant") == "ta" and r["name"] == "canary_recall_low"
+        and r["state"] == "firing"
+        for r in alert_recs
+    )
+    assert not any(r.get("tenant") == "tb" for r in alert_recs)
+    assert validate_records(sink.records) == []
+
+
+# ---- the noisy-neighbor chaos acceptance ----------------------------------
+
+
+def test_noisy_neighbor_isolation_acceptance(tmp_path, monkeypatch):
+    """THE ISSUE 16 acceptance: a live 3-tenant server with tenant
+    ``noisy`` abusing the write path (volume + stalled repairs via
+    ``faults.noisy_neighbor_burst``) while ``vb``/``vc`` keep working.
+
+    Pinned from live endpoints: victims' reads stay fast and answer
+    ONLY from their own namespace; their mid-storm deltas publish with
+    zero sheds; the abuser sheds and its ingest-lag page fires; the
+    victims' alert planes never fire."""
+    # Alert thresholds: resolved at each tenant's first touch, so set
+    # BEFORE the server exists. for_s outlasts any victim's worst-case
+    # queue wait (<= ~2 abuser publishes) but not the abuser's
+    # storm-long backlog.
+    monkeypatch.setenv("GRAPHMINE_ALERT_INGEST_LAG_S", "0.5")
+    monkeypatch.setenv("GRAPHMINE_ALERT_INGEST_LAG_FOR_S", "6.0")
+    # Quality plane off: the synthetic lof arrays drift wildly once a
+    # real repair rescores them, and those warn-rules would drown the
+    # signal under test — WRITE-path isolation via the ingest-lag page.
+    # Per-tenant quality/canary scoping is pinned separately above.
+    monkeypatch.setenv("GRAPHMINE_QUALITY", "0")
+
+    sink = _sink()
+    src, dst, v = _cliques([(0, 12), (12, 26), (26, 40)])
+    store = SnapshotStore(str(tmp_path / "snap"))
+    _publish(store, src, dst, v, sink=sink)
+    _publish(store.for_tenant("noisy"), src, dst, v, sink=sink)
+    sb, db, vvb = _cliques([(0, 15), (15, 30)])
+    _publish(store.for_tenant("vb"), sb, db, vvb, sink=sink)
+    sc, dc, vvc = _cliques([(0, 10), (10, 20)])
+    _publish(store.for_tenant("vc"), sc, dc, vvc, sink=sink)
+
+    server = SnapshotServer(store, sink=sink)
+    # Tight envelope for the abuser only: ~2 groups of pending rows,
+    # then ITS OWN ladder sheds it. Victims keep the generous defaults.
+    server.tenancy.set_overrides(
+        "noisy", max_pending_rows=24, max_queue_depth=2, deadline_s=120.0,
+    )
+    payloads, staller = faults.noisy_neighbor_burst(
+        "noisy", v, batches=6, rows_per_batch=8, seed=7, stall_s=1.2,
+    )
+    inj = faults.FaultInjector()
+    inj.add("delta_repair", staller, at=1, repeat=10**6)
+
+    host, port = server.start()
+    abuser_sheds = [0]
+    abuser_errors = []
+    stop = threading.Event()
+
+    def abuse():
+        i = 0
+        while not stop.is_set():
+            try:
+                _post(host, port, "/delta", payloads[i % len(payloads)],
+                      headers={"X-Tenant-Id": "noisy"})
+            except urllib.error.HTTPError as e:
+                e.read()
+                if e.code == 503:
+                    abuser_sheds[0] += 1
+                    time.sleep(0.05)
+                else:
+                    abuser_errors.append(e)
+                    return
+            except Exception as e:  # noqa: BLE001 — collect, assert later
+                abuser_errors.append(e)
+                return
+            i += 1
+
+    victim_delta = {
+        "vb": {"insert": [[2, 16]], "delete": []},
+        "vc": {"insert": [[2, 11]], "delete": []},
+    }
+    try:
+        # phase A — quiet baseline: victims write and read cleanly
+        for t in ("vb", "vc"):
+            out = _post(host, port, "/delta", victim_delta[t],
+                        headers={"X-Tenant-Id": t})
+            assert out["version"] == 2
+            assert _get(host, port, f"/alertz?tenant={t}")["firing"] == 0
+
+        # phase B — the storm
+        read_lat = []
+        victim_versions = {"vb": set(), "vc": set()}
+        noisy_fired = False
+        posted_mid = False
+        with inj.installed():
+            threads = [
+                threading.Thread(target=abuse, daemon=True)
+                for _ in range(3)
+            ]
+            for th in threads:
+                th.start()
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 25.0:
+                for t in ("vb", "vc"):
+                    q0 = time.perf_counter()
+                    out = _post(host, port, "/query", {"vertices": [3, 7]},
+                                headers={"X-Tenant-Id": t})
+                    read_lat.append(time.perf_counter() - q0)
+                    assert len(out["label"]) == 2
+                    victim_versions[t].add(out["version"])
+                elapsed = time.monotonic() - t0
+                if elapsed > 3.0 and not posted_mid:
+                    posted_mid = True
+                    for t in ("vb", "vc"):
+                        out = _post(host, port, "/delta", victim_delta[t],
+                                    headers={"X-Tenant-Id": t})
+                        # flowing, not shed: a real publish came back
+                        assert out["version"] == 3
+                    # zero cross-tenant reads: vb's vertex 25 does not
+                    # exist in vc's 20-vertex namespace, storm or not
+                    with pytest.raises(urllib.error.HTTPError) as e:
+                        _get(host, port, "/vertex?v=25&tenant=vc")
+                    assert e.value.code == 404
+                if elapsed > 8.0:
+                    page = _get(host, port, "/alertz?tenant=noisy")
+                    firing = [
+                        r["name"] for r in page["rules"]
+                        if r["state"] == "firing"
+                    ]
+                    if "ingest_lag_high" in firing:
+                        noisy_fired = True
+                        break
+                time.sleep(0.02)
+            stop.set()
+            for th in threads:
+                th.join(timeout=30)
+        server.wait_applied(timeout=120.0)
+
+        assert abuser_errors == []
+        assert noisy_fired, "abuser ingest-lag page never fired"
+        assert posted_mid and abuser_sheds[0] > 0
+
+        # victims' reads: bounded p99, and every answer came from the
+        # victim's OWN version chain (1 publish + 2 deltas), never the
+        # abuser's racing chain
+        read_lat.sort()
+        assert read_lat[int(0.99 * (len(read_lat) - 1))] < 1.0
+        for t in ("vb", "vc"):
+            assert victim_versions[t] <= {2, 3}
+            assert server.engine_for(t).version == 3
+            assert _get(host, port, f"/alertz?tenant={t}")["firing"] == 0
+
+        st = _get(host, port, "/statusz")["tenancy"]["per_tenant"]
+        assert st["noisy"]["verdicts"].get("shed", 0) >= 1
+        assert st["vb"]["verdicts"].get("shed", 0) == 0
+        assert st["vc"]["verdicts"].get("shed", 0) == 0
+        assert st["noisy"]["version"] > 3
+
+        # the victims' alert planes never transitioned, storm-long
+        assert not any(
+            r.get("phase") == "alert" and r.get("tenant") in ("vb", "vc")
+            for r in sink.records
+        )
+        assert validate_records(sink.records) == []
+
+        # the per-tenant obs rollup renders the storm
+        from tools.obs_report import _tenant_section
+
+        lines = _tenant_section(sink.records, 0.0)
+        assert any("noisy" in ln for ln in lines)
+    finally:
+        stop.set()
+        server.stop()

@@ -77,7 +77,8 @@ SUB_RECORDS = {
     "exchange": ("neighbor_vs_allgather",),
     "stream": ("ivf_reuse",),
     "serve": ("write_load", "replicated_read", "writer_failover",
-              "latency_quantiles", "quality_pass", "memory"),
+              "latency_quantiles", "quality_pass", "multi_tenant",
+              "memory"),
     # the per-tier memory sub-record (ISSUE 14: model + measured child
     # peak RSS) is tracked on the headline tier; every tier carries it,
     # but one manifest row is the signal "this round recorded memory"

@@ -174,6 +174,26 @@ def cmd_status(args) -> int:
         print(f"fleet_cli: router unreachable at {args.url}: {e}",
               file=sys.stderr)
         return 2
+    if args.tenant:
+        # per-tenant view: collapse each replica's tenant_versions map
+        # (the prober's /healthz payload) to the one namespace asked for
+        # — the roll/catch-up story for a single tenant at a glance
+        out = {
+            "tenant": args.tenant,
+            "committed_version": out.get("committed_version"),
+            "read_only": out.get("read_only"),
+            "replicas": [
+                {
+                    "id": r.get("id"),
+                    "state": r.get("state"),
+                    "version": (r.get("tenant_versions") or {}).get(
+                        args.tenant
+                    ),
+                    "writer": r.get("writer"),
+                }
+                for r in out.get("replicas", [])
+            ],
+        }
     print(json.dumps(out, indent=1))
     return 0
 
@@ -235,6 +255,10 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("status", help="print the router's /fleetz")
     p.add_argument("--url", required=True, help="router base URL")
+    p.add_argument("--tenant", default=None,
+                   help="collapse the view to one tenant namespace: "
+                        "per-replica versions for that tenant only "
+                        "(docs/SERVING.md 'Multi-tenant serving')")
     p.set_defaults(fn=cmd_status)
 
     p = sub.add_parser("roll", help="trigger a zero-downtime rolling reload")

@@ -957,6 +957,67 @@ def _quality_section(records, t0):
     return out
 
 
+def _tenant_section(records, t0):
+    """Per-tenant serving rollup (ISSUE 16, docs/SERVING.md "Multi-tenant
+    serving"): group the write-path and alert records by the ``tenant``
+    they carry — one row per namespace with its admission verdict mix,
+    applied volume, sheds and firing alerts, so a noisy-neighbor
+    incident reads as "tenant A shed, tenant B clean" instead of one
+    blended stream. Records without a tenant stamp are the default
+    namespace. Empty when the stream is single-tenant (no record
+    carries a tenant key)."""
+    phases = ("admission", "delta_apply", "delta_shed", "delta_coalesce",
+              "access_log", "alert", "quality_drift", "canary_score")
+    tagged = [r for r in records if r.get("phase") in phases]
+    if not any("tenant" in r for r in tagged):
+        return []
+    groups: dict = {}
+    for r in tagged:
+        groups.setdefault(r.get("tenant") or "default", []).append(r)
+    out = [
+        "  tenant            deltas    rows  sheds  admission verdicts"
+        "        firing"
+    ]
+    for tenant in sorted(groups):
+        rs = groups[tenant]
+        applies = [r for r in rs if r["phase"] == "delta_apply"]
+        rows = sum(
+            int(r.get("inserts", 0) or 0) + int(r.get("deletes", 0) or 0)
+            for r in applies
+        )
+        verdicts: dict = {}
+        for r in rs:
+            if r["phase"] == "admission":
+                v = str(r.get("verdict", "?"))
+                verdicts[v] = verdicts.get(v, 0) + 1
+        mix = " ".join(
+            f"{k}:{n}" for k, n in sorted(verdicts.items())
+        ) or "-"
+        sheds = sum(1 for r in rs if r["phase"] == "delta_shed")
+        last_alert: dict = {}
+        for r in rs:
+            if r["phase"] == "alert" and r.get("name"):
+                last_alert[r["name"]] = r.get("state")
+        firing = sorted(
+            n for n, st in last_alert.items() if st == "firing"
+        )
+        out.append(
+            f"  {tenant:<16} {len(applies):>7} {rows:>7} {sheds:>6}  "
+            f"{mix:<24}  {', '.join(firing) or '-'}"
+        )
+    transitions = [
+        r for r in tagged
+        if r["phase"] == "alert" and "tenant" in r
+    ]
+    for r in transitions:
+        mark = "ALERT FIRING" if r.get("state") == "firing" else "resolved"
+        out.append(
+            f"  {_fmt_offset(r, t0)}  [{r.get('tenant', '?')}]  {mark:<12}"
+            f" {r.get('name', '?')}  value={r.get('value', '?')}"
+        )
+    return out
+
+
 def gating_alerts(records) -> list:
     """Alert names whose LAST transition in the stream is a firing
     page-severity alert (the canary rule is the built-in page) — the CI
@@ -1146,6 +1207,11 @@ def build_report(
         lines.append("")
         lines.append("-- quality & alerts (result drift / canary) --")
         lines.extend(qual)
+    tenants = _tenant_section(records, t0)
+    if tenants:  # single-tenant streams carry no tenant stamps
+        lines.append("")
+        lines.append("-- tenants (per-namespace serving rollup) --")
+        lines.extend(tenants)
     ftrace = _fleet_trace_section(records)
     if ftrace:
         lines.append("")

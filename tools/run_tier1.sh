@@ -7,7 +7,13 @@
 # (-m faults: tests/test_resilience.py + the tripwire/reshard cases in
 # tests/test_sharded.py) is part of this default pass.
 #
-# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only|--slo-only|--blocking-only|--admission-only|--fleet-only|--wal-only|--trace-only|--perf-only|--quality-only|--mem-only|--sharded2d-only] [extra pytest args...]
+# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only|--slo-only|--blocking-only|--admission-only|--fleet-only|--wal-only|--trace-only|--perf-only|--quality-only|--mem-only|--sharded2d-only|--tenancy-only] [extra pytest args...]
+#   --tenancy-only run just the `tenancy`-marked multi-tenant serving
+#                  suite (tests/test_tenancy.py: namespaced stores,
+#                  hostile-id refusal, per-tenant bounds + fair apply,
+#                  tenant-scoped WAL replay, per-tenant alerting and
+#                  the noisy-neighbor chaos acceptance) — the fast
+#                  slice when iterating on tenancy
 #   --sharded2d-only run just the `sharded2d`-marked 2D-edge-partition
 #                  suite (tests/test_sharded2d.py: neighbor-exchange
 #                  bit-parity vs the sort oracle, per-peer boundary
@@ -130,6 +136,9 @@ elif [ "${1:-}" = "--mem-only" ]; then
 elif [ "${1:-}" = "--sharded2d-only" ]; then
     shift
     MARKER='sharded2d and not slow'
+elif [ "${1:-}" = "--tenancy-only" ]; then
+    shift
+    MARKER='tenancy and not slow'
 fi
 
 LOG="${TIER1_LOG:-/tmp/_t1.log}"

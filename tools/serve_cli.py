@@ -176,7 +176,13 @@ def _sink(args):
 def _store(args):
     from graphmine_tpu.serve.snapshot import SnapshotStore
 
-    return SnapshotStore(args.store)
+    store = SnapshotStore(args.store)
+    tenant = getattr(args, "tenant", None)
+    if tenant:
+        # local mode scopes to the tenant's namespace directly; HTTP
+        # mode sends X-Tenant-Id instead (the server does the remap)
+        store = store.for_tenant(tenant)
+    return store
 
 
 def cmd_info(args) -> int:
@@ -201,6 +207,8 @@ def cmd_query(args) -> int:
             "deadline_ms": args.deadline_ms,
             "max_retries": args.max_retries,
         }
+        if args.tenant:
+            kw["headers"] = {"X-Tenant-Id": args.tenant}
         merged: dict = {}
         calls = []
         if args.vertex:
@@ -294,6 +302,10 @@ def cmd_delta(args) -> int:
         # WAL instead of double-applying (a WAL-less server ignores it).
         delta_id = args.delta_id or f"cli-{os.getpid()}-{os.urandom(6).hex()}"
         headers = {"X-Delta-Id": delta_id}
+        if args.tenant:
+            # tenant + delta id together ride every retry: the dedupe
+            # key is (tenant, delta_id) server-side
+            headers["X-Tenant-Id"] = args.tenant
         if args.ack_wal:
             headers["X-Delta-Ack"] = "wal"
         out = request_with_retries(
@@ -401,9 +413,17 @@ def main(argv=None) -> int:
                        help="extra attempts on 503/transport failure "
                             "(decorrelated-jitter backoff, honoring the "
                             "server's Retry-After)")
+        p.add_argument("--tenant", default=None,
+                       help="tenant namespace: HTTP mode sends it as "
+                            "X-Tenant-Id on every attempt; local mode "
+                            "scopes --store to tenants/<id>/ "
+                            "(docs/SERVING.md 'Multi-tenant serving')")
 
     p = sub.add_parser("info", help="print the current snapshot manifest")
     common(p)
+    p.add_argument("--tenant", default=None,
+                   help="read the manifest of this tenant's namespace "
+                        "(tenants/<id>/ under --store)")
     p.set_defaults(fn=cmd_info)
 
     p = sub.add_parser("query", help="one-shot queries against the store")
