@@ -1007,6 +1007,105 @@ def _serve_multi_tenant(tmp, arrays, fp, v):
     }
 
 
+def _serve_sharded_write(tmp, arrays, fp, v):
+    """The serve tier's sharded-write-plane sub-record (r17,
+    docs/SERVING.md "Sharded write plane"): the SAME concurrent delta
+    burst against one server at 1 vs 3 writer shards — accepted
+    deltas/s, publish (epoch) cadence, and the per-range apply split
+    (how evenly dst-ownership spread the burst). On the CPU fallback
+    all shards share one interpreter, so the honest headline is the
+    split/append-path overhead vs the single-WAL write path — per-range
+    parallel fsync scaling is a multi-spindle number (ROADMAP silicon
+    backlog); the record shape is what the capture pipeline tracks
+    either way."""
+    import threading
+
+    from graphmine_tpu.serve.admission import (
+        AdmissionBounds,
+        AdmissionController,
+    )
+    from graphmine_tpu.serve.server import SnapshotServer
+    from graphmine_tpu.serve.snapshot import SnapshotStore
+    from graphmine_tpu.testing import faults as _faults
+
+    batches, rows = (12, 48) if _CPU_FALLBACK else (40, 256)
+    # generous envelope so neither run sheds: the record compares the
+    # durability path (1 WAL append vs split + per-shard appends), and a
+    # shed batch skips that path entirely, skewing the ratio
+    bounds = AdmissionBounds(
+        max_pending_rows=batches * rows * 2,
+        max_queue_depth=batches + 4,
+        deadline_s=120.0,
+    )
+    out = []
+    for shards in (1, 3):
+        root = os.path.join(tmp, f"sharded_write_{shards}")
+        store = SnapshotStore(root)
+        store.publish(arrays, fingerprint=fp)
+        server = SnapshotServer(
+            store,
+            admission=AdmissionController(bounds=bounds),
+            # durability-matched baseline: 1 shard runs the classic
+            # single-WAL writer (plane mode forbids wal=), so both rungs
+            # pay an fsync'd append per accepted batch
+            wal=os.path.join(root, "wal") if shards == 1 else None,
+            writer_shards=shards,
+        )
+        payloads = _faults.delta_burst(
+            v, batches=batches, rows_per_batch=rows, seed=29,
+        )
+        results = []
+        t0 = time.perf_counter()
+        threads = []
+        for p in payloads:
+            th = threading.Thread(
+                target=lambda pl=p: results.append(server.apply_delta(pl))
+            )
+            th.start()
+            threads.append(th)
+            time.sleep(0.002)
+        for th in threads:
+            th.join()
+        elapsed = time.perf_counter() - t0
+        accepted = sum(
+            1 for r in results if r.get("verdict") != "shed"
+        )
+        rec = {
+            "writer_shards": shards,
+            "batches": batches,
+            "rows_per_batch": rows,
+            "seconds": round(elapsed, 3),
+            "accepted_batches": accepted,
+            "accepted_deltas_per_sec": round(accepted / elapsed, 2)
+            if elapsed > 0 else 0.0,
+        }
+        debt = server.debt.snapshot()
+        applies = debt["applies_warm"] + debt["applies_cold"]
+        rec["applies"] = applies
+        rec["accepted_rows_per_sec"] = round(
+            debt["rows_applied_total"] / elapsed
+        ) if elapsed > 0 else 0
+        ts = server._tenants["default"]
+        if ts.plane is not None:
+            plane = ts.plane.snapshot()
+            epoch = plane["epoch"]
+            rec["committed_epoch"] = epoch
+            rec["publishes_per_sec"] = round(epoch / elapsed, 2) \
+                if elapsed > 0 else 0.0
+            # per-range apply split: each shard's appended sub-batch
+            # count — dst-ownership's actual spread of the burst
+            rec["per_shard_appends"] = {
+                str(s["shard"]): s["wal"]["last_seq"]
+                for s in plane["shards"]
+            }
+        else:
+            rec["publishes_per_sec"] = round(applies / elapsed, 2) \
+                if elapsed > 0 else 0.0
+        server.stop()
+        out.append(rec)
+    return out
+
+
 def _serve_replicated_read(tmp, arrays, fp, v):
     """The serve tier's replicated-read sub-record (r10): hammer the
     SAME batched-query workload through the fleet router at 1 vs 3
@@ -1443,6 +1542,13 @@ def main_serve() -> None:
         # apply — the victims' read p99 and zero-shed apply counts ARE
         # the noisy-neighbor bound the manifest tracks.
         multi_tenant = _serve_multi_tenant(tmp, arrays, fp, v)
+
+        # sharded write plane (r17): the same burst at 1 vs 3 writer
+        # shards — accepted deltas/s, epoch-publish cadence and the
+        # per-range apply split. CPU-fallback shares one interpreter, so
+        # this prices the split/per-shard-append overhead; parallel
+        # per-range fsync scaling is a silicon-backlog number.
+        sharded_write = _serve_sharded_write(tmp, arrays, fp, v)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -1492,6 +1598,9 @@ def main_serve() -> None:
                     "quality_pass": quality_pass,
                     # noisy-neighbor isolation bound (ISSUE 16)
                     "multi_tenant": multi_tenant,
+                    # 1 vs 3 writer shards: split overhead + epoch
+                    # cadence + per-range apply spread (r17)
+                    "sharded_write": sharded_write,
                     "device": str(jax.devices()[0]),
                 },
             }

@@ -63,10 +63,16 @@ class Counter(_Metric):
 
 
 class Gauge(_Metric):
-    """Last-observed value (current superstep, devices alive, RSS)."""
+    """Last-observed value (current superstep, devices alive, RSS).
+    ``labels`` distinguish siblings of one :class:`GaugeFamily`
+    (per-shard WAL gauges, r17); an unlabeled gauge has an empty dict
+    and renders exactly as before."""
 
-    def __init__(self, name: str, help: str = ""):
+    __slots__ = ("labels",)
+
+    def __init__(self, name: str, help: str = "", labels: dict | None = None):
         super().__init__(name, help, "gauge")
+        self.labels = dict(labels or {})
 
     def set(self, v: float) -> None:
         with self._lock:
@@ -75,6 +81,48 @@ class Gauge(_Metric):
     def inc(self, n: float = 1) -> None:
         with self._lock:
             self._value += n
+
+
+class GaugeFamily:
+    """All label-sets of one gauge name: one shared HELP/TYPE line, one
+    :class:`Gauge` child per label combination — the shape the sharded
+    write plane's per-shard WAL gauges need
+    (``graphmine_serve_wal_pending_entries{shard="2"}``): one unlabeled
+    gauge would silently fold a dead shard's backlog into healthy
+    ranges. Mirrors :class:`~graphmine_tpu.obs.histogram.HistogramFamily`
+    so the one-name-one-TYPE registry rule holds across kinds."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._children: dict = {}
+        self._lock = threading.Lock()
+
+    def labels(self, **labels) -> Gauge:
+        """Get-or-create the child for one label combination."""
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = Gauge(
+                    self.name, self.help, labels=dict(labels)
+                )
+            return child
+
+    def children(self) -> list:
+        """Children sorted by label set — deterministic exposition order."""
+        with self._lock:
+            return [self._children[k] for k in sorted(self._children)]
+
+    @property
+    def value(self):
+        """Sum across children — what ``Registry.values`` (and the
+        heartbeat's gauge fold) reports for a labeled family. For the
+        WAL backlog gauges the sum IS the whole-plane total; per-shard
+        values live in the exposition lines."""
+        return sum(c.value for c in self.children())
 
 
 class Registry:
@@ -104,8 +152,28 @@ class Registry:
     def counter(self, name: str, help: str = "") -> Counter:
         return self._get(name, help, Counter)
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get(name, help, Gauge)
+    def gauge(self, name: str, help: str = "", **labels) -> Gauge:
+        """Get-or-create a gauge. With ``labels``
+        (``registry.gauge("wal_pending", shard="2")``) the name becomes
+        a :class:`GaugeFamily` and the labeled child is returned; a name
+        must stay labeled or unlabeled for its lifetime (mixing would
+        emit duplicate series under one TYPE line)."""
+        if not labels:
+            return self._get(name, help, Gauge)
+        if not _NAME_RE.match(name):
+            raise ValueError(
+                f"invalid metric name {name!r} (want [a-zA-Z_:][a-zA-Z0-9_:]*)"
+            )
+        with self._lock:
+            fam = self._metrics.get(name)
+            if fam is None:
+                fam = self._metrics[name] = GaugeFamily(name, help)
+            elif not isinstance(fam, GaugeFamily):
+                raise ValueError(
+                    f"metric {name!r} already registered as an unlabeled "
+                    f"{fam.kind}; one name is one shape"
+                )
+        return fam.labels(**labels)
 
     def histogram(
         self, name: str, help: str = "", buckets=None, **labels
@@ -183,6 +251,21 @@ class Registry:
             if isinstance(m, HistogramFamily):
                 for child in m.children():
                     lines.extend(child.render_lines(extra_labels=labels))
+            elif isinstance(m, GaugeFamily):
+                for child in m.children():
+                    merged = dict(labels or {})
+                    merged.update(child.labels)
+                    parts = ",".join(
+                        '%s="%s"' % (
+                            k,
+                            str(v).replace("\\", "\\\\").replace('"', '\\"'),
+                        )
+                        for k, v in sorted(merged.items())
+                    )
+                    lines.append(
+                        f"{m.name}{{{parts}}} {child.value}"
+                        if parts else f"{m.name} {child.value}"
+                    )
             else:
                 lines.append(f"{m.name}{lab} {m.value}")
         return "\n".join(lines) + ("\n" if lines else "")

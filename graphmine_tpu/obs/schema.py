@@ -170,6 +170,22 @@ register("writer_promote", "epoch")
 register("publish_fenced", "attempted_epoch", "store_epoch", "reason")
 register("ship_lag", "lag_entries", "lag_s")
 
+# ---- sharded write plane (r17, serve/shardplane.py; docs/SERVING.md
+# "Sharded write plane") ----------------------------------------------------
+# shard_publish: one per writer shard per epoch stage — the per-range
+# array files written under epochs/epoch-<e>.stage before the commit;
+# epoch_commit: the coordinator's durable two-phase commit point — the
+# epoch → per-shard version vector mapping readers key off (a crash
+# before this record leaves the previous epoch served); shard_degraded:
+# a per-range availability transition (killed / read_only / recovered /
+# promoted) — shard loss degrades ONE vertex range, and this record is
+# the timeline line that says which. Single builder:
+# serve/shardplane.emit_shard_record (tools/schema_lint.py flags inline
+# emits elsewhere).
+register("shard_publish", "epoch", "shard", "version", "arrays")
+register("epoch_commit", "epoch", "version_vector", "shards")
+register("shard_degraded", "shard", "status", "reason")
+
 # ---- cross-process tracing / time-to-visible SLO (docs/OBSERVABILITY.md
 # "Fleet tracing") ---------------------------------------------------------
 # delta_stages: one per accepted delta batch at publish time, emitted in
@@ -231,6 +247,7 @@ TENANT_PHASES = frozenset((
     "delta_stages", "snapshot_publish", "snapshot_load", "access_log",
     "alert", "quality_snapshot", "quality_drift", "canary_score",
     "wal_append", "wal_replay", "repair_fallback",
+    "shard_publish", "epoch_commit", "shard_degraded",
 ))
 
 # Mirrors serve/tenancy.py TENANT_RE — duplicated by design: obs/ stays
@@ -244,6 +261,7 @@ RECOVERY_PHASES = frozenset((
     "checkpoint_rollback_ok", "ivf_fallback", "quarantine",
     "repair_fallback", "delta_shed", "breaker_transition",
     "fleet_degraded", "wal_replay", "writer_promote", "publish_fenced",
+    "shard_degraded",
 ))
 
 

@@ -158,6 +158,24 @@ class EdgeDelta:
     def num_deletes(self) -> int:
         return len(self.delete_src)
 
+    def take(self, insert_index, delete_index) -> "EdgeDelta":
+        """Row-select a sub-delta by ORIGINAL row indices (the sharded
+        write plane's splitter, r17): inserts keep their weights, and
+        because the indices are positions into THIS delta's arrays, a
+        scatter of the sub-deltas back through the same indices is
+        bit-identical to the original — the splitter/merger parity the
+        shardplane tests pin."""
+        ins = np.asarray(insert_index, np.int64)
+        dels = np.asarray(delete_index, np.int64)
+        return EdgeDelta(
+            self.insert_src[ins], self.insert_dst[ins],
+            self.delete_src[dels], self.delete_dst[dels],
+            insert_weight=(
+                None if self.insert_weight is None
+                else self.insert_weight[ins]
+            ),
+        )
+
 
 def validate_delta(
     delta: EdgeDelta, num_vertices: int,

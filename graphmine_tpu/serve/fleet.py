@@ -752,6 +752,15 @@ class ReplicaSet:
                     "lof_stale": r.last_health.get("lof_stale"),
                     "tenants": r.last_health.get("tenants"),
                     "tenant_versions": r.last_health.get("tenant_versions"),
+                    # sharded write plane (r17): committed publish epoch
+                    # + per-range version vector — fleet_cli status
+                    # --shards collapses these into the range table
+                    "epoch": r.last_health.get("epoch"),
+                    "shard_versions": r.last_health.get("shard_versions"),
+                    "writer_shards": r.last_health.get("writer_shards"),
+                    "degraded_shards": r.last_health.get(
+                        "degraded_shards"
+                    ),
                 }
                 for r in self.replicas()
             ],
@@ -1536,7 +1545,7 @@ class FleetRouter:
         rs = self.replica_set
         committed = rs.committed_version()
         healthy = rs.healthy_count()
-        return {
+        out = {
             "ok": True,
             "role": "router",
             "committed_version": committed,
@@ -1549,6 +1558,18 @@ class FleetRouter:
             "ready": committed is not None
             and healthy >= max(1, self.config.min_healthy),
         }
+        # Sharded write plane (r17): surface the writer's committed
+        # publish epoch + per-range version vector, as last probed — the
+        # fleet-facing "which epoch is served" answer the chaos tier's
+        # no-mixed-epoch-reads assertion keys off.
+        writer = (
+            rs.replica(rs.writer_id) if rs.writer_id is not None else None
+        )
+        if writer is not None and writer.last_health.get("epoch") is not None:
+            out["epoch"] = writer.last_health.get("epoch")
+            out["shard_versions"] = writer.last_health.get("shard_versions")
+            out["writer_shards"] = writer.last_health.get("writer_shards")
+        return out
 
     def fleetz(self) -> dict:
         return {**self.replica_set.snapshot(),

@@ -147,6 +147,12 @@ from graphmine_tpu.serve.delta import (
     validate_delta,
 )
 from graphmine_tpu.serve.query import QueryEngine
+from graphmine_tpu.serve.shardplane import (
+    ShardPlan,
+    ShardRangeUnavailableError,
+    ShardedWritePlane,
+    writer_shards_from_env,
+)
 from graphmine_tpu.serve.snapshot import PublishFencedError, SnapshotStore
 from graphmine_tpu.serve.tenancy import (
     DEFAULT_TENANT,
@@ -212,7 +218,7 @@ class _PendingDelta:
     __slots__ = ("delta", "rows", "deadline", "deadline_s", "status",
                  "result", "error", "event", "shed_reason", "seq",
                  "delta_id", "async_ack", "trace", "t_accept",
-                 "t_durable", "tenant")
+                 "t_durable", "tenant", "shard_seqs")
 
     def __init__(
         self, delta: EdgeDelta, rows: int, deadline: float,
@@ -250,6 +256,11 @@ class _PendingDelta:
         # batch parks on — its debt, sheds and apply all charge HERE,
         # never to another tenant's ledger.
         self.tenant = DEFAULT_TENANT
+        # Sharded-write-plane identity (r17, serve/shardplane.py): the
+        # {shard: seq} map of every per-range WAL frame this batch is
+        # durable in — the (delta_id, shard) exactly-once pairs. None on
+        # the single-WAL (or WAL-less) path.
+        self.shard_seqs: dict | None = None
 
 
 class _TenantSink:
@@ -287,7 +298,7 @@ class _TenantState:
 
     __slots__ = ("tenant", "store", "engine", "ingestor", "admission",
                  "debt", "alerts", "queue", "reserved", "deficit",
-                 "quality_report")
+                 "quality_report", "plane")
 
     def __init__(self, tenant: str, store: SnapshotStore):
         self.tenant = tenant
@@ -301,6 +312,10 @@ class _TenantState:
         self.reserved = 0        # queue slots promised mid-WAL-append
         self.deficit = 0.0       # DRR balance, in rows
         self.quality_report = None
+        # Sharded write plane (r17, serve/shardplane.py): this tenant's
+        # vertex-range writer shards + epoch coordinator. None below
+        # writer_shards=2 — the single-WAL path stays bit-identical.
+        self.plane: ShardedWritePlane | None = None
 
 
 class SnapshotServer:
@@ -323,6 +338,7 @@ class SnapshotServer:
         primary_wal: str | None = None,
         ship_interval_s: float = 0.2,
         profilez_dir: str | None = None,
+        writer_shards: int | None = None,
     ):
         self.store = store
         self.sink = sink
@@ -416,6 +432,32 @@ class SnapshotServer:
                 self.wal.sink = sink
             if self.wal.registry is None:
                 self.wal.registry = self.registry
+        # Vertex-range writer sharding (r17, serve/shardplane.py).
+        # writer_shards=1 (the default, env GRAPHMINE_WRITER_SHARDS) is
+        # the EXACT pre-shard write path — no plane object exists, every
+        # branch below keys off `ts.plane is None`. Above 1, each
+        # tenant's namespace gets its own ShardedWritePlane (per-range
+        # WAL + admission + debt) and epoch coordinator; the whole-graph
+        # `wal=` and `standby_of=` knobs are mutually exclusive with it
+        # (durability and standby machinery move INTO the plane, one
+        # per range — double-logging every batch would make neither log
+        # authoritative).
+        if writer_shards is None:
+            writer_shards = writer_shards_from_env(1)
+        self.writer_shards = int(writer_shards)
+        if self.writer_shards > 1:
+            if self.wal is not None:
+                raise ValueError(
+                    "writer_shards > 1 owns per-range WALs under "
+                    f"{store.root}/shards; drop wal= (the plane logs "
+                    "every sub-batch itself)"
+                )
+            if standby_of is not None:
+                raise ValueError(
+                    "writer_shards > 1 replicates per range "
+                    "(plane.attach_standby), not per process; drop "
+                    "standby_of="
+                )
         # The epoch this writer stamps on publishes: adopt the store's
         # unless told otherwise (a promotion bumps it via promote()).
         self.writer_epoch = (
@@ -453,6 +495,8 @@ class SnapshotServer:
         self._engine = QueryEngine(snap)
         if self._shipper is not None:
             self.wal.protect_version = snap.version
+        if self.writer_shards > 1:
+            self._attach_plane(self._tenants[DEFAULT_TENANT], snap)
         self._ingestor: DeltaIngestor | None = None
         # One publisher at a time — the store's generation rotation (and
         # the ingestor's host state) assume it. Held by the apply worker
@@ -527,6 +571,15 @@ class SnapshotServer:
             # work the rollback evicted).
             self._reconcile_wal_cursor(snap, "startup")
             self._replay_wal(source="startup")
+        # Sharded-plane startup (r17): converge the epoch store first —
+        # a coordinator crash between stage and commit left either a
+        # finishable generation (re-commit) or a torn one (sweep); only
+        # then replay each range's accepted-but-unapplied WAL tail, so
+        # replayed applies build on the recovered committed epoch.
+        if self.writer_shards > 1:
+            self._replay_plane(
+                self._tenants[DEFAULT_TENANT], source="startup"
+            )
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> tuple[str, int]:
@@ -570,7 +623,7 @@ class SnapshotServer:
                 ts.queue.clear()
             self._rr.clear()
             for p in leftovers:
-                if p.seq is not None:
+                if p.seq is not None or p.shard_seqs:
                     p.status = "accepted"
                     p.result = self._accepted_payload(
                         p, note="server stopping; replays on restart",
@@ -595,6 +648,9 @@ class SnapshotServer:
         self._worker_stop = False
         if self.wal is not None:
             self.wal.close()
+        for ts in list(self._tenants.values()):
+            if ts.plane is not None:
+                ts.plane.close()
 
     def _ensure_worker(self) -> None:
         """Start the apply worker lazily (first delta) so in-process
@@ -704,10 +760,19 @@ class SnapshotServer:
             raise UnknownTenantError(tenant)
         ts = self._make_tenant_state(tenant, store, snap)
         with self._tenants_lock:
-            ts = self._tenants.setdefault(tenant, ts)
+            registered = self._tenants.setdefault(tenant, ts)
         self.tenancy.note(tenant)
-        self.tenancy.note_bytes(tenant, ts.engine.snapshot.nbytes)
-        return ts
+        self.tenancy.note_bytes(tenant, registered.engine.snapshot.nbytes)
+        if registered is ts and ts.plane is not None:
+            # Replay only AFTER the state is registered: replayed
+            # batches park on ts.queue and the worker resolves the
+            # tenant through self._tenants — parking work under an
+            # unregistered name would KeyError in the pop. (A lost
+            # setdefault race closes the plane we built for nothing.)
+            self._replay_plane(ts, source="tenant_admit")
+        elif registered is not ts and ts.plane is not None:
+            ts.plane.close()
+        return registered
 
     def _make_tenant_state(
         self, tenant: str, store: SnapshotStore, snap,
@@ -733,7 +798,38 @@ class SnapshotServer:
                 store.fence_epoch(self.writer_epoch)
             except (OSError, ValueError):
                 pass  # equal/lower epochs are already fenced
+        if self.writer_shards > 1:
+            # Tenancy × shardplane composition (r17): tenancy splits by
+            # namespace, the plane splits each namespace's range space —
+            # a lazily-admitted tenant gets its own full set of range
+            # writers and its own epoch chain.
+            self._attach_plane(ts, snap)
         return ts
+
+    def _attach_plane(self, ts: _TenantState, snap) -> None:
+        """Build one tenant's sharded write plane over its namespace
+        store and converge its epoch directory (finish or sweep a torn
+        publish) before anything can read or append. Non-default
+        tenants pass registry=None — same rule as their alert manager:
+        the per-shard gauge children are keyed by shard alone, and two
+        tenants' shard-0 series racing one child would be the
+        last-writer-wins bug tenancy exists to prevent."""
+        plan = ShardPlan.build(
+            self.writer_shards, int(len(snap["labels"]))
+        )
+        ts.plane = ShardedWritePlane(
+            ts.store, plan, sink=self._tenant_sink(ts.tenant),
+            registry=(
+                self.registry if ts.tenant == DEFAULT_TENANT else None
+            ),
+            tenant=ts.tenant,
+            # per-shard ladders inherit the server's envelope — a batch
+            # the front ladder admitted must not be re-shed by a shard
+            # ladder running tighter DEFAULTS than the operator set
+            admission_bounds=self.admission.bounds,
+        )
+        ts.plane.coordinator.recover()
+        ts.plane.note_versions(ts.plane.coordinator.version_vector())
 
     def _tenant_sink(self, tenant: str):
         """The sink a tenant's ingest/alert plane emits through: the
@@ -886,10 +982,10 @@ class SnapshotServer:
             )
         if ack not in (None, "wal"):
             raise ValueError(f"unknown ack mode {ack!r} (use 'wal')")
-        if ack == "wal" and self.wal is None:
+        if ack == "wal" and self.wal is None and ts.plane is None:
             raise ValueError(
                 "X-Delta-Ack: wal needs a server running with a "
-                "write-ahead log (serve --wal)"
+                "write-ahead log (serve --wal or --writer-shards)"
             )
         bound = ts.admission.bounds.deadline_s
         deadline_s = bound if deadline_s is None else max(
@@ -959,7 +1055,45 @@ class SnapshotServer:
         pending.trace = self._current_trace_header()
         pending.tenant = tenant
         try:
-            if self.wal is not None:
+            if ts.plane is not None:
+                # Sharded plane (r17): the plane splits the batch by
+                # dst-range ownership, runs each owner shard's admission
+                # ladder, dedupes (delta_id, shard) per shard, and
+                # fsyncs one sub-batch per touched range. The batch
+                # queues with the ORIGINAL unsplit delta — the apply
+                # splices exactly what a single-WAL server would, so
+                # published bytes are identical by construction.
+                try:
+                    sub = ts.plane.submit(
+                        delta, delta_id=delta_id or "",
+                        deadline_s=deadline_s,
+                        queue_depth=decision.queue_depth,
+                        applying=self._applying, trace=pending.trace,
+                    )
+                except ShardRangeUnavailableError as exc:
+                    ts.admission.emit_admission(decision, debt_at_resolve)
+                    ts.debt.shed(rows)
+                    ts.admission.record_shed(
+                        str(exc), rows, decision.queue_depth,
+                        ts.debt.snapshot(),
+                    )
+                    return self._shed_payload(
+                        str(exc), ts.admission.bounds.retry_after_s
+                    )
+                if sub["verdict"] == "duplicate":
+                    ts.admission.emit_admission(decision, debt_at_resolve)
+                    return self._duplicate_plane_payload(
+                        ts, delta_id or "", sub
+                    )
+                if sub["verdict"] == "shed":
+                    ts.admission.emit_admission(decision, debt_at_resolve)
+                    ts.debt.shed(rows)
+                    return self._shed_payload(
+                        sub["reason"], sub["retry_after_s"]
+                    )
+                pending.shard_seqs = sub["shard_seqs"]
+                pending.t_durable = time.monotonic()
+            elif self.wal is not None:
                 seq, dup = self.wal.append(
                     payload, delta_id=delta_id or "", deadline_s=deadline_s,
                     trace=pending.trace, tenant=tenant,
@@ -978,9 +1112,16 @@ class SnapshotServer:
             enqueued = False
             with self._queue_cv:
                 ts.reserved = max(0, ts.reserved - 1)
-                if not self._worker_stop and (
-                    pending.seq is not None or self.wal is None
-                ):
+                # In plane mode, only a plane-accepted batch (shard_seqs
+                # set) may queue: a plane shed/duplicate/refusal
+                # returning through this finally must not enqueue work
+                # the client was just told is NOT pending.
+                durable_ok = (
+                    pending.shard_seqs is not None
+                    if ts.plane is not None
+                    else (pending.seq is not None or self.wal is None)
+                )
+                if not self._worker_stop and durable_ok:
                     if pending.status == "queued":
                         # durable acknowledgements never deadline-shed;
                         # sync callers keep the client's budget
@@ -998,7 +1139,9 @@ class SnapshotServer:
                             self._rr.append(tenant)
                         self._queue_cv.notify_all()
                         enqueued = True
-                elif self._worker_stop and pending.seq is not None:
+                elif self._worker_stop and (
+                    pending.seq is not None or pending.shard_seqs
+                ):
                     # stop() won the race after the append: the batch is
                     # durable and replays on restart — acknowledged, not
                     # shed
@@ -1074,12 +1217,36 @@ class SnapshotServer:
         out = {
             "verdict": "accepted",
             "applied": False,
-            "durable": pending.seq is not None,
+            "durable": (
+                pending.seq is not None or bool(pending.shard_seqs)
+            ),
             "seq": pending.seq,
             "delta_id": pending.delta_id,
         }
+        if pending.shard_seqs:
+            out["shard_seqs"] = {
+                str(k): int(v) for k, v in pending.shard_seqs.items()
+            }
         if note:
             out["note"] = note
+        return out
+
+    def _duplicate_plane_payload(
+        self, ts: _TenantState, delta_id: str, sub: dict,
+    ) -> dict:
+        """A retried key EVERY touched shard already holds maps onto the
+        original accept (the per-shard twin of _duplicate_payload)."""
+        applied = bool(sub.get("applied"))
+        out = {
+            "verdict": "duplicate",
+            "delta_id": delta_id,
+            "shard_seqs": {
+                str(k): int(v) for k, v in sub["shard_seqs"].items()
+            },
+            "applied": applied,
+        }
+        if applied:
+            out["version"] = ts.engine.version
         return out
 
     def _duplicate_payload(
@@ -1102,6 +1269,11 @@ class SnapshotServer:
         """Tombstone a WAL-durable batch that was shed off the queue so
         a later replay can't resurrect work the client was told is NOT
         applied (its retry still dedupes-by-id into a fresh accept)."""
+        if pending.shard_seqs:
+            ts = self._tenants.get(pending.tenant)
+            if ts is not None and ts.plane is not None:
+                ts.plane.skip(pending.shard_seqs)
+            return
         if pending.seq is None or self.wal is None:
             return
         try:
@@ -1176,6 +1348,70 @@ class SnapshotServer:
             self.sink.emit(
                 "wal_replay", entries=n, from_seq=int(entries[0]["seq"]),
                 to_seq=int(entries[-1]["seq"]), source=source,
+            )
+        if n:
+            self._ensure_worker()
+        return n
+
+    def _replay_plane(self, ts: _TenantState, source: str = "startup") -> int:
+        """Per-range WAL replay (r17): each shard's accepted-but-
+        unapplied sub-batches re-enqueue as independent async batches.
+        Applying the sub-batches separately is semantically equal to the
+        original whole-batch apply — disjoint dst ranges mean disjoint
+        delete keys, so the per-shard applies commute (the splitter-
+        parity property tests/test_shardplane.py pins). Each replayed
+        batch carries exactly its own ``{shard: seq}`` pair, so the
+        commit after its publish advances only that range's log."""
+        n, lo_seq, hi_seq = 0, None, 0
+        for ws in ts.plane.shards:
+            if ws.read_only:
+                continue
+            for e in ws.wal.pending():
+                payload = e.get("payload") or {}
+                try:
+                    delta = EdgeDelta.from_pairs(
+                        insert=payload.get("insert", ()),
+                        delete=payload.get("delete", ()),
+                    )
+                except ValueError:
+                    continue  # the accept path parsed it once
+                rows = delta.num_inserts + delta.num_deletes
+                with self._queue_cv:
+                    if self._worker_stop:
+                        break
+                    debt_at = ws.debt.snapshot()
+                    decision = ws.admission.resolve(
+                        rows=rows,
+                        queue_depth=len(ts.queue) + ts.reserved,
+                        debt=debt_at, applying=self._applying,
+                        emit=False, replay=True,
+                    )
+                    ws.debt.submitted(rows)
+                    ts.debt.submitted(rows)
+                    p = _PendingDelta(delta, rows, math.inf, float(
+                        e.get("deadline_s")
+                        or ts.admission.bounds.deadline_s
+                    ))
+                    p.shard_seqs = {ws.shard: int(e["seq"])}
+                    p.delta_id = e.get("id", "")
+                    p.async_ack = True
+                    p.tenant = ts.tenant
+                    p.trace = e.get("trace", "")
+                    p.t_durable = p.t_accept
+                    ts.queue.append(p)
+                    if ts.tenant not in self._rr:
+                        self._rr.append(ts.tenant)
+                    self._queue_cv.notify_all()
+                ws.admission.emit_admission(decision, debt_at)
+                seq = int(e["seq"])
+                lo_seq = seq if lo_seq is None else min(lo_seq, seq)
+                hi_seq = max(hi_seq, seq)
+                n += 1
+        if n and self.sink is not None:
+            self.sink.emit(
+                "wal_replay", entries=n, from_seq=int(lo_seq),
+                to_seq=int(hi_seq), source=source, tenant=ts.tenant,
+                shards=ts.plane.plan.num_shards,
             )
         if n:
             self._ensure_worker()
@@ -1707,6 +1943,22 @@ class SnapshotServer:
                 # contiguous resolved run (never past an acked entry
                 # still in flight toward the queue).
                 self.wal.commit_applied(seqs, snap.version)
+            if ts.plane is not None:
+                # Sharded plane (r17): advance each touched range's WAL
+                # watermark, then two-phase-publish the epoch — stage
+                # every range's arrays, durably commit the epoch →
+                # version-vector record. Readers key off the committed
+                # epoch, so a multi-range group becomes visible
+                # atomically (or, on a torn commit, not at all: the
+                # previous epoch stays served and startup recovery
+                # finishes or sweeps the stage).
+                merged_seqs: dict[int, list] = {}
+                for p in group:
+                    for s, q in (p.shard_seqs or {}).items():
+                        merged_seqs.setdefault(int(s), []).append(int(q))
+                if merged_seqs:
+                    ts.plane.commit_applied(merged_seqs, snap.version)
+                self._publish_epoch(ts, snap)
         self._emit_delta_stages(group, snap, t_apply_start)
         # Publish-time alert evaluation (outside the delta lock — a
         # record fsync must not serialize handlers): a quality or canary
@@ -1724,6 +1976,34 @@ class SnapshotServer:
             "coalesced": len(group),
             "lof_stale": bool(snap.meta.get("lof_stale", False)),
         }
+
+    def _publish_epoch(self, ts: _TenantState, snap) -> int:
+        """Stage + commit the next publish epoch (r17, two-phase): each
+        range's slice of the per-vertex result arrays lands in its own
+        shard directory (the r2 sharded-checkpoint manifest format — no
+        gather through one writer), then the coordinator durably commits
+        epoch → version vector under the store's fence lock. Growth rows
+        (vertices born past the plan) ride with the LAST range, same
+        rule as the splitter's ownership."""
+        plane = ts.plane
+        labels = np.asarray(snap["labels"])
+        lof = snap.get("lof")
+        n = len(labels)
+        shard_arrays: dict[int, dict] = {}
+        versions: dict[int, int] = {}
+        last = plane.plan.num_shards - 1
+        for ws in plane.shards:
+            lo = min(ws.lo, n)
+            hi = n if ws.shard == last else min(ws.hi, n)
+            arrs = {"labels": labels[lo:hi]}
+            if lof is not None:
+                arrs["lof"] = np.asarray(lof)[lo:hi]
+            shard_arrays[ws.shard] = arrs
+            versions[ws.shard] = int(ws.version)
+        epoch = plane.coordinator.committed_epoch() + 1
+        plane.coordinator.stage(epoch, shard_arrays, versions=versions)
+        plane.coordinator.commit(epoch, plane.version_vector())
+        return epoch
 
     # -- per-delta time-to-visible stages ---------------------------------
     def _emit_delta_stages(self, group: list, snap, t_apply_start: float):
@@ -2031,6 +2311,23 @@ class SnapshotServer:
                 out["replication_lag_s"] = ship["lag_s"]
         if self.wal is not None:
             out["wal"] = self.wal.snapshot()
+        dts = self._tenants[DEFAULT_TENANT]
+        if dts.plane is not None:
+            # Sharded-plane probe surface (r17): the committed epoch and
+            # the per-range version vector — the router's /healthz
+            # aggregates these fleet-wide, and the fleet prober's
+            # mixed-epoch guard keys off them.
+            out["writer_shards"] = self.writer_shards
+            out["epoch"] = dts.plane.coordinator.committed_epoch()
+            out["shard_versions"] = {
+                str(k): int(v)
+                for k, v in dts.plane.version_vector().items()
+            }
+            degraded = [
+                ws.shard for ws in dts.plane.shards if ws.read_only
+            ]
+            if degraded:
+                out["degraded_shards"] = degraded
         if not ready:
             out["not_ready_reason"] = not_ready_why
         if overloaded:
@@ -2242,6 +2539,13 @@ class SnapshotServer:
         }
         if self.wal is not None:
             payload["wal"] = self.wal.snapshot()
+        dts = self._tenants[DEFAULT_TENANT]
+        if dts.plane is not None:
+            # Per-shard WAL/admission/debt children (r17): the single
+            # "wal" section becomes a per-range table — one entry per
+            # shard, mirroring the per-shard-labeled gauge children on
+            # /metrics.
+            payload["shardplane"] = dts.plane.snapshot()
         if self._shipper is not None:
             payload["replication"] = self._shipper.snapshot()
         if self.sink is not None:

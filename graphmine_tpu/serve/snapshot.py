@@ -71,6 +71,11 @@ from graphmine_tpu.pipeline.checkpoint import (
 MANIFEST_NAME = "manifest.json"
 EPOCH_NAME = "EPOCH"
 TENANTS_DIRNAME = "tenants"
+# Sharded-write-plane publish epochs (r17, serve/shardplane.py): staged
+# per-range generations and their durable commit records live under
+# <root>/epochs — beside the snapshot chain, namespaced per tenant like
+# everything else under the root.
+EPOCHS_DIRNAME = "epochs"
 _FORMAT_VERSION = 1
 
 
@@ -232,6 +237,15 @@ class SnapshotStore:
         finally:
             fcntl.flock(fd, fcntl.LOCK_UN)
             os.close(fd)
+
+    def fence_lock(self):
+        """The store's inter-process fence lock as a public context
+        manager — the serialization point the sharded write plane's
+        epoch coordinator commits under (r17): epoch minting, per-range
+        promotion fencing and the two-phase publish commit all take THIS
+        lock, so a deposed coordinator and a promotion can never
+        interleave their commit records."""
+        return self._fence_lock()
 
     def _fence_file_epoch(self) -> int:
         try:

@@ -201,8 +201,14 @@ class WriteAheadLog:
         sink=None,
         registry=None,
         read_only: bool = False,
+        shard: int | None = None,
     ):
         self.root = root
+        # Writer-shard identity (r17 shardplane): when set, the seq
+        # gauges export as per-shard-labeled children
+        # (``...{shard="2"}``) — one unlabeled gauge would silently
+        # average a dead shard's backlog into healthy ranges.
+        self.shard = None if shard is None else int(shard)
         # read_only opens a FOREIGN log (a promotion reading the deposed
         # primary's directory): scan must not repair — truncating a
         # "torn" tail that is really the still-alive zombie's in-flight
@@ -948,17 +954,23 @@ class WriteAheadLog:
         if reg is None:
             return
         snap = self.snapshot()
+        # A shard-owned WAL exports labeled children; the single-writer
+        # log keeps the exact pre-shard unlabeled series.
+        lab = {} if self.shard is None else {"shard": str(self.shard)}
         reg.gauge(
             "graphmine_serve_wal_last_seq",
             "highest sequence number appended to the write-ahead log",
+            **lab,
         ).set(snap["last_seq"])
         reg.gauge(
             "graphmine_serve_wal_applied_seq",
             "WAL watermark: entries at or below this seq are published",
+            **lab,
         ).set(snap["applied_seq"])
         reg.gauge(
             "graphmine_serve_wal_pending_entries",
             "WAL entries accepted but not yet in a published snapshot",
+            **lab,
         ).set(snap["pending_entries"])
         # memory plane (ISSUE 14): retained-segment bytes on the same
         # scrape as the seq gauges — the WAL's share of the serve
@@ -1007,8 +1019,12 @@ class LogShipper:
         batch_limit: int = 512,
         sink=None,
         registry=None,
+        shard: int | None = None,
     ):
         self.wal = wal
+        # Per-range shipping lane (r17): labels the lag gauges so one
+        # range's replication stall never hides inside a plane average.
+        self.shard = None if shard is None else int(shard)
         self.primary_url = primary_url.rstrip("/")
         self.poll_interval_s = float(poll_interval_s)
         self.timeout_s = float(timeout_s)
@@ -1132,11 +1148,14 @@ class LogShipper:
         reg = self.registry
         if reg is None:
             return
+        lab = {} if self.shard is None else {"shard": str(self.shard)}
         reg.gauge(
             "graphmine_serve_replication_lag_entries",
             "WAL entries the standby has not yet shipped from the primary",
+            **lab,
         ).set(snap["lag_entries"])
         reg.gauge(
             "graphmine_serve_replication_lag_seconds",
             "how long the standby has been behind the primary's WAL",
+            **lab,
         ).set(snap["lag_s"])

@@ -47,6 +47,13 @@ writer loss whose zombie publish the epoch fence must refuse, and
 :func:`ship_lag` congests the standby's log shipping so the replication
 lag gauges — and the promotion's loss-bound story — are testable
 (tests/test_wal.py).
+
+Sharded-write-plane faults (ISSUE 17): :func:`writer_shard_kill` kills
+ONE vertex-range writer shard (its range flips read-only while the rest
+keep accepting), and :func:`shard_publish_torn` crashes the epoch
+coordinator between stage and commit — the torn two-phase publish whose
+recovery must leave the previous epoch served
+(tests/test_shardplane.py).
 """
 
 from __future__ import annotations
@@ -430,6 +437,39 @@ def ship_lag(server_or_shipper, seconds: float) -> None:
             "LogShipper"
         )
     shipper.chaos_delay_s = float(seconds)
+
+
+def writer_shard_kill(server, shard: int, tenant: str = "default") -> None:
+    """Kill ONE vertex-range writer shard of a sharded write plane
+    (r17, serve/shardplane.py): the shard's WAL handle closes
+    un-flushed and its range flips read-only — batches touching it
+    refuse 503 while every OTHER range keeps accepting writes. The
+    restart is ``plane.restart_shard(shard)`` (per-range WAL replay;
+    acked sub-batches survive by append-time fsync) or a standby
+    promotion via ``plane.promote_shard``. Acts on an in-process
+    SnapshotServer started with ``writer_shards > 1``."""
+    ts = server._tenants.get(tenant)
+    plane = getattr(ts, "plane", None) if ts is not None else None
+    if plane is None:
+        raise ValueError(
+            f"writer_shard_kill needs a server running with "
+            f"writer_shards > 1 (tenant {tenant!r} has no shard plane)"
+        )
+    plane.kill_shard(int(shard), reason="writer_shard_kill")
+
+
+def shard_publish_torn(at: int = 1, repeat: int = 1) -> FaultInjector:
+    """A coordinator crash BETWEEN stage and commit (r17): every shard's
+    per-range arrays are staged, the ``publish_epoch`` record is never
+    written. Returns a ready-to-install :class:`FaultInjector` targeting
+    the ``shard_publish_commit`` seam (inside the store's fence lock,
+    before the stage→final rename). The recovery contract: readers keep
+    serving the PREVIOUS committed epoch, and the next startup's
+    ``EpochCoordinator.recover()`` finishes the staged generation (or
+    sweeps an incomplete one) — never a half-visible epoch."""
+    inj = FaultInjector()
+    inj.add("shard_publish_commit", preemption, at=at, repeat=repeat)
+    return inj
 
 
 def replica_stale(server, hold: bool = True) -> None:

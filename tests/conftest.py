@@ -193,6 +193,16 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "shardplane: sharded-write-plane suite (tests/test_shardplane.py: "
+        "vertex-range plan ownership, deterministic delta-splitter "
+        "bit-parity vs sequential whole-batch apply, epoch "
+        "stage/commit/recover incl. the torn-publish drill, per-range "
+        "failover and the 3-shard/2-tenant shard-kill chaos acceptance "
+        "test); runs in the default CPU pass — select with -m shardplane "
+        "or tools/run_tier1.sh --shardplane-only",
+    )
+    config.addinivalue_line(
+        "markers",
         "slo: serving-SLO observability suite (tests/test_slo.py: "
         "bucket histograms + merge associativity, live /metrics and "
         "/statusz under the query hammer, quantile agreement vs the "

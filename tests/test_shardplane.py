@@ -1,0 +1,824 @@
+"""Sharded-write-plane suite (marker ``shardplane``): the ISSUE 17
+no-single-point-of-failure contract — tools/run_tier1.sh
+--shardplane-only.
+
+The acceptance pins:
+- ``ShardPlan`` cuts the id space into k contiguous vertex ranges (the
+  last shard owns growth ids) and ownership is deterministic;
+- the delta splitter routes every insert AND delete to its dst owner,
+  ``merge_splits`` is a bit-exact inverse, and split-then-apply equals
+  sequential whole-batch apply — labels, LOF and weights bit-identical,
+  cross-range deletes included;
+- publishes are epoch-coordinated two-phase commits: the durable
+  ``publish_epoch`` record is THE commit point, a torn publish (crash
+  between stage and commit) leaves the previous epoch served and is
+  finished or swept by ``recover()``;
+- shard death flips ONLY its range read-only (untouched ranges keep
+  accepting), restart/standby-promotion replays the acked tail with
+  zero acked-delta loss, and a 3-shard/2-tenant plane survives a
+  mid-burst shard kill with zero mixed-epoch reads;
+- ``GRAPHMINE_WRITER_SHARDS=1`` (the default) is the exact pre-shard
+  path — the plane is never constructed, published bytes identical.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from graphmine_tpu.graph.container import build_graph
+from graphmine_tpu.obs.schema import validate_records
+from graphmine_tpu.obs.spans import Tracer
+from graphmine_tpu.pipeline.checkpoint import graph_fingerprint
+from graphmine_tpu.pipeline.metrics import MetricsSink
+from graphmine_tpu.serve import SnapshotStore
+from graphmine_tpu.serve.admission import AdmissionBounds, AdmissionController
+from graphmine_tpu.serve.delta import EdgeDelta, cold_recompute, splice_edges
+from graphmine_tpu.serve.server import SnapshotServer
+from graphmine_tpu.serve.shardplane import (
+    EpochCoordinator,
+    ShardPlan,
+    ShardRangeUnavailableError,
+    ShardedWritePlane,
+    emit_shard_record,
+    merge_splits,
+    split_delta,
+    writer_shards_from_env,
+)
+from graphmine_tpu.testing import faults
+
+pytestmark = pytest.mark.shardplane
+
+
+# ---- fixtures -------------------------------------------------------------
+
+
+def _clique(lo, hi):
+    ids = np.arange(lo, hi)
+    s, d = np.meshgrid(ids, ids)
+    m = s.ravel() < d.ravel()
+    return s.ravel()[m], d.ravel()[m]
+
+
+def _cliques(spans):
+    parts = [_clique(lo, hi) for lo, hi in spans]
+    src = np.concatenate([p[0] for p in parts]).astype(np.int32)
+    dst = np.concatenate([p[1] for p in parts]).astype(np.int32)
+    return src, dst, max(hi for _, hi in spans)
+
+
+def _sink():
+    return MetricsSink(tracer=Tracer())
+
+
+def _publish(store, src, dst, v, weights=None, sink=None):
+    g = build_graph(src, dst, num_vertices=v)
+    labels, cc, _ = cold_recompute(g)
+    arrays = {
+        "src": src, "dst": dst, "labels": labels, "cc_labels": cc,
+        "lof": np.linspace(0.5, 1.2, v).astype(np.float32),
+    }
+    if weights is not None:
+        arrays["weights"] = np.asarray(weights, np.float32)
+    store.publish(
+        arrays, fingerprint=graph_fingerprint(src, dst), sink=sink,
+    )
+    return store
+
+
+def _generous():
+    return AdmissionController(bounds=AdmissionBounds(
+        max_pending_rows=100_000, max_queue_depth=64, deadline_s=300.0,
+    ))
+
+
+def _get(host, port, path, headers=None):
+    req = urllib.request.Request(
+        f"http://{host}:{port}{path}", headers=headers or {}
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _post(host, port, path, payload, headers=None):
+    req = urllib.request.Request(
+        f"http://{host}:{port}{path}", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+# ---- range plan ------------------------------------------------------------
+
+
+def test_shard_plan_ownership_properties(monkeypatch):
+    """Contiguous cover, ceil-width chunks, growth ids to the LAST
+    shard, scalar/vector ownership agreement, env parsing."""
+    plan = ShardPlan.build(3, 100)
+    assert plan.boundaries == (0, 34, 68, 100)
+    assert [r["shard"] for r in plan.ranges()] == [0, 1, 2]
+    assert plan.ranges()[-1]["owns_growth"] is True
+    # every id in [0, v) owned by exactly the range that contains it
+    ids = np.arange(120)  # includes growth ids >= 100
+    owners = plan.owners(ids)
+    for i in (0, 33, 34, 67, 68, 99):
+        lo, hi = plan.range_of(plan.owner_of(i))
+        assert lo <= i < hi
+        assert owners[i] == plan.owner_of(i)
+    # growth: ids beyond num_vertices belong to the last shard
+    assert plan.owner_of(100) == 2
+    assert (owners[100:] == 2).all()
+
+    one = ShardPlan.build(1, 100)
+    assert one.boundaries == (0, 100)
+    assert (one.owners(ids) == 0).all()
+
+    with pytest.raises(ValueError):
+        ShardPlan.build(0, 100)
+
+    monkeypatch.delenv("GRAPHMINE_WRITER_SHARDS", raising=False)
+    assert writer_shards_from_env() == 1
+    monkeypatch.setenv("GRAPHMINE_WRITER_SHARDS", "4")
+    assert writer_shards_from_env() == 4
+    assert ShardPlan.from_env(100).num_shards == 4
+    for bad in ("0", "-2", "three", "1.5", ""):
+        monkeypatch.setenv("GRAPHMINE_WRITER_SHARDS", bad)
+        with pytest.raises(ValueError):
+            writer_shards_from_env()
+
+
+def test_emit_shard_record_is_the_single_builder():
+    """Unknown phases are refused at the builder (the schema_lint twin:
+    no other call site may emit these phases at all) and a ``None``
+    sink is a no-op."""
+    sink = _sink()
+    emit_shard_record(sink, "shard_publish", epoch=1, shard=0, version=1,
+                      arrays=["labels"])
+    emit_shard_record(None, "epoch_commit", epoch=1)  # no-op, no raise
+    assert sink.records[-1]["phase"] == "shard_publish"
+    with pytest.raises(ValueError):
+        emit_shard_record(sink, "shard_published", epoch=1)
+    with pytest.raises(ValueError):
+        emit_shard_record(sink, "delta_apply")  # registered, not ours
+
+
+# ---- deterministic splitter ------------------------------------------------
+
+
+def test_split_merge_bit_identity_randomized():
+    """N random batches (weighted and not, growth inserts, cross-range
+    and unmatched deletes) split and scatter back bit-identically, and
+    every sub-batch's rows all belong to its shard's dst range."""
+    rng = np.random.default_rng(17)
+    for trial in range(20):
+        k = int(rng.integers(1, 6))
+        v = int(rng.integers(k, 60))
+        plan = ShardPlan.build(k, v)
+        n_ins = int(rng.integers(0, 30))
+        n_del = int(rng.integers(0, 20))
+        d = EdgeDelta(
+            rng.integers(0, v, n_ins),
+            # some inserts hit growth ids beyond v
+            rng.integers(0, v + 10, n_ins),
+            rng.integers(0, v, n_del),
+            rng.integers(0, v, n_del),
+            insert_weight=(
+                rng.random(n_ins).astype(np.float32)
+                if trial % 2 else None
+            ),
+        )
+        splits = split_delta(d, plan)
+        # partition: every original row appears in exactly one split
+        all_ins = np.concatenate(
+            [sp.insert_index for sp in splits]
+        ) if splits else np.empty(0)
+        all_del = np.concatenate([sp.delete_index for sp in splits])
+        assert sorted(all_ins) == list(range(n_ins))
+        assert sorted(all_del) == list(range(n_del))
+        for sp in splits:
+            lo, hi = plan.range_of(sp.shard)
+            owns_growth = sp.shard == plan.num_shards - 1
+            for dst in sp.delta.insert_dst:
+                assert lo <= dst < hi or (owns_growth and dst >= v)
+            for dst in sp.delta.delete_dst:
+                assert lo <= dst < hi or (owns_growth and dst >= v)
+        m = merge_splits(splits)
+        np.testing.assert_array_equal(m.insert_src, d.insert_src)
+        np.testing.assert_array_equal(m.insert_dst, d.insert_dst)
+        np.testing.assert_array_equal(m.delete_src, d.delete_src)
+        np.testing.assert_array_equal(m.delete_dst, d.delete_dst)
+        if d.insert_weight is None:
+            assert m.insert_weight is None or n_ins == 0
+        else:
+            np.testing.assert_array_equal(m.insert_weight, d.insert_weight)
+
+
+def test_split_then_splice_parity_randomized():
+    """Applying a batch's splits one-by-one produces the same edge
+    multiset, vertex count, delete accounting and (recomputed) labels
+    as one whole-batch splice — cross-range deletes included. Unique
+    edge keys per trial so multiset comparison is exact."""
+    rng = np.random.default_rng(29)
+    for trial in range(8):
+        v = int(rng.integers(12, 40))
+        k = int(rng.integers(2, 5))
+        src, dst, _ = _cliques([(0, v // 2), (v // 2, v)])
+        w = (rng.integers(1, 16, len(src)) / 4.0).astype(np.float32)
+        plan = ShardPlan.build(k, v)
+        # inserts: fresh unique pairs (some growth); deletes: a sample
+        # of existing edges — src and dst often land in DIFFERENT
+        # ranges, the cross-range rule under test
+        n_ins = int(rng.integers(1, 12))
+        ins = rng.choice(v * (v + 8), size=n_ins, replace=False)
+        isrc, idst = ins % v, ins // v
+        del_idx = rng.choice(
+            len(src), size=int(rng.integers(1, 6)), replace=False
+        )
+        d = EdgeDelta(
+            isrc, idst, src[del_idx], dst[del_idx],
+            insert_weight=(
+                (rng.integers(1, 8, n_ins) / 4.0).astype(np.float32)
+                if trial % 2 else None
+            ),
+        )
+        weighted = d.insert_weight is not None
+
+        def run(parts):
+            s, dd, ww, vv = src, dst, w, v
+            stats_sum = {"inserted": 0, "deleted": 0, "unmatched_deletes": 0}
+            for p in parts:
+                s, dd, ww, vv, st = splice_edges(s, dd, vv, p, weights=ww)
+                for key in stats_sum:
+                    stats_sum[key] += st[key]
+            return s, dd, ww, vv, stats_sum
+
+        whole = run([d])
+        parts = run([sp.delta for sp in split_delta(d, plan)])
+        assert whole[3] == parts[3]  # num_vertices
+        assert whole[4] == parts[4]  # inserted/deleted/unmatched sums
+        # edge MULTISET identical (order differs by construction: the
+        # split path appends per-shard); weights ride their edges
+        def canon(s, dd, ww):
+            order = np.lexsort((ww, dd, s))
+            return s[order], dd[order], ww[order]
+        for a, b in zip(canon(*whole[:3]), canon(*parts[:3])):
+            np.testing.assert_array_equal(a, b)
+        # recomputed labels/cc bit-identical over the identical multiset
+        ga = build_graph(whole[0], whole[1], num_vertices=whole[3])
+        gb = build_graph(parts[0], parts[1], num_vertices=parts[3])
+        la, ca, _ = cold_recompute(ga)
+        lb, cb, _ = cold_recompute(gb)
+        np.testing.assert_array_equal(la, lb)
+        np.testing.assert_array_equal(ca, cb)
+        assert weighted == (d.insert_weight is not None)
+
+
+# ---- epoch-coordinated publish ---------------------------------------------
+
+
+def _coordinator(tmp_path, k=3, v=30, sink=None):
+    src, dst, v = _cliques([(0, v // 2), (v // 2, v)])
+    store = SnapshotStore(str(tmp_path / "snap"))
+    _publish(store, src, dst, v)
+    plan = ShardPlan.build(k, v)
+    return EpochCoordinator(store, plan, sink=sink), plan, v
+
+
+def _shard_arrays(plan, v, fill=0):
+    out = {}
+    for s in range(plan.num_shards):
+        lo, hi = plan.range_of(s)
+        out[s] = {"labels": np.arange(lo, hi, dtype=np.int32) + fill}
+    return out
+
+
+def test_epoch_stage_commit_read_roundtrip(tmp_path):
+    """stage → commit → read: the record is the commit point, arrays
+    verify against their manifests, the version vector round-trips, and
+    only RETAIN_EPOCHS generations survive."""
+    sink = _sink()
+    coord, plan, v = _coordinator(tmp_path, sink=sink)
+    assert coord.committed_epoch() == 0
+    assert coord.read_epoch() is None
+
+    coord.stage(1, _shard_arrays(plan, v), versions={0: 2, 1: 2, 2: 2})
+    # staged but uncommitted: nothing served
+    assert coord.committed_epoch() == 0
+    coord.commit(1, {0: 2, 1: 2, 2: 2})
+    assert coord.committed_epoch() == 1
+    got = coord.read_epoch()
+    assert got["epoch"] == 1
+    assert got["version_vector"] == {0: 2, 1: 2, 2: 2}
+    lo, hi = plan.range_of(1)
+    np.testing.assert_array_equal(
+        got["shards"][1]["arrays"]["labels"], np.arange(lo, hi)
+    )
+
+    for e, ver in ((2, 3), (3, 4), (4, 5)):
+        coord.stage(e, _shard_arrays(plan, v, fill=e),
+                    versions={s: ver for s in range(3)})
+        coord.commit(e, {s: ver for s in range(3)})
+    assert coord.committed_epoch() == 4
+    assert coord.committed_epochs() == [3, 4]  # RETAIN_EPOCHS = 2
+    assert coord.version_vector() == {0: 5, 1: 5, 2: 5}
+
+    phases = [r["phase"] for r in sink.records]
+    assert phases.count("shard_publish") == 12  # 4 epochs x 3 shards
+    assert phases.count("epoch_commit") == 4
+    assert validate_records(sink.records) == []
+
+
+def test_torn_publish_serves_previous_epoch_and_recovers(tmp_path):
+    """THE torn-publish drill: a crash injected at the
+    ``shard_publish_commit`` seam (everything staged, nothing
+    committed) leaves the previous epoch served in full; ``recover()``
+    finishes the complete generation. An INCOMPLETE stage (a shard's
+    array file lost) is swept instead — never half-committed."""
+    sink = _sink()
+    coord, plan, v = _coordinator(tmp_path, sink=sink)
+    coord.stage(1, _shard_arrays(plan, v), versions={s: 2 for s in range(3)})
+    coord.commit(1, {s: 2 for s in range(3)})
+
+    coord.stage(2, _shard_arrays(plan, v, fill=9),
+                versions={s: 3 for s in range(3)})
+    inj = faults.shard_publish_torn()
+    with inj.installed():
+        with pytest.raises(Exception):
+            coord.commit(2, {s: 3 for s in range(3)})
+    # the coordinator "crashed" between stage and commit: epoch 1 is
+    # still served, whole and verifiable
+    assert coord.committed_epoch() == 1
+    assert coord.read_epoch()["version_vector"] == {0: 2, 1: 2, 2: 2}
+
+    rec = coord.recover()
+    assert coord.committed_epoch() == 2
+    assert coord.version_vector() == {0: 3, 1: 3, 2: 3}
+    assert any(r["phase"] == "epoch_commit" and r.get("recovered")
+               for r in sink.records)
+
+    # incomplete stage: lose one shard's array file → recover sweeps
+    coord.stage(3, _shard_arrays(plan, v, fill=4),
+                versions={s: 4 for s in range(3)})
+    stage = coord._stage_dir(3)
+    os.remove(os.path.join(stage, "shard-001", "labels.npy"))
+    coord.recover()
+    assert coord.committed_epoch() == 2
+    assert not os.path.exists(stage)
+    assert rec is not None
+    assert validate_records(sink.records) == []
+
+
+# ---- the plane: submit / dedupe / per-range failover -----------------------
+
+
+def test_plane_submit_dedupe_shed_and_range_refusal(tmp_path):
+    """Direct plane contract: accepted batches return per-shard seqs,
+    a retried id every touched shard holds is a duplicate, one
+    saturated range sheds the WHOLE batch, and a dead range raises the
+    structured 503 while untouched ranges keep accepting."""
+    src, dst, v = _cliques([(0, 15), (15, 30)])
+    store = SnapshotStore(str(tmp_path / "snap"))
+    _publish(store, src, dst, v)
+    plan = ShardPlan.build(3, v)  # ranges [0,10) [10,20) [20,30)
+    plane = ShardedWritePlane(
+        store, plan, sink=_sink(),
+        admission_bounds=AdmissionBounds(
+            max_pending_rows=100, max_queue_depth=8, deadline_s=300.0,
+        ),
+    )
+    try:
+        cross = EdgeDelta.from_pairs(insert=[[1, 2], [1, 12], [1, 25]])
+        sub = plane.submit(cross, delta_id="d1")
+        assert sub["verdict"] == "accepted"
+        assert sorted(sub["shard_seqs"]) == [0, 1, 2]
+
+        # clean retry: every touched shard already holds d1
+        again = plane.submit(cross, delta_id="d1")
+        assert again["verdict"] == "duplicate"
+        assert again["shard_seqs"] == sub["shard_seqs"]
+
+        # watermarks advance per shard; the version vector follows
+        plane.commit_applied(sub["shard_seqs"], version=2)
+        assert plane.version_vector() == {0: 2, 1: 2, 2: 2}
+
+        # all-or-nothing: saturate shard 1's ladder → whole batch sheds,
+        # nothing appended anywhere
+        before = {
+            ws.shard: ws.wal.last_seq for ws in plane.shards
+        }
+        plane.shards[1].debt.submitted(10_000)
+        shed = plane.submit(
+            EdgeDelta.from_pairs(insert=[[0, 1], [0, 15]]), delta_id="d2",
+        )
+        assert shed["verdict"] == "shed"
+        assert "shard 1" in shed["reason"]
+        assert {
+            ws.shard: ws.wal.last_seq for ws in plane.shards
+        } == before
+        from graphmine_tpu.serve.delta import RepairDebt
+
+        plane.shards[1].debt = RepairDebt()  # drop the synthetic backlog
+
+        # dead range: only batches TOUCHING it are refused
+        plane.kill_shard(1, reason="writer_shard_kill")
+        with pytest.raises(ShardRangeUnavailableError) as e:
+            plane.submit(EdgeDelta.from_pairs(insert=[[0, 12]]))
+        assert e.value.shards == (1,)
+        assert "degraded vertex range" in str(e.value)
+        ok = plane.submit(
+            EdgeDelta.from_pairs(insert=[[0, 1]]), delta_id="d3",
+        )
+        assert ok["verdict"] == "accepted"
+        assert list(ok["shard_seqs"]) == [0]
+
+        # restart: the acked-but-unapplied tail comes back for replay
+        pending = plane.restart_shard(1)
+        assert [p["id"] for p in pending] == ["d1"] or pending == []
+        after = plane.submit(EdgeDelta.from_pairs(insert=[[0, 13]]))
+        assert after["verdict"] == "accepted"
+    finally:
+        plane.close()
+
+
+def test_plane_standby_ship_promote_is_fenced(tmp_path):
+    """Per-range standby: ship copies the WAL verbatim, promotion mints
+    a store epoch (the fence) and reopens the range with zero acked
+    loss — the §"Replicated writers" dance, per range."""
+    src, dst, v = _cliques([(0, 15), (15, 30)])
+    store = SnapshotStore(str(tmp_path / "snap"))
+    _publish(store, src, dst, v)
+    plane = ShardedWritePlane(store, ShardPlan.build(2, v), sink=_sink())
+    try:
+        plane.attach_standby(1)
+        s1 = plane.submit(
+            EdgeDelta.from_pairs(insert=[[0, 20], [1, 21]]), delta_id="a",
+        )
+        assert plane.ship_shard(1) == 1  # one entry copied verbatim
+        epoch_before = store.current_epoch()
+
+        plane.kill_shard(1)
+        out = plane.promote_shard(1)
+        assert out["epoch"] == epoch_before + 1
+        assert [p["id"] for p in out["pending"]] == ["a"]
+        # the promoted WAL holds the acked seq and the range is live
+        assert not plane.shards[1].read_only
+        assert plane.shards[1].wal.last_seq == s1["shard_seqs"][1]
+        ok = plane.submit(EdgeDelta.from_pairs(insert=[[2, 22]]))
+        assert ok["verdict"] == "accepted"
+        # no standby anymore: a second promote demands a fresh attach
+        with pytest.raises(ValueError):
+            plane.promote_shard(1)
+    finally:
+        plane.close()
+
+
+# ---- server integration ----------------------------------------------------
+
+
+def test_writer_shards_one_is_exact_preshard_path(tmp_path, monkeypatch):
+    """The default (1 shard) never builds a plane, composes with
+    ``wal=`` exactly as before, and publishes byte-identical arrays to
+    a pre-shard server fed the same deltas. Plane mode refuses
+    ``wal=``/``standby_of=`` loudly."""
+    monkeypatch.setenv("GRAPHMINE_QUALITY", "0")
+    src, dst, v = _cliques([(0, 12), (12, 26)])
+    deltas = [
+        {"insert": [[0, 14], [3, 20]], "delete": []},
+        {"insert": [[5, 30]], "delete": [[0, 14]]},
+    ]
+
+    def run(root, **kw):
+        store = SnapshotStore(str(tmp_path / root))
+        _publish(store, src, dst, v)
+        server = SnapshotServer(store, admission=_generous(), **kw)
+        try:
+            for p in deltas:
+                out = server.apply_delta(dict(p))
+                assert out.get("verdict") in (None, "accepted")
+                server.wait_applied(timeout=120.0)
+        finally:
+            server.stop()
+        return store.load()
+
+    base = run("a")
+    explicit = run("b", writer_shards=1)
+    assert explicit.version == base.version
+    for name in ("src", "dst", "labels", "cc_labels", "lof"):
+        np.testing.assert_array_equal(explicit[name], base[name])
+    # 1-shard servers have no plane and no epochs directory
+    assert not os.path.exists(str(tmp_path / "b" / "epochs"))
+
+    monkeypatch.setenv("GRAPHMINE_WRITER_SHARDS", "1")
+    store = SnapshotStore(str(tmp_path / "c"))
+    _publish(store, src, dst, v)
+    s = SnapshotServer(store, admission=_generous())
+    try:
+        assert s.writer_shards == 1
+        assert s._tenants["default"].plane is None
+    finally:
+        s.stop()
+
+    monkeypatch.setenv("GRAPHMINE_WRITER_SHARDS", "3")
+    with pytest.raises(ValueError):
+        SnapshotServer(store, wal=str(tmp_path / "w"))
+
+
+def test_sharded_apply_bit_identical_to_single_writer(tmp_path, monkeypatch):
+    """THE randomized parity satellite at the system level: N random
+    batches (weighted inserts, growth vertices, cross-range deletes)
+    through a 3-shard plane and through the classic single-WAL writer —
+    every published array (labels, LOF, weights, edges) bit-identical."""
+    monkeypatch.setenv("GRAPHMINE_QUALITY", "0")
+    rng = np.random.default_rng(23)
+    src, dst, v = _cliques([(0, 12), (12, 26), (26, 40)])
+    w = (rng.integers(1, 16, len(src)) / 4.0).astype(np.float32)
+
+    batches = []
+    cur_edges = list(zip(src.tolist(), dst.tolist()))
+    for _ in range(6):
+        ins = [
+            [int(rng.integers(0, v)), int(rng.integers(0, v + 6)),
+             float(rng.integers(1, 8)) / 4.0]
+            for _ in range(int(rng.integers(1, 6)))
+        ]
+        # cross-range deletes: sample real edges (src/dst often owned
+        # by different shards)
+        k = int(rng.integers(0, 3))
+        dels = [list(cur_edges[i]) for i in
+                rng.choice(len(cur_edges), size=k, replace=False)]
+        for e in dels:
+            cur_edges.remove((e[0], e[1]))
+        cur_edges.extend((r[0], r[1]) for r in ins)
+        batches.append({"insert": ins, "delete": dels})
+
+    def run(root, shards):
+        store = SnapshotStore(str(tmp_path / root))
+        _publish(store, src, dst, v, weights=w)
+        server = SnapshotServer(
+            store, admission=_generous(),
+            wal=str(tmp_path / root / "wal") if shards == 1 else None,
+            writer_shards=shards,
+        )
+        try:
+            for i, p in enumerate(batches):
+                out = server.apply_delta(dict(p), delta_id=f"b{i}")
+                assert out.get("verdict") in (None, "accepted"), out
+                server.wait_applied(timeout=120.0)
+        finally:
+            server.stop()
+        return store.load()
+
+    one = run("one", 1)
+    three = run("three", 3)
+    assert three.version == one.version
+    for name in ("src", "dst", "weights", "labels", "cc_labels", "lof"):
+        np.testing.assert_array_equal(three[name], one[name])
+
+
+def test_plane_server_surfaces_and_gauges(tmp_path, monkeypatch):
+    """A 3-shard server's live surfaces: /healthz epoch + per-range
+    version vector, /statusz shardplane range table, per-shard-labeled
+    WAL gauges on /metrics (the unlabeled pre-shard series absent),
+    and the obs_report writer-shards timeline over the stream."""
+    monkeypatch.setenv("GRAPHMINE_QUALITY", "0")
+    sink = _sink()
+    src, dst, v = _cliques([(0, 15), (15, 30)])
+    store = SnapshotStore(str(tmp_path / "snap"))
+    _publish(store, src, dst, v, sink=sink)
+    server = SnapshotServer(
+        store, sink=sink, admission=_generous(), writer_shards=3,
+    )
+    host, port = server.start()
+    try:
+        out = _post(host, port, "/delta",
+                    {"insert": [[0, 5], [0, 16], [0, 25]], "delete": []})
+        assert out["version"] == 2
+        hz = _get(host, port, "/healthz")
+        assert hz["writer_shards"] == 3
+        assert hz["epoch"] == 1
+        assert hz["shard_versions"] == {"0": 2, "1": 2, "2": 2}
+        assert "degraded_shards" not in hz
+
+        sz = _get(host, port, "/statusz")
+        table = sz["shardplane"]
+        assert table["num_shards"] == 3
+        assert [s["shard"] for s in table["shards"]] == [0, 1, 2]
+        assert all(s["wal"]["last_seq"] == 1 for s in table["shards"])
+
+        req = urllib.request.Request(f"http://{host}:{port}/metrics")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            metrics = r.read().decode()
+        seq_lines = [
+            ln for ln in metrics.splitlines()
+            if ln.startswith("graphmine_serve_wal_last_seq{")
+        ]
+        for s in range(3):
+            assert any(f'shard="{s}"' in ln for ln in seq_lines), seq_lines
+        # the unlabeled pre-shard series must NOT exist in plane mode
+        assert "\ngraphmine_serve_wal_last_seq " not in metrics
+
+        faults.writer_shard_kill(server, 1)
+        hz = _get(host, port, "/healthz")
+        assert hz["degraded_shards"] == [1]
+    finally:
+        server.stop()
+
+    from tools.obs_report import build_report
+
+    report = build_report(sink.records)
+    assert "writer shards" in report
+    assert "EPOCH COMMIT" in report
+    assert "SHARD READ_ONLY" in report
+    assert validate_records(sink.records) == []
+
+
+def test_serve_cli_info_reads_shardplane_offline(tmp_path, monkeypatch):
+    """``serve_cli info`` reports the committed epoch, version vector
+    and per-shard WAL watermarks straight from the store — the RUNBOOKS
+    §17 offline triage path (no server process required)."""
+    monkeypatch.setenv("GRAPHMINE_QUALITY", "0")
+    src, dst, v = _cliques([(0, 15), (15, 30)])
+    store = SnapshotStore(str(tmp_path / "snap"))
+    _publish(store, src, dst, v)
+    server = SnapshotServer(store, admission=_generous(), writer_shards=2)
+    try:
+        server.apply_delta({"insert": [[0, 5], [0, 20]], "delete": []})
+        server.wait_applied(timeout=120.0)
+    finally:
+        server.stop()
+
+    from tools.serve_cli import _shardplane_info
+
+    info = _shardplane_info(store, store.load())
+    assert info["committed_epoch"] == 1
+    assert info["num_shards"] == 2
+    assert info["version_vector"] == {"0": 2, "1": 2}
+    wals = info["shard_wals"]
+    assert set(wals) == {"shard-000", "shard-001"}
+    assert all(w["last_seq"] == 1 for w in wals.values())
+
+
+# ---- THE chaos acceptance --------------------------------------------------
+
+
+def test_shard_kill_chaos_acceptance(tmp_path, monkeypatch):
+    """THE ISSUE 17 acceptance: a live 3-shard / 2-tenant server under
+    concurrent cross-range bursts loses writer shard 1 mid-burst.
+
+    Pinned from live surfaces: batches touching the dead range 503 with
+    the structured range reason while shard-0/2-confined batches AND the
+    second tenant keep publishing; /healthz epochs only ever advance and
+    every version vector is internally consistent (no mixed-epoch
+    reads); a server restart replays the acked tail so ZERO
+    acknowledged deltas are lost; the record stream validates clean."""
+    monkeypatch.setenv("GRAPHMINE_QUALITY", "0")
+    sink = _sink()
+    src, dst, v = _cliques([(0, 14), (14, 28), (28, 42)])
+    store = SnapshotStore(str(tmp_path / "snap"))
+    _publish(store, src, dst, v, sink=sink)
+    sb, db, vb = _cliques([(0, 10), (10, 20)])
+    _publish(store.for_tenant("tb"), sb, db, vb, sink=sink)
+
+    server = SnapshotServer(
+        store, sink=sink, admission=_generous(), writer_shards=3,
+    )
+    host, port = server.start()
+    # ranges: [0,14) [14,28) [28,42)+growth
+    acked = []        # (tenant, insert pairs) whose accept we saw
+    acked_lock = threading.Lock()
+    errors = []
+    refused_dead = [0]
+    epochs_seen = []
+    next_edge = [10_000]
+
+    def fresh_pairs(lo, hi, n=2):
+        """Unique never-before-inserted pairs with dst in [lo, hi)."""
+        with acked_lock:
+            base = next_edge[0]
+            next_edge[0] += n
+        return [[(base + i) % 14, lo + ((base + i) % (hi - lo))]
+                for i in range(n)]
+
+    stop = threading.Event()
+    killed = threading.Event()
+
+    def writer(tenant, lo, hi, ack_wal=False):
+        i = 0
+        while not stop.is_set():
+            pairs = fresh_pairs(lo, hi)
+            headers = {} if tenant == "default" else {"X-Tenant-Id": tenant}
+            if ack_wal:
+                # 202 at the durability point: these acks may still be
+                # queued when the shard dies — the replay-path half of
+                # the zero-acked-loss pin
+                headers["X-Delta-Ack"] = "wal"
+                headers["X-Delta-Id"] = f"{tenant}-{lo}-{i}"
+                i += 1
+            try:
+                out = _post(
+                    host, port, "/delta",
+                    {"insert": pairs, "delete": []},
+                    headers=headers,
+                )
+                if out.get("verdict") in (None, "accepted"):
+                    with acked_lock:
+                        acked.append((tenant, pairs))
+            except urllib.error.HTTPError as e:
+                body = e.read().decode()
+                if e.code == 503 and "degraded vertex range" in body:
+                    refused_dead[0] += 1
+                elif e.code != 503:
+                    errors.append((tenant, e.code, body))
+                    return
+            except Exception as exc:  # noqa: BLE001 — assert later
+                errors.append((tenant, exc))
+                return
+
+    threads = [
+        threading.Thread(target=writer, args=("default", 0, 14)),
+        threading.Thread(target=writer, args=("default", 14, 28, True)),
+        threading.Thread(target=writer, args=("default", 28, 42)),
+        threading.Thread(target=writer, args=("tb", 0, 20)),
+    ]
+    for t in threads:
+        t.start()
+    try:
+        import time as _time
+
+        t0 = _time.monotonic()
+        while _time.monotonic() - t0 < 8.0:
+            hz = _get(host, port, "/healthz")
+            if "epoch" in hz:
+                epochs_seen.append(hz["epoch"])
+                vv = hz["shard_versions"]
+                # no mixed-epoch read: one vector, all three ranges
+                # present, from ONE committed record
+                assert sorted(vv) == ["0", "1", "2"]
+            if (not killed.is_set()
+                    and _time.monotonic() - t0 > 2.0
+                    and len(acked) >= 6):
+                faults.writer_shard_kill(server, 1)
+                killed.set()
+            if killed.is_set() and refused_dead[0] > 0 and \
+                    _time.monotonic() - t0 > 5.0:
+                break
+            _time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+
+        assert killed.is_set(), "storm never reached the kill point"
+        assert errors == [], errors
+        assert refused_dead[0] > 0, "dead range never refused a batch"
+        # epochs only ever advanced — a torn or reverted epoch would
+        # show up as a non-monotonic step
+        assert epochs_seen == sorted(epochs_seen)
+
+        # untouched ranges (and the OTHER TENANT) still accept, live
+        ok = _post(host, port, "/delta",
+                   {"insert": [[0, 1]], "delete": []})
+        assert ok.get("verdict") in (None, "accepted")
+        okb = _post(host, port, "/delta",
+                    {"insert": [[0, 1]], "delete": []},
+                    headers={"X-Tenant-Id": "tb"})
+        assert okb.get("verdict") in (None, "accepted")
+        with acked_lock:
+            acked.append(("default", [[0, 1]]))
+            acked.append(("tb", [[0, 1]]))
+        server.wait_applied(timeout=120.0)
+    finally:
+        stop.set()
+        server.stop()
+
+    # zero acked-delta loss: a fresh server over the same store replays
+    # every shard's acked-but-unapplied tail (shard 1's closed WAL
+    # included) and every acknowledged insert is in the published edges
+    server2 = SnapshotServer(
+        store, sink=sink, admission=_generous(), writer_shards=3,
+    )
+    try:
+        assert server2.wait_applied(timeout=120.0)
+        for tenant in ("default", "tb"):
+            snap = (store if tenant == "default"
+                    else store.for_tenant("tb")).load()
+            have = set(zip(snap["src"].tolist(), snap["dst"].tolist()))
+            for t, pairs in acked:
+                if t != tenant:
+                    continue
+                for s, d in pairs:
+                    assert (s, d) in have, (
+                        f"acked insert ({s},{d}) for {tenant} lost"
+                    )
+        # the epoch chain converged with the WAL watermarks
+        ts = server2._tenants["default"]
+        assert ts.plane.coordinator.committed_epoch() >= max(
+            epochs_seen or [0]
+        )
+    finally:
+        server2.stop()
+    assert validate_records(sink.records) == []

@@ -78,7 +78,7 @@ SUB_RECORDS = {
     "stream": ("ivf_reuse",),
     "serve": ("write_load", "replicated_read", "writer_failover",
               "latency_quantiles", "quality_pass", "multi_tenant",
-              "memory"),
+              "sharded_write", "memory"),
     # the per-tier memory sub-record (ISSUE 14: model + measured child
     # peak RSS) is tracked on the headline tier; every tier carries it,
     # but one manifest row is the signal "this round recorded memory"

@@ -174,6 +174,30 @@ def cmd_status(args) -> int:
         print(f"fleet_cli: router unreachable at {args.url}: {e}",
               file=sys.stderr)
         return 2
+    if getattr(args, "shards", False):
+        # sharded-write-plane view (r17): collapse each replica's probed
+        # epoch + per-range version vector into a range table — "which
+        # epoch is served, which range is behind or read-only" at a
+        # glance (RUNBOOKS §17)
+        out = {
+            "committed_version": out.get("committed_version"),
+            "writer": out.get("writer"),
+            "read_only": out.get("read_only"),
+            "replicas": [
+                {
+                    "id": r.get("id"),
+                    "state": r.get("state"),
+                    "writer": r.get("writer"),
+                    "writer_shards": r.get("writer_shards"),
+                    "epoch": r.get("epoch"),
+                    "shard_versions": r.get("shard_versions"),
+                    "degraded_shards": r.get("degraded_shards"),
+                }
+                for r in out.get("replicas", [])
+            ],
+        }
+        print(json.dumps(out, indent=1))
+        return 0
     if args.tenant:
         # per-tenant view: collapse each replica's tenant_versions map
         # (the prober's /healthz payload) to the one namespace asked for
@@ -259,6 +283,11 @@ def main(argv=None) -> int:
                    help="collapse the view to one tenant namespace: "
                         "per-replica versions for that tenant only "
                         "(docs/SERVING.md 'Multi-tenant serving')")
+    p.add_argument("--shards", action="store_true",
+                   help="collapse the view to the sharded write plane: "
+                        "per-replica committed epoch + per-range version "
+                        "vector + degraded ranges (docs/SERVING.md "
+                        "'Sharded write plane')")
     p.set_defaults(fn=cmd_status)
 
     p = sub.add_parser("roll", help="trigger a zero-downtime rolling reload")

@@ -875,6 +875,65 @@ def _failover_section(records, t0):
     return out
 
 
+def _writer_shards_section(records, t0):
+    """Sharded-write-plane timeline (r17, docs/SERVING.md "Sharded write
+    plane"): per-range admission verdict mix, every epoch commit, every
+    per-shard stage publish, and each range's degrade/recover/promote
+    line — the §17 runbook's "which range is read-only, which epoch is
+    stuck" view. Empty list = no shard-plane records in the stream."""
+    publishes = [r for r in records if r.get("phase") == "shard_publish"]
+    commits = [r for r in records if r.get("phase") == "epoch_commit"]
+    degraded = [r for r in records if r.get("phase") == "shard_degraded"]
+    admissions = [
+        r for r in records
+        if r.get("phase") == "admission" and r.get("shard") is not None
+    ]
+    if not (publishes or commits or degraded):
+        return []
+    out = []
+    if admissions:
+        # per-range verdict mix: one line per shard, the range-level
+        # answer to "who is shedding"
+        by_shard: dict = {}
+        for r in admissions:
+            mix = by_shard.setdefault(int(r["shard"]), {})
+            v = r.get("verdict", "?")
+            mix[v] = mix.get(v, 0) + 1
+        for shard in sorted(by_shard):
+            mix = by_shard[shard]
+            parts = "  ".join(
+                f"{v}={mix[v]}" for v in sorted(mix)
+            )
+            out.append(f"  shard {shard} admission: {parts}")
+    if publishes:
+        by_shard = {}
+        for r in publishes:
+            by_shard.setdefault(int(r.get("shard", -1)), []).append(r)
+        staged = ", ".join(
+            f"shard {s}×{len(rs)}" for s, rs in sorted(by_shard.items())
+        )
+        out.append(f"  stage publishes: {len(publishes)} ({staged})")
+    for r in commits:
+        vec = r.get("version_vector") or {}
+        vv = " ".join(
+            f"{k}:{vec[k]}" for k in sorted(vec, key=lambda x: int(x))
+        )
+        tag = "  (recovered)" if r.get("recovered") else ""
+        out.append(
+            f"  {_fmt_offset(r, t0)}  EPOCH COMMIT  epoch "
+            f"{r.get('epoch', '?')}  versions [{vv}]{tag}"
+        )
+    for r in degraded:
+        status = str(r.get("status", "?")).upper()
+        rng = r.get("range")
+        rng_s = f" [{rng[0]},{rng[1]})" if isinstance(rng, list) else ""
+        out.append(
+            f"  {_fmt_offset(r, t0)}  SHARD {status}  shard "
+            f"{r.get('shard', '?')}{rng_s}  [{r.get('reason', '')}]"
+        )
+    return out
+
+
 def _sketch_quantiles(state) -> str:
     """p50/p90/p99 of a sketch state dict — rebuilt through the one
     shared QuantileSketch machinery so the report's numbers can never
@@ -1222,6 +1281,13 @@ def build_report(
         lines.append("")
         lines.append("-- writer failover (WAL / promotion / fencing) --")
         lines.extend(failover)
+    shards = _writer_shards_section(records, t0)
+    if shards:
+        lines.append("")
+        lines.append(
+            "-- writer shards (ranges / epochs / per-range failover) --"
+        )
+        lines.extend(shards)
     lines.append("")
     lines.append("-- recovery timeline --")
     lines.extend(_recovery_timeline(records, t0))

@@ -7,7 +7,13 @@
 # (-m faults: tests/test_resilience.py + the tripwire/reshard cases in
 # tests/test_sharded.py) is part of this default pass.
 #
-# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only|--slo-only|--blocking-only|--admission-only|--fleet-only|--wal-only|--trace-only|--perf-only|--quality-only|--mem-only|--sharded2d-only|--tenancy-only] [extra pytest args...]
+# Usage: tools/run_tier1.sh [--faults-only|--obs-only|--ann-only|--serve-only|--slo-only|--blocking-only|--admission-only|--fleet-only|--wal-only|--trace-only|--perf-only|--quality-only|--mem-only|--sharded2d-only|--tenancy-only|--shardplane-only] [extra pytest args...]
+#   --shardplane-only run just the `shardplane`-marked sharded-write-
+#                  plane suite (tests/test_shardplane.py: range plan
+#                  ownership, deterministic delta splitter bit-parity,
+#                  epoch stage/commit/recover incl. torn publish, and
+#                  the 3-shard/2-tenant shard-kill chaos acceptance) —
+#                  the fast slice when iterating on serve/shardplane.py
 #   --tenancy-only run just the `tenancy`-marked multi-tenant serving
 #                  suite (tests/test_tenancy.py: namespaced stores,
 #                  hostile-id refusal, per-tenant bounds + fair apply,
@@ -139,6 +145,9 @@ elif [ "${1:-}" = "--sharded2d-only" ]; then
 elif [ "${1:-}" = "--tenancy-only" ]; then
     shift
     MARKER='tenancy and not slow'
+elif [ "${1:-}" = "--shardplane-only" ]; then
+    shift
+    MARKER='shardplane and not slow'
 fi
 
 LOG="${TIER1_LOG:-/tmp/_t1.log}"

@@ -77,6 +77,16 @@ _SKETCH_OWNERS = (
     os.path.join("graphmine_tpu", "obs", "quality.py"),
 )
 
+# Inline shard-plane record emission (ISSUE 17): the shard_publish /
+# epoch_commit / shard_degraded family has ONE builder —
+# serve/shardplane.emit_shard_record(), which validates the phase name
+# before anything reaches the sink. A raw sink.emit("shard_publish",...)
+# elsewhere would bypass that gate and drift from the registered shapes.
+_INLINE_SHARD_RE = re.compile(
+    r"emit\(\s*[\"'](?:shard_publish|epoch_commit|shard_degraded)[\"']"
+)
+_SHARD_OWNER = os.path.join("graphmine_tpu", "serve", "shardplane.py")
+
 PACKAGE_DIR = os.path.join(_REPO, "graphmine_tpu")
 
 
@@ -142,6 +152,12 @@ def scan_inline_sketches(root: str = PACKAGE_DIR) -> list:
     return _scan_inline(root, _INLINE_SKETCH_RE, _SKETCH_OWNERS)
 
 
+def scan_inline_shard_records(root: str = PACKAGE_DIR) -> list:
+    """``(file, line)`` pairs of direct shard-plane record emits outside
+    the single builder (serve/shardplane.emit_shard_record)."""
+    return _scan_inline(root, _INLINE_SHARD_RE, (_SHARD_OWNER,))
+
+
 def violations(root: str = PACKAGE_DIR) -> list:
     """Emitted-but-unregistered phases plus inline cost sub-records:
     list of human-readable strings (empty = clean). The tier-1 test
@@ -169,6 +185,13 @@ def violations(root: str = PACKAGE_DIR) -> list:
         "sub-records with graphmine_tpu/obs/sketch.py "
         "(QuantileSketch.to_state()), the single shape owner"
         for path, line in scan_inline_sketches(root)
+    )
+    out.extend(
+        f"{path}:{line}: direct shard-plane record emit — route "
+        "shard_publish/epoch_commit/shard_degraded through "
+        "graphmine_tpu/serve/shardplane.py (emit_shard_record), the "
+        "single builder"
+        for path, line in scan_inline_shard_records(root)
     )
     return out
 

@@ -186,15 +186,78 @@ def _store(args):
 
 
 def cmd_info(args) -> int:
-    snap = _store(args).load()
+    store = _store(args)
+    snap = store.load()
     if snap is None:
         print(f"serve_cli: store at {args.store!r} is empty", file=sys.stderr)
         return 2
-    print(json.dumps({
+    out = {
         **snap.meta,
         "arrays": {k: list(v.shape) for k, v in snap.arrays.items()},
-    }, indent=1, default=str))
+    }
+    # Sharded write plane (r17): when the store carries an epochs/
+    # directory, report the committed epoch, its per-range version
+    # vector and the range table from the durable publish_epoch record
+    # — the offline twin of /healthz's epoch + shard_versions.
+    shards = _shardplane_info(store, snap)
+    if shards is not None:
+        out["shardplane"] = shards
+    print(json.dumps(out, indent=1, default=str))
     return 0
+
+
+def _shardplane_info(store, snap):
+    """The store's committed-epoch view, read straight off disk via the
+    coordinator (no server needed). None when the store has never run
+    under writer_shards > 1."""
+    import os as _os
+
+    from graphmine_tpu.serve.shardplane import (
+        EpochCoordinator,
+        ShardPlan,
+        SHARDS_DIRNAME,
+    )
+    from graphmine_tpu.serve.snapshot import EPOCHS_DIRNAME
+    from graphmine_tpu.serve.wal import WriteAheadLog
+
+    if not _os.path.isdir(_os.path.join(store.root, EPOCHS_DIRNAME)):
+        return None
+    coord = EpochCoordinator(
+        store, ShardPlan.build(1, int(len(snap["labels"])))
+    )
+    epoch = coord.committed_epoch()
+    rec = coord._read_record(coord._record_path(epoch)) if epoch else None
+    out = {
+        "committed_epoch": epoch,
+        "version_vector": {
+            str(k): v for k, v in coord.version_vector(epoch).items()
+        },
+        "ranges": (rec or {}).get("ranges", []),
+        "num_shards": (rec or {}).get("num_shards"),
+    }
+    # per-shard WAL lag: open each range's log read-only and report its
+    # last vs applied seq (the "which range is behind" column)
+    wals = {}
+    base = _os.path.join(store.root, SHARDS_DIRNAME)
+    if _os.path.isdir(base):
+        for name in sorted(_os.listdir(base)):
+            wal_dir = _os.path.join(base, name, "wal")
+            if not _os.path.isdir(wal_dir):
+                continue
+            try:
+                wal = WriteAheadLog(wal_dir, read_only=True)
+                s = wal.snapshot()
+                wals[name] = {
+                    "last_seq": s.get("last_seq"),
+                    "applied_seq": s.get("applied_seq"),
+                    "pending_entries": s.get("pending_entries"),
+                }
+                wal.close()
+            except (OSError, ValueError):
+                wals[name] = {"error": "unreadable"}
+    if wals:
+        out["shard_wals"] = wals
+    return out
 
 
 def cmd_query(args) -> int:
